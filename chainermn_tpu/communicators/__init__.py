@@ -1,0 +1,68 @@
+"""Communicator factory.
+
+Rebuild of ``chainermn/communicators/__init__.py:1-73``: the same
+name->strategy dispatch surface, mapped to mesh/collective layouts
+instead of MPI/NCCL stacks.
+
+Selection guide (parity with the reference's table at
+``communicators/__init__.py:12-20``):
+
+============== ========== ===========================================
+Name           Mesh       Use case
+============== ========== ===========================================
+xla            2-D        flagship: let XLA lower the fused allreduce
+                          (recommended; no reference equivalent)
+hierarchical   2-D        explicit ICI reduce-scatter -> DCN psum ->
+                          ICI all-gather (reference default)
+two_dimensional 2-D       full-mesh reduce-scatter/all-gather
+flat           2-D        one fused collective, no staging
+naive          2-D        per-parameter pmean; CPU testing
+single_node    1 host     ICI-only; asserts inter_size == 1
+non_cuda_aware 2-D        hierarchical with f32-staged DCN leg
+dummy          any        no communication; fusion-overhead probe
+============== ========== ===========================================
+"""
+
+from chainermn_tpu.communicators.base import CommunicatorBase  # noqa
+from chainermn_tpu.communicators.dummy_communicator import DummyCommunicator
+from chainermn_tpu.communicators.flat_communicator import FlatCommunicator
+from chainermn_tpu.communicators.hierarchical_communicator import (
+    HierarchicalCommunicator)
+from chainermn_tpu.communicators.naive_communicator import NaiveCommunicator
+from chainermn_tpu.communicators.non_cuda_aware_communicator import (
+    NonCudaAwareCommunicator)
+from chainermn_tpu.communicators.single_node_communicator import (
+    SingleNodeCommunicator)
+from chainermn_tpu.communicators.two_dimensional_communicator import (
+    TwoDimensionalCommunicator)
+from chainermn_tpu.communicators.xla_communicator import XlaCommunicator
+
+_COMMUNICATORS = {
+    'naive': NaiveCommunicator,
+    'flat': FlatCommunicator,
+    'hierarchical': HierarchicalCommunicator,
+    'two_dimensional': TwoDimensionalCommunicator,
+    'single_node': SingleNodeCommunicator,
+    'non_cuda_aware': NonCudaAwareCommunicator,
+    'dummy': DummyCommunicator,
+    'xla': XlaCommunicator,
+}
+
+
+def create_communicator(communicator_name='xla', mesh=None, mesh_shape=None,
+                        devices=None):
+    """Create a communicator by strategy name.
+
+    Parity with ``chainermn.create_communicator(name, mpi_comm)``
+    (reference ``communicators/__init__.py:22-34``); ``mesh``/
+    ``mesh_shape``/``devices`` replace the ``mpi_comm`` argument (the
+    default -- discover all global devices -- replaces
+    ``MPI.COMM_WORLD``).
+    """
+    try:
+        cls = _COMMUNICATORS[communicator_name]
+    except KeyError:
+        raise ValueError(
+            'Unrecognized communicator: %r (choose from %s)'
+            % (communicator_name, ', '.join(sorted(_COMMUNICATORS))))
+    return cls(mesh=mesh, mesh_shape=mesh_shape, devices=devices)

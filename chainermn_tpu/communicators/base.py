@@ -1,0 +1,188 @@
+"""Communicator base class.
+
+TPU-native rebuild of ``chainermn/communicators/_base.py``.  The
+reference communicator is an eager, per-process object doing MPI/NCCL
+calls; ours is a *mesh-backed* object whose collective methods are pure
+functions valid inside ``shard_map``/``pjit`` traces over ``self.mesh``
+(XLA lowers them to ICI/DCN collectives), plus a few eager driver-level
+helpers for host-side data placement.
+
+Correspondence with the reference API (``_base.py:15-80``):
+
+- ``rank`` / ``size``            -> global device rank / device count
+- ``intra_rank`` etc.            -> mesh coordinates (``_base.py:83-111``)
+- ``send`` / ``recv``            -> :meth:`send_recv` (collective permute);
+                                    typed eager wire protocol is unnecessary
+                                    because XLA shapes are static
+- ``broadcast_data(model)``      -> :meth:`broadcast_data` (root-select psum)
+- ``allreduce_grad(model)``      -> :meth:`allreduce_grad` (strategy-defined)
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.communicators import mesh_utility
+from chainermn_tpu.communicators.mesh_utility import AXIS_INTER, AXIS_INTRA, AXES
+
+
+def _is_tracing(tree):
+    return any(isinstance(leaf, jax.core.Tracer)
+               for leaf in jax.tree_util.tree_leaves(tree))
+
+
+class CommunicatorBase:
+    """Mesh-backed communicator.
+
+    ``allreduce_grad`` must be called inside a ``shard_map`` over
+    ``self.mesh`` (the canonical way is via
+    :func:`chainermn_tpu.create_multi_node_optimizer`); subclasses
+    implement the reduction strategy in :meth:`_allreduce_impl`.
+    """
+
+    def __init__(self, mesh=None, mesh_shape=None, devices=None):
+        if mesh is None:
+            mesh = mesh_utility.build_mesh(devices, mesh_shape)
+        self.mesh = mesh
+
+    # ------------------------------------------------------------------
+    # Topology (reference `_base.py:15-21, 83-111`)
+    # ------------------------------------------------------------------
+    @property
+    def size(self):
+        """Total number of devices in the mesh (= reference world size)."""
+        return self.mesh.size
+
+    @property
+    def inter_size(self):
+        return self.mesh.shape[AXIS_INTER]
+
+    @property
+    def intra_size(self):
+        return self.mesh.shape[AXIS_INTRA]
+
+    @property
+    def rank(self):
+        """Driver-level rank: this *process*'s index.
+
+        Inside a trace, per-device rank is :meth:`axis_rank`.  The
+        reference has one process per device so the two coincide there.
+        """
+        return jax.process_index()
+
+    # -- in-trace coordinates ------------------------------------------
+    def intra_rank(self):
+        return lax.axis_index(AXIS_INTRA)
+
+    def inter_rank(self):
+        return lax.axis_index(AXIS_INTER)
+
+    def axis_rank(self):
+        """Global device rank, valid inside shard_map over ``self.mesh``."""
+        return self.inter_rank() * self.intra_size + self.intra_rank()
+
+    # ------------------------------------------------------------------
+    # Collectives (in-trace)
+    # ------------------------------------------------------------------
+    def allreduce_grad(self, grads):
+        """Mean-allreduce a gradient pytree across the whole mesh.
+
+        Parity: communicator ``allreduce_grad`` including the 1/size
+        averaging that every reference communicator applies (e.g.
+        ``naive_communicator.py:19-20``).
+        """
+        return self._allreduce_impl(grads)
+
+    def _allreduce_impl(self, grads):
+        raise NotImplementedError
+
+    def allreduce(self, x, op='mean'):
+        """Allreduce a single array or pytree over the full mesh."""
+        red = {'mean': lambda v: lax.pmean(v, AXES),
+               'sum': lambda v: lax.psum(v, AXES),
+               'max': lambda v: lax.pmax(v, AXES),
+               'min': lambda v: lax.pmin(v, AXES)}[op]
+        return jax.tree_util.tree_map(red, x)
+
+    def broadcast_data(self, params, root=0):
+        """Every device receives ``root``'s values.
+
+        Parity: ``broadcast_data`` / ``broadcast_naive``
+        (``_communication_utility.py:57-60``).  Lowered as a masked psum
+        -- XLA rewrites ``psum(select(rank==root, x, 0))`` into an
+        efficient broadcast over ICI.
+
+        Works both inside a trace (uses axis indices) and eagerly (uses
+        replicated ``device_put``; with one controller every process
+        holds the same host values, so replication *is* the broadcast).
+        """
+        if not _is_tracing(params):
+            return self.replicate(params)
+        me = self.axis_rank()
+
+        def bcast(x):
+            sel = jnp.where(me == root, x, jnp.zeros_like(x))
+            return lax.psum(sel, AXES).astype(x.dtype)
+
+        return jax.tree_util.tree_map(bcast, params)
+
+    def send_recv(self, x, perm, axis=AXIS_INTRA):
+        """Point-to-point: collective permute along one mesh axis.
+
+        Parity: ``CommunicatorBase.send``/``recv`` (``_base.py:23-74``).
+        The reference ships (ndim, shape, payload) as three eager MPI
+        messages because Chainer shapes are dynamic; under XLA shapes
+        are static so a single ``ppermute`` suffices, and its transpose
+        (reverse permutation) is exactly the reference's
+        ``Send.backward = recv`` (``point_to_point_communication.py:23-33``)
+        -- supplied automatically by JAX autodiff.
+        """
+        return lax.ppermute(x, axis, perm)
+
+    # ------------------------------------------------------------------
+    # Driver-level (eager) helpers
+    # ------------------------------------------------------------------
+    def replicate(self, tree):
+        """Place a host pytree on the mesh fully replicated."""
+        sharding = NamedSharding(self.mesh, P())
+        return jax.device_put(tree, sharding)
+
+    def shard_batch(self, tree, axis=0):
+        """Place a host batch sharded over all devices along ``axis``.
+
+        The TPU-native analogue of per-rank minibatching: one global
+        array, leading dim split over (inter x intra).
+        """
+        spec = [None] * axis + [AXES]
+        sharding = NamedSharding(self.mesh, P(*spec))
+        return jax.device_put(tree, sharding)
+
+    def batch_spec(self, axis=0):
+        return P(*([None] * axis + [AXES]))
+
+    def allreduce_obj(self, value, op='mean'):
+        """Eager scalar/pytree allreduce across *processes*.
+
+        Parity: the evaluator's pickle-based ``mpi_comm.allreduce``
+        (``multi_node_evaluator.py:31-38``).  With a single controller
+        every process computes the same global metrics, so this is the
+        identity unless multi-process; then it runs a tiny jitted psum.
+        """
+        if jax.process_count() == 1:
+            return value
+        from jax.experimental import multihost_utils
+        vals = multihost_utils.process_allgather(value)
+
+        def red(stack):
+            if op == 'mean':
+                return stack.mean(axis=0)
+            if op == 'sum':
+                return stack.sum(axis=0)
+            raise ValueError(op)
+        return jax.tree_util.tree_map(red, vals)
+
+    # ------------------------------------------------------------------
+    def __repr__(self):
+        return '%s(inter=%d, intra=%d)' % (
+            type(self).__name__, self.inter_size, self.intra_size)

@@ -1,0 +1,17 @@
+"""No-communication communicator (reference ``dummy_communicator.py``).
+
+Runs the full pack/unpack path but performs no collective, so measured
+step time isolates fusion overhead from communication -- the same
+measurement purpose as the reference (``dummy_communicator.py:8-12``),
+and like the reference it does not produce correct training results on
+more than one device.
+"""
+
+from chainermn_tpu.communicators import memory_utility
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+
+class DummyCommunicator(CommunicatorBase):
+
+    def _allreduce_impl(self, grads):
+        return memory_utility.fused_reduce(grads, lambda buf: buf)

@@ -1,0 +1,36 @@
+"""Two-level ICI-then-DCN allreduce (reference default,
+``hierarchical_communicator.py``).
+
+The reference reduces within each node over NCCL, allreduces across node
+roots over MPI, then broadcasts within nodes (``:37-53``).  The TPU
+mapping: reduce-scatter + regather staged so the *intra* (ICI) axis
+carries the bulk of the traffic and the *inter* (DCN) axis moves only
+the already-reduced values once:
+
+    psum_scatter(intra) -> psum(inter) -> all_gather(intra)
+
+Each device ships ``1/intra_size`` of the buffer over DCN -- the same
+bandwidth shape as the reference's node-root chunking
+(``hierarchical_communicator.py:27-29``), but with the inter-node
+traffic spread over every device's DCN link instead of one root.
+"""
+
+from jax import lax
+
+from chainermn_tpu.communicators import memory_utility
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.communicators.mesh_utility import AXIS_INTER, AXIS_INTRA
+
+
+class HierarchicalCommunicator(CommunicatorBase):
+
+    def _allreduce_impl(self, grads):
+        def reduce_buf(buf):
+            buf, n = memory_utility.pad_to_multiple(buf, self.intra_size)
+            shard = lax.psum_scatter(buf, AXIS_INTRA, scatter_dimension=0,
+                                     tiled=True)
+            shard = lax.psum(shard, AXIS_INTER)
+            buf = lax.all_gather(shard, AXIS_INTRA, axis=0, tiled=True)
+            return buf[:n] / self.size
+
+        return memory_utility.fused_reduce(grads, reduce_buf)

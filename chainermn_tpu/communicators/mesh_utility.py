@@ -1,0 +1,97 @@
+"""Topology discovery and device-mesh construction.
+
+TPU-native replacement for the reference's hostname-based rank discovery
+(``chainermn/communicators/_communication_utility.py:7-40`` groups MPI
+ranks by ``MPI.Get_processor_name()`` into (intra_rank, inter_rank)).
+
+On TPU the two-level topology is intrinsic: devices within one host /
+slice talk over ICI, hosts talk over DCN.  We therefore build a 2-D
+``jax.sharding.Mesh`` with axes ``('inter', 'intra')``:
+
+- ``intra`` -- devices that share a process (>= ICI locality), the
+  analogue of the reference's intra-node NCCL group,
+- ``inter`` -- across processes (DCN), the analogue of the reference's
+  inter-node MPI group.
+
+No launcher is involved: JAX's runtime enumerates global devices, so the
+all-gather/scatter handshake the reference performs at
+``_communication_utility.py:16-40`` is unnecessary.
+"""
+
+import collections
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+#: Mesh axis that maps to DCN (across hosts) -- reference "inter_rank".
+AXIS_INTER = 'inter'
+#: Mesh axis that maps to ICI (within a host/slice) -- reference "intra_rank".
+AXIS_INTRA = 'intra'
+#: Both axes, in majorness order; data parallelism spans the product.
+AXES = (AXIS_INTER, AXIS_INTRA)
+
+
+def sorted_devices(devices=None):
+    """Global devices in deterministic (process_index, id) order."""
+    if devices is None:
+        devices = jax.devices()
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def detect_topology(devices=None):
+    """Return ``(inter_size, intra_size)`` discovered from the device set.
+
+    Mirrors the information computed by ``init_ranks``
+    (``_communication_utility.py:7-40``) -- but from the JAX runtime's
+    process/device table instead of an MPI hostname gather.
+    """
+    devices = sorted_devices(devices)
+    per_process = collections.Counter(d.process_index for d in devices)
+    sizes = set(per_process.values())
+    if len(sizes) != 1:
+        # Ragged hosts cannot form a rectangular mesh; collapse to 1-D.
+        return (1, len(devices))
+    intra = sizes.pop()
+    return (len(per_process), intra)
+
+
+def build_mesh(devices=None, mesh_shape=None):
+    """Build the 2-D ``(inter, intra)`` mesh.
+
+    ``mesh_shape`` overrides discovery, letting tests emulate a
+    multi-host topology on a single process (the analogue of the
+    reference testing multi-node code with ``mpiexec -n 3`` on one CPU
+    host, ``.travis.yml:55``).
+    """
+    devices = sorted_devices(devices)
+    n = len(devices)
+    if mesh_shape is None:
+        mesh_shape = detect_topology(devices)
+    inter, intra = mesh_shape
+    if inter == -1:
+        inter = n // intra
+    if intra == -1:
+        intra = n // inter
+    if inter * intra != n:
+        raise ValueError(
+            'mesh_shape %r does not cover %d devices' % ((inter, intra), n))
+    arr = np.asarray(devices, dtype=object).reshape(inter, intra)
+    return Mesh(arr, AXES)
+
+
+def factorized_mesh(devices=None, intra_size=None):
+    """Mesh with a chosen intra size (defaults to detected topology)."""
+    devices = sorted_devices(devices)
+    if intra_size is None:
+        return build_mesh(devices)
+    return build_mesh(devices, (-1, intra_size))
+
+
+def balanced_2d(n):
+    """Near-square (inter, intra) factorization of ``n`` for tests."""
+    intra = int(math.sqrt(n))
+    while n % intra:
+        intra -= 1
+    return (n // intra, intra)

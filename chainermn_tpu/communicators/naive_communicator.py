@@ -1,0 +1,21 @@
+"""Per-parameter allreduce (reference ``naive_communicator.py``).
+
+The reference issues one in-place MPI ``Allreduce`` per parameter and
+divides by world size afterwards (``naive_communicator.py:16-20``).  The
+TPU analogue is a per-leaf ``pmean`` over the full mesh -- XLA emits one
+collective per leaf, no fusion.  Like the reference, this is the
+baseline/CPU-friendly strategy and the fusion-free control for
+benchmarking.
+"""
+
+from jax import lax
+import jax
+
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.communicators.mesh_utility import AXES
+
+
+class NaiveCommunicator(CommunicatorBase):
+
+    def _allreduce_impl(self, grads):
+        return jax.tree_util.tree_map(lambda g: lax.pmean(g, AXES), grads)

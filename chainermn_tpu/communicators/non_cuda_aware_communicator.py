@@ -1,0 +1,43 @@
+"""Host-staged hierarchical allreduce (reference
+``non_cuda_aware_communicator.py``).
+
+The reference exists because some MPI builds cannot read GPU pointers:
+inter-node traffic is staged through pinned host memory (``:49-73``).
+The TPU analogue of "stage across the slow link on the host" is forcing
+the DCN leg of the reduction through a transfer-friendly dtype: the
+intra (ICI) reduction runs at full precision, the inter (DCN) leg is
+cast to float32 (or kept if already lower) so links with no native
+wide-type support behave deterministically.  Functionally it is the
+hierarchical strategy with an explicit DCN staging dtype.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.communicators import memory_utility
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.communicators.mesh_utility import AXIS_INTER, AXIS_INTRA
+
+
+class NonCudaAwareCommunicator(CommunicatorBase):
+
+    inter_dtype = jnp.float32
+
+    def _allreduce_impl(self, grads):
+        def reduce_buf(buf):
+            buf, n = memory_utility.pad_to_multiple(buf, self.intra_size)
+            shard = lax.psum_scatter(buf, AXIS_INTRA, scatter_dimension=0,
+                                     tiled=True)
+            # Stage the DCN leg at <= float32: narrow wide dtypes, never
+            # widen (widening would double DCN bytes, the opposite of
+            # what host staging is for).
+            stage_dt = self.inter_dtype
+            narrow = jnp.dtype(shard.dtype).itemsize > jnp.dtype(
+                stage_dt).itemsize
+            staged = shard.astype(stage_dt) if narrow else shard
+            staged = lax.psum(staged, AXIS_INTER)
+            shard = staged.astype(shard.dtype)
+            buf = lax.all_gather(shard, AXIS_INTRA, axis=0, tiled=True)
+            return buf[:n] / self.size
+
+        return memory_utility.fused_reduce(grads, reduce_buf)

@@ -1,0 +1,28 @@
+"""Intra-node-only allreduce (reference ``single_node_communicator.py``).
+
+The reference is pure-NCCL and asserts it runs on one node
+(``single_node_communicator.py:13-15``).  Ours reduces over the ICI
+(``intra``) axis only and asserts ``inter_size == 1`` at construction,
+exactly mirroring that contract.
+"""
+
+from jax import lax
+
+from chainermn_tpu.communicators import memory_utility
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.communicators.mesh_utility import AXIS_INTRA
+
+
+class SingleNodeCommunicator(CommunicatorBase):
+
+    def __init__(self, mesh=None, mesh_shape=None, devices=None):
+        super().__init__(mesh, mesh_shape, devices)
+        if self.inter_size != 1:
+            raise ValueError(
+                'SingleNodeCommunicator requires inter_size == 1 '
+                '(got %d); use hierarchical/xla for multi-host meshes'
+                % self.inter_size)
+
+    def _allreduce_impl(self, grads):
+        return memory_utility.fused_reduce(
+            grads, lambda buf: lax.pmean(buf, AXIS_INTRA))

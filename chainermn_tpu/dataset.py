@@ -1,0 +1,109 @@
+"""Dataset partitioning.
+
+Rebuild of ``chainermn/dataset.py``.  The reference's rank 0 slices the
+dataset into near-equal ``SubDataset``s and pickle-sends one to every
+rank (``dataset.py:29-43``).  With JAX's single-controller model every
+process holds (or can open) the dataset, so scattering is pure index
+arithmetic -- no serial O(size) send loop, no pickle wire format.
+"""
+
+import math
+
+import numpy as np
+
+
+class SubDataset:
+    """A contiguous view ``dataset[start:finish]`` (the reference reuses
+    ``chainer.datasets.SubDataset``; this is our standalone
+    equivalent)."""
+
+    def __init__(self, dataset, start, finish):
+        if not 0 <= start <= finish <= len(dataset):
+            raise ValueError('invalid sub-dataset range [%d, %d)'
+                             % (start, finish))
+        self._dataset = dataset
+        self._start = start
+        self._finish = finish
+
+    def __len__(self):
+        return self._finish - self._start
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if i < -len(self) or i >= len(self):
+            raise IndexError(i)
+        return self._dataset[self._start + (i % len(self))]
+
+
+def scatter_index(n_total, size, rank):
+    """(start, finish) of ``rank``'s shard.
+
+    Balanced quotient partition: shard lengths differ by at most 1 and
+    no shard is empty while ``n_total >= size`` (the reference's
+    ceil-chunking at ``dataset.py:32`` can hand trailing ranks empty
+    shards, which would desync collective-issuing loops; the balanced
+    rule keeps the reference's covered-exactly contract from its
+    ``tests/test_dataset.py:16-34`` without that hazard)."""
+    return (n_total * rank) // size, (n_total * (rank + 1)) // size
+
+
+def scatter_dataset(dataset, comm=None, size=None, rank=None, shuffle=False,
+                    seed=0):
+    """Return this process's shard of ``dataset``.
+
+    Parity with ``chainermn.scatter_dataset(dataset, comm)``
+    (``dataset.py:5-43``).  ``size``/``rank`` default to the JAX process
+    topology (data loading is per-process; per-device sharding of each
+    batch is the updater's job).  ``shuffle`` adds a seeded global
+    permutation -- an extension the reference lacks.
+    """
+    import jax
+    if size is None:
+        size = jax.process_count()
+    if rank is None:
+        rank = jax.process_index()
+    if not 0 <= rank < size:
+        raise ValueError('rank %d out of range for size %d' % (rank, size))
+    if shuffle:
+        order = np.random.RandomState(seed).permutation(len(dataset))
+        dataset = _Permuted(dataset, order)
+    start, finish = scatter_index(len(dataset), size, rank)
+    return SubDataset(dataset, start, finish)
+
+
+class _Permuted:
+    def __init__(self, dataset, order):
+        self._dataset = dataset
+        self._order = order
+
+    def __len__(self):
+        return len(self._dataset)
+
+    def __getitem__(self, i):
+        return self._dataset[int(self._order[i])]
+
+
+def get_n_iterations_for_one_epoch(dataset, local_batch_size, comm=None,
+                                   size=None):
+    """Iterations per epoch under even sharding (deprecated in the
+    reference, ``dataset.py:46-74``; kept for API parity).
+
+    ``size`` defaults to ``comm.size`` (device count, matching the
+    reference's one-process-per-device ``comm.size``) or, with no
+    communicator, the process count.
+    """
+    import jax
+    if size is None:
+        size = comm.size if comm is not None else jax.process_count()
+    n_sub = int(math.ceil(len(dataset) / size))
+    return int(math.ceil(n_sub / local_batch_size))
+
+
+def get_epoch_trigger(n_epochs, dataset, local_batch_size, comm=None,
+                      size=None):
+    """(n_iterations, 'iteration') trigger tuple (reference
+    ``dataset.py:77-100``)."""
+    n_iter = get_n_iterations_for_one_epoch(
+        dataset, local_batch_size, comm, size)
+    return (n_epochs * n_iter, 'iteration')

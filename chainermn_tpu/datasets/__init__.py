@@ -1,0 +1,1 @@
+from chainermn_tpu.datasets.empty_dataset import create_empty_dataset  # noqa

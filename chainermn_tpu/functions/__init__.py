@@ -1,0 +1,3 @@
+from chainermn_tpu.functions.point_to_point_communication import (  # noqa
+    send, recv)
+from chainermn_tpu.functions.pseudo_connect import pseudo_connect  # noqa

@@ -1,0 +1,59 @@
+"""Differentiable point-to-point communication.
+
+Rebuild of ``chainermn/functions/point_to_point_communication.py``.
+The reference wraps eager MPI send/recv in ``chainer.Function``s whose
+backwards run the opposite transfer (``Send.backward = recv`` at
+``:23-33``, ``Recv.backward = send`` at ``:76-81``), plus a "delegate
+variable" hack to keep the autograd graph connected.
+
+The TPU-native primitive is ``lax.ppermute`` inside an SPMD
+(``shard_map``) region: its transpose *is* the reverse permutation, so
+JAX autodiff reproduces the reference's backward pairing with no
+delegate machinery.  ``send``/``recv`` here are thin, symmetric views
+of one collective-permute: every device participates; a device that is
+not a declared destination receives (and should ignore) zeros.
+"""
+
+from jax import lax
+
+from chainermn_tpu.communicators.mesh_utility import AXIS_INTRA
+
+
+def send(x, comm=None, rank=None, src=None, axis=AXIS_INTRA, perm=None):
+    """Ship ``x`` from device ``src`` to device ``rank``; differentiable.
+
+    Parity with ``chainermn.functions.send(x, comm, rank)``
+    (``point_to_point_communication.py:84-116``).  The reference infers
+    the source from the calling process; in SPMD form the program is
+    identical on every device, so the pair must be explicit: either
+    ``(src, rank)`` or a full ``perm`` schedule of disjoint pairs.
+    Returns what *this* device received under the permutation (zeros
+    when it is not a destination) -- the reference's separate delegate
+    return value is unnecessary because the data dependency itself
+    keeps the graph alive, and the transpose rule of ``ppermute``
+    reproduces ``Send.backward = recv`` (reference ``:23-33``) exactly.
+    """
+    if perm is None:
+        if rank is None or src is None:
+            raise ValueError('provide (src, rank) or an explicit perm')
+        perm = [(src, rank)]
+    return lax.ppermute(x, axis, perm)
+
+
+def recv(comm=None, rank=None, dst=None, axis=AXIS_INTRA, x=None, perm=None):
+    """Receive on device ``dst`` from device ``rank``; mirror of
+    :func:`send`.
+
+    Parity with ``chainermn.functions.recv`` (``:119-150``).  ``x`` is
+    each device's contribution template (``zeros_like`` of the
+    transported value) since every SPMD participant supplies an
+    operand; the value received on non-destination devices is zero.
+    """
+    if x is None:
+        raise ValueError('recv needs a template operand x (zeros_like of '
+                         'the transported value)')
+    if perm is None:
+        if rank is None or dst is None:
+            raise ValueError('provide (rank, dst) or an explicit perm')
+        perm = [(rank, dst)]
+    return lax.ppermute(x, axis, perm)

@@ -1,0 +1,35 @@
+"""Graph-splicing no-op.
+
+Rebuild of ``chainermn/functions/pseudo_connect.py``.  The reference
+needs ``PseudoConnect`` because Chainer's eager backward only walks
+connected graphs: it forwards actual variables unchanged while carrying
+a "delegate variable" whose gradient is zero (``pseudo_connect.py:6-24``),
+forcing cross-process send/recv pairs to be visited in order.
+
+Under JAX tracing every dependency is explicit, so the operational
+content reduces to "make ``actual`` depend on ``delegate`` without
+changing its value".  We keep it as a real primitive-level identity
+(zero-weighted add) so schedules that rely on ordering edges -- e.g.
+forcing a collective to complete before a stage runs -- can still
+express them, exactly the role the reference assigns it.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def pseudo_connect(delegate_variable, *actual_variables):
+    """Tie ``actual_variables`` to ``delegate_variable``'s completion.
+
+    Gradient semantics match the reference: actuals get passthrough
+    gradients, the delegate gets zeros (``pseudo_connect.py:14-24``).
+    """
+    if delegate_variable is None:
+        return (actual_variables[0] if len(actual_variables) == 1
+                else actual_variables)
+    anchor = jnp.zeros((), dtype=jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(delegate_variable):
+        anchor = anchor + jax.lax.stop_gradient(
+            jnp.asarray(leaf, jnp.float32).ravel()[:1].sum()) * 0.0
+    out = tuple(x + anchor.astype(x.dtype) for x in actual_variables)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,124 @@
+"""Model-parallel stage container.
+
+Rebuild of ``chainermn/link.py`` (``MultiNodeChainList``).  The
+reference is an SPMD object: every process holds *its* sublinks plus
+routing metadata ``(rank_in, rank_out)``, and forward interleaves
+``recv -> compute -> send`` with delegate variables and pseudo-connect
+glue so Chainer's eager backward visits cross-process edges in order
+(``link.py:136-213``).
+
+Single-controller JAX removes the whole delegate-variable apparatus:
+the global stage DAG is visible to the tracer, autodiff reverses it for
+free (the reference's ``Send.backward = recv`` pairing,
+``point_to_point_communication.py:23-33``, is just the transpose rule
+of a data dependency), and cross-stage transfers become device
+placement the compiler schedules.  What this class keeps from the
+reference is the *routing semantics*: stages declared in order, each
+with a home rank, ``rank_in`` sources and ``rank_out`` destinations,
+including cycles, crossings and one-to-many branches (the topologies of
+reference ``tests/test_link.py:31-101``).
+
+Stage-to-device placement is expressed with device placement over
+``comm.mesh`` when ``place=True``; XLA inserts the transfers.  This
+container is the arbitrary-topology parity surface; throughput-oriented
+pipeline parallelism with micro-batching lives in
+``chainermn_tpu.parallel``.
+"""
+
+import jax
+
+
+class MultiNodeChainList:
+    """A DAG of stages with reference-style rank routing.
+
+    Usage::
+
+        model = MultiNodeChainList(comm)
+        model.add_link(stage0_apply, rank_in=None, rank_out=1, rank=0)
+        model.add_link(stage1_apply, rank_in=0, rank_out=None, rank=1)
+        y = model(params_per_stage, x)   # inside or outside jit
+
+    ``add_link`` parity: reference ``link.py:111-134``; ``rank`` is the
+    stage's home device (defaults to declaration index), which the
+    reference encodes implicitly as "the process that constructed this
+    sublink".
+    """
+
+    def __init__(self, comm=None, place=False):
+        self._comm = comm
+        self._place = place and comm is not None
+        self._links = []
+
+    def add_link(self, link, rank_in=None, rank_out=None, rank=None):
+        """Register a stage.
+
+        ``link``: a callable ``link(params, *inputs) -> output`` (or
+        ``link(*inputs)`` if it is parameterless / closes over params).
+        ``rank_in``: None (reads global inputs), an int, or list of
+        ints -- home ranks of producer stages, consumed in order.
+        ``rank_out``: None (contributes to global outputs), an int, or
+        list of ints -- home ranks of consumer stages.
+        """
+        if rank is None:
+            rank = len(self._links)
+        if rank_in is not None and not isinstance(rank_in, (list, tuple)):
+            rank_in = [rank_in]
+        if rank_out is not None and not isinstance(rank_out, (list, tuple)):
+            rank_out = [rank_out]
+        self._links.append((link, rank, rank_in, rank_out))
+        return self
+
+    def __len__(self):
+        return len(self._links)
+
+    def _pin(self, x, rank):
+        if not self._place:
+            return x
+        dev = self._comm.mesh.devices.flat[rank % self._comm.size]
+        return jax.device_put(x, dev)
+
+    def __call__(self, params, *inputs):
+        """Run the stage DAG.
+
+        ``params`` is a list/tuple with one entry per registered stage
+        (use ``None`` for parameterless stages).  Messages between
+        stages form FIFO queues keyed (src_rank, dst_rank), matching
+        the reference's tagged point-to-point channels
+        (``point_to_point_communication.py:84-150``).
+        """
+        if params is None:
+            params = [None] * len(self._links)
+        if len(params) != len(self._links):
+            raise ValueError('expected %d per-stage param entries, got %d'
+                             % (len(self._links), len(params)))
+        queues = {}
+        outputs = []
+        for (link, rank, rank_in, rank_out), p in zip(self._links, params):
+            if rank_in is None:
+                xs = tuple(inputs)
+            else:
+                xs = []
+                for src in rank_in:
+                    q = queues.get((src, rank))
+                    if not q:
+                        raise RuntimeError(
+                            'stage at rank %d expects input from rank %d '
+                            'but none was sent; check rank_in/rank_out '
+                            'declaration order' % (rank, src))
+                    xs.append(q.pop(0))
+                xs = tuple(xs)
+            xs = tuple(self._pin(x, rank) for x in xs)
+            y = link(p, *xs) if p is not None else link(*xs)
+            if rank_out is None:
+                outputs.append(y)
+            else:
+                for dst in rank_out:
+                    queues.setdefault((rank, dst), []).append(
+                        self._pin(y, dst))
+        leftovers = {k: len(v) for k, v in queues.items() if v}
+        if leftovers:
+            raise RuntimeError(
+                'unconsumed inter-stage messages: %r' % leftovers)
+        if not outputs:
+            return None
+        return outputs[0] if len(outputs) == 1 else tuple(outputs)

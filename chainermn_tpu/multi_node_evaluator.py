@@ -1,0 +1,46 @@
+"""Multi-node evaluator wrapper.
+
+Rebuild of ``chainermn/multi_node_evaluator.py``: the reference runs the
+wrapped evaluator on the local dataset shard then averages every
+reported scalar across ranks with a pickle-based MPI allreduce, keys
+sorted for determinism (``:31-38``).
+
+Ours wraps any object (or callable) producing a metric dict.  When the
+metrics were computed on a per-process data shard, they are averaged
+across processes; metrics computed in-graph over a mesh-sharded batch
+are already global, and the wrapper is transparent for them.
+"""
+
+
+def create_multi_node_evaluator(actual_evaluator, communicator):
+    """Parity with ``chainermn.create_multi_node_evaluator(ev, comm)``.
+
+    ``actual_evaluator`` is either a callable returning a metric dict or
+    an object with ``.evaluate()``.  Returns an object of the same call
+    style whose results are cross-process means, averaged key-by-key in
+    sorted order like the reference (``multi_node_evaluator.py:33-37``).
+    """
+
+    def _reduce(local_dict):
+        out = {}
+        for key in sorted(local_dict):
+            out[key] = communicator.allreduce_obj(local_dict[key], op='mean')
+        return out
+
+    class Wrapper:
+        def __init__(self):
+            self.actual_evaluator = actual_evaluator
+            self.communicator = communicator
+
+        def __getattr__(self, name):
+            return getattr(self.actual_evaluator, name)
+
+        def evaluate(self, *args, **kwargs):
+            ev = self.actual_evaluator
+            local = (ev.evaluate(*args, **kwargs)
+                     if hasattr(ev, 'evaluate') else ev(*args, **kwargs))
+            return _reduce(local)
+
+        __call__ = evaluate
+
+    return Wrapper()

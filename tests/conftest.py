@@ -1,0 +1,26 @@
+"""Test harness: 8 virtual CPU devices.
+
+The reference exercises real multi-process behavior by running the whole
+suite under ``mpiexec -n {1,2,3}`` on one CPU host (``.travis.yml:55``).
+The TPU-native analogue is XLA's forced host-platform device count: one
+process, 8 virtual CPU devices, real mesh/collective code paths.
+"""
+
+import os
+
+# Force CPU regardless of ambient JAX_PLATFORMS (the dev box pins the
+# real TPU platform in the environment); tests want the virtual mesh.
+# NOTE: the interpreter's sitecustomize pre-imports jax, so env vars
+# alone are too late -- set the config knobs directly (backends are
+# created lazily, so this still takes effect).
+_platform = os.environ.get('CHAINERMN_TPU_TEST_PLATFORM', 'cpu')
+os.environ['JAX_PLATFORMS'] = _platform
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', _platform)
+jax.config.update('jax_default_matmul_precision', 'highest')

@@ -1,0 +1,64 @@
+"""Dataset scatter tests (port of reference ``tests/test_dataset.py``:
+shard sizes equal +-1, union == original, incl. empty / size-1 /
+non-divisible datasets)."""
+
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.dataset import scatter_index
+
+
+@pytest.mark.parametrize('n', [0, 1, 7, 8, 23, 100, 103])
+@pytest.mark.parametrize('size', [1, 2, 3, 4, 8])
+def test_scatter_partition(n, size):
+    ds = list(range(n))
+    shards = [chainermn_tpu.scatter_dataset(ds, size=size, rank=r)
+              for r in range(size)]
+    sizes = [len(s) for s in shards]
+    # cover exactly, sizes within 1 of each other
+    assert sum(sizes) == n
+    assert max(sizes) - min(sizes) <= 1
+    union = []
+    for s in shards:
+        union.extend(s[i] for i in range(len(s)))
+    assert sorted(union) == ds
+    # no empty shard while there is enough data
+    if n >= size:
+        assert min(sizes) >= 1
+
+
+def test_scatter_index_contiguous():
+    size = 5
+    prev_end = 0
+    for r in range(size):
+        start, end = scatter_index(23, size, r)
+        assert start == prev_end
+        prev_end = end
+    assert prev_end == 23
+
+
+def test_scatter_shuffle_covers():
+    ds = list(range(50))
+    shards = [chainermn_tpu.scatter_dataset(ds, size=4, rank=r, shuffle=True,
+                                            seed=3)
+              for r in range(4)]
+    union = sorted(x for s in shards for x in s[0:len(s)])
+    assert union == ds
+
+
+def test_empty_dataset():
+    """Port of reference ``tests/datasets_tests/test_empty_dataset.py``."""
+    for n in [0, 1, 10]:
+        ds = chainermn_tpu.create_empty_dataset(list(range(n)))
+        assert len(ds) == n
+        assert all(item == () for item in ds)
+
+
+def test_epoch_helpers():
+    comm = chainermn_tpu.create_communicator('naive', mesh_shape=(2, 4))
+    ds = list(range(100))
+    n_iter = chainermn_tpu.dataset.get_n_iterations_for_one_epoch(
+        ds, 5, comm)
+    assert n_iter == 3  # ceil(ceil(100/8)/5)
+    assert chainermn_tpu.dataset.get_epoch_trigger(2, ds, 5, comm) == \
+        (6, 'iteration')
