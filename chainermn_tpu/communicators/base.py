@@ -67,9 +67,31 @@ class CommunicatorBase:
         """Driver-level rank: this *process*'s index.
 
         Inside a trace, per-device rank is :meth:`axis_rank`.  The
-        reference has one process per device so the two coincide there.
+        reference has one process per device so its ``rank``/``size``
+        form a pair; here they do NOT: ``rank`` counts processes while
+        ``size`` counts devices.  Pair ``rank`` with
+        :attr:`process_count` (e.g. for dataset sharding -- or better,
+        pass the communicator to ``scatter_dataset`` and let it do
+        this), and :meth:`axis_rank` with ``size``.
         """
         return jax.process_index()
+
+    @property
+    def process_count(self):
+        """Number of controller processes participating in the mesh."""
+        return len({d.process_index for d in self.mesh.devices.flat})
+
+    def process_rank_in_mesh(self):
+        """This process's index among the mesh's participating
+        processes; raises if this process owns none of the mesh's
+        devices."""
+        procs = sorted({d.process_index for d in self.mesh.devices.flat})
+        me = jax.process_index()
+        if me not in procs:
+            raise ValueError(
+                'process %d owns no devices of this mesh (processes: %r)'
+                % (me, procs))
+        return procs.index(me)
 
     # -- in-trace coordinates ------------------------------------------
     def intra_rank(self):
@@ -127,7 +149,7 @@ class CommunicatorBase:
 
         return jax.tree_util.tree_map(bcast, params)
 
-    def send_recv(self, x, perm, axis=AXIS_INTRA):
+    def send_recv(self, x, perm, axis=AXES):
         """Point-to-point: collective permute along one mesh axis.
 
         Parity: ``CommunicatorBase.send``/``recv`` (``_base.py:23-74``).
@@ -137,6 +159,11 @@ class CommunicatorBase:
         (reverse permutation) is exactly the reference's
         ``Send.backward = recv`` (``point_to_point_communication.py:23-33``)
         -- supplied automatically by JAX autodiff.
+
+        With the default ``axis`` (both mesh axes), ``perm`` pairs are
+        *global* device ranks (row-major over (inter, intra), i.e.
+        :meth:`axis_rank` values); pass a single axis name for
+        axis-local permutes.
         """
         return lax.ppermute(x, axis, perm)
 

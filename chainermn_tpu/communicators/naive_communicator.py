@@ -8,14 +8,10 @@ baseline/CPU-friendly strategy and the fusion-free control for
 benchmarking.
 """
 
-from jax import lax
-import jax
-
 from chainermn_tpu.communicators.base import CommunicatorBase
-from chainermn_tpu.communicators.mesh_utility import AXES
 
 
 class NaiveCommunicator(CommunicatorBase):
 
     def _allreduce_impl(self, grads):
-        return jax.tree_util.tree_map(lambda g: lax.pmean(g, AXES), grads)
+        return self.allreduce(grads, op='mean')

@@ -53,16 +53,19 @@ def scatter_dataset(dataset, comm=None, size=None, rank=None, shuffle=False,
     """Return this process's shard of ``dataset``.
 
     Parity with ``chainermn.scatter_dataset(dataset, comm)``
-    (``dataset.py:5-43``).  ``size``/``rank`` default to the JAX process
-    topology (data loading is per-process; per-device sharding of each
-    batch is the updater's job).  ``shuffle`` adds a seeded global
-    permutation -- an extension the reference lacks.
+    (``dataset.py:5-43``).  ``size``/``rank`` default to the
+    communicator's *process* topology (data loading is per-process;
+    per-device sharding of each batch is the updater's job) -- or the
+    global JAX process topology when no ``comm`` is given.  ``shuffle``
+    adds a seeded global permutation, an extension the reference lacks.
     """
     import jax
     if size is None:
-        size = jax.process_count()
+        size = comm.process_count if comm is not None \
+            else jax.process_count()
     if rank is None:
-        rank = jax.process_index()
+        rank = comm.process_rank_in_mesh() if comm is not None \
+            else jax.process_index()
     if not 0 <= rank < size:
         raise ValueError('rank %d out of range for size %d' % (rank, size))
     if shuffle:

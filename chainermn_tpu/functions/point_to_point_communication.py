@@ -16,10 +16,10 @@ not a declared destination receives (and should ignore) zeros.
 
 from jax import lax
 
-from chainermn_tpu.communicators.mesh_utility import AXIS_INTRA
+from chainermn_tpu.communicators.mesh_utility import AXES
 
 
-def send(x, comm=None, rank=None, src=None, axis=AXIS_INTRA, perm=None):
+def send(x, comm=None, rank=None, src=None, axis=AXES, perm=None):
     """Ship ``x`` from device ``src`` to device ``rank``; differentiable.
 
     Parity with ``chainermn.functions.send(x, comm, rank)``
@@ -27,6 +27,9 @@ def send(x, comm=None, rank=None, src=None, axis=AXIS_INTRA, perm=None):
     the source from the calling process; in SPMD form the program is
     identical on every device, so the pair must be explicit: either
     ``(src, rank)`` or a full ``perm`` schedule of disjoint pairs.
+    Ranks are *global* device ranks (``comm.axis_rank()`` numbering)
+    under the default ``axis`` (both mesh axes); pass one axis name for
+    axis-local numbering.
     Returns what *this* device received under the permutation (zeros
     when it is not a destination) -- the reference's separate delegate
     return value is unnecessary because the data dependency itself
@@ -40,7 +43,7 @@ def send(x, comm=None, rank=None, src=None, axis=AXIS_INTRA, perm=None):
     return lax.ppermute(x, axis, perm)
 
 
-def recv(comm=None, rank=None, dst=None, axis=AXIS_INTRA, x=None, perm=None):
+def recv(comm=None, rank=None, dst=None, axis=AXES, x=None, perm=None):
     """Receive on device ``dst`` from device ``rank``; mirror of
     :func:`send`.
 

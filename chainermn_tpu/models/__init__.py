@@ -1,0 +1,13 @@
+"""Model zoo.
+
+The reference delegates models to Chainer plus an ImageNet zoo under
+``examples/imagenet/models_v2/`` (alex, googlenet, googlenetbn, nin,
+resnet50) and MLPs in the MNIST examples.  ChainerMN-TPU is standalone,
+so the zoo lives in the package: flax.linen modules, NHWC layouts,
+bfloat16-friendly, reported metrics matching the reference's
+``chainer.report({'loss','accuracy'})`` convention via classifier
+loss functions.
+"""
+
+from chainermn_tpu.models.mlp import MLP  # noqa
+from chainermn_tpu.models.classifier import Classifier, classifier_loss  # noqa

@@ -1,0 +1,54 @@
+"""Classifier wrapper: softmax cross-entropy + accuracy.
+
+The reference models report ``loss``/``accuracy`` through
+``chainer.report`` (e.g. ``models_v2/resnet50.py:106-108``,
+``L.Classifier`` at ``train_mnist.py:54``).  Ours is functional: wrap a
+model apply function into ``loss_fn(params, x, y) -> (loss, metrics)``
+consumable by the updater/evaluator.
+"""
+
+import jax.numpy as jnp
+import optax
+
+
+def classifier_loss(apply_fn, label_smoothing=0.0):
+    """``loss_fn(params, x, y) -> (loss, {'accuracy': ...})``."""
+
+    def loss_fn(params, x, y, train=True):
+        logits = apply_fn(params, x)
+        if isinstance(logits, tuple):  # models returning (logits, aux)
+            logits = logits[0]
+        if label_smoothing:
+            n = logits.shape[-1]
+            onehot = optax.smooth_labels(
+                jnp.eye(n, dtype=logits.dtype)[y], label_smoothing)
+            loss = optax.softmax_cross_entropy(logits, onehot).mean()
+        else:
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, {'accuracy': acc}
+
+    return loss_fn
+
+
+class Classifier:
+    """Object flavor for symmetry with ``L.Classifier``; callable as a
+    loss function."""
+
+    def __init__(self, apply_fn, label_smoothing=0.0):
+        self.apply_fn = apply_fn
+        self._loss = classifier_loss(apply_fn, label_smoothing)
+
+    def __call__(self, params, x, y):
+        return self._loss(params, x, y)
+
+    def eval_metrics(self, params, x, y):
+        """Per-example metrics for the masked evaluator: returns arrays
+        of shape (batch,)."""
+        logits = self.apply_fn(params, x)
+        if isinstance(logits, tuple):
+            logits = logits[0]
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        acc = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        return {'loss': loss, 'accuracy': acc}

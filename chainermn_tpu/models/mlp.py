@@ -1,0 +1,17 @@
+"""Three-layer MLP (the reference MNIST model,
+``examples/mnist/train_mnist.py:20-31``: 784 -> units -> units -> 10
+with ReLU)."""
+
+import flax.linen as nn
+
+
+class MLP(nn.Module):
+    n_units: int = 100
+    n_out: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        x = nn.relu(nn.Dense(self.n_units)(x))
+        return nn.Dense(self.n_out)(x)

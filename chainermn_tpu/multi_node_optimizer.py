@@ -47,6 +47,12 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
             actual_state=actual_optimizer.init(params))
 
     def update(grads, state, params=None):
+        if params is None and broadcast_first:
+            raise ValueError(
+                'the multi-node optimizer requires params in update() '
+                '(the first call performs the initial weight broadcast, '
+                'reference multi_node_optimizer.py:23-26); pass '
+                'broadcast_first=False to opt out')
 
         def first_call(_):
             # Initial weight sync in place of a step (reference :23-26);

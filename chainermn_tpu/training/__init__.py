@@ -1,0 +1,17 @@
+"""Training loop machinery.
+
+The reference delegates its loop to Chainer's ``Trainer`` /
+``StandardUpdater`` / extensions (wired at
+``examples/mnist/train_mnist.py:96-121``).  ChainerMN-TPU is
+standalone, so it ships its own: the same surface (trainer, updater,
+iterators, extensions, triggers), built around one jitted
+``shard_map`` train step instead of an eager per-process loop.
+"""
+
+from chainermn_tpu.training.iterators import SerialIterator  # noqa
+from chainermn_tpu.training.trainer import Trainer  # noqa
+from chainermn_tpu.training.updater import StandardUpdater  # noqa
+from chainermn_tpu.training.evaluator import Evaluator  # noqa
+from chainermn_tpu.training import extensions  # noqa
+from chainermn_tpu.training import triggers  # noqa
+from chainermn_tpu.training.convert import concat_examples  # noqa

@@ -1,0 +1,46 @@
+"""Batch collation (the role of ``chainer.dataset.convert.concat_examples``
+in the reference examples, e.g. ``train_mnist.py:99``)."""
+
+import numpy as np
+
+
+def concat_examples(batch, padding=None):
+    """Stack a list of examples into batched arrays.
+
+    Examples may be tuples (``(x, y)`` -> ``(X, Y)``), dicts, or bare
+    arrays.  With ``padding=(pad_to, fill)`` the leading dimension is
+    padded to ``pad_to`` (for static-shape jit steps on final partial
+    batches) and a float32 validity ``mask`` of shape ``(pad_to,)`` is
+    appended to the result tuple.
+    """
+    if len(batch) == 0:
+        raise ValueError('batch is empty')
+    first = batch[0]
+    if isinstance(first, tuple):
+        cols = tuple(np.stack([np.asarray(b[i]) for b in batch])
+                     for i in range(len(first)))
+    elif isinstance(first, dict):
+        cols = {k: np.stack([np.asarray(b[k]) for b in batch])
+                for k in first}
+    else:
+        cols = (np.stack([np.asarray(b) for b in batch]),)
+    if padding is None:
+        return cols
+    pad_to, fill = padding
+    n = len(batch)
+    if pad_to < n:
+        raise ValueError('pad_to %d < batch size %d' % (pad_to, n))
+
+    def pad(a):
+        if pad_to == n:
+            return a
+        widths = [(0, pad_to - n)] + [(0, 0)] * (a.ndim - 1)
+        return np.pad(a, widths, constant_values=fill)
+
+    mask = np.zeros((pad_to,), np.float32)
+    mask[:n] = 1.0
+    if isinstance(cols, dict):
+        cols = {k: pad(v) for k, v in cols.items()}
+        cols['mask'] = mask
+        return cols
+    return tuple(pad(c) for c in cols) + (mask,)

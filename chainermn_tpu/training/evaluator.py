@@ -1,0 +1,100 @@
+"""Evaluation loop.
+
+Equivalent of ``chainer.training.extensions.Evaluator`` as used by the
+reference (``train_mnist.py:102-104``): iterate a validation set with a
+jitted metric function, mask-weighted so the final partial batch is
+exact, and return mean metrics.  Wrap with
+:func:`chainermn_tpu.create_multi_node_evaluator` for cross-process
+averaging parity (``multi_node_evaluator.py:31-38``).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from chainermn_tpu.training.convert import concat_examples
+
+
+class Evaluator:
+    """Args:
+      iterator: non-repeating iterator over the eval dataset.
+      eval_fn: ``eval_fn(params, *batch) -> metrics_dict`` of *sums* or
+        means over the batch?  Contract: per-example metric array of
+        shape ``(batch,)`` per key; masking and averaging are handled
+        here.
+      params_getter: callable returning current params (usually
+        ``lambda: updater.params``).
+    """
+
+    trigger = (1, 'epoch')
+    priority = 300
+    name = 'validation'
+
+    def __init__(self, iterator, eval_fn, params_getter, comm,
+                 prefix='validation/main/'):
+        self.iterator = iterator
+        self.eval_fn = eval_fn
+        self.params_getter = params_getter
+        self.comm = comm
+        self.prefix = prefix
+        self._jitted = None
+
+    def _build(self):
+        comm = self.comm
+        eval_fn = self.eval_fn
+
+        def step(params, mask, *batch):
+            metrics = eval_fn(params, *batch)
+            out = {}
+            for k, v in metrics.items():
+                v = jnp.asarray(v, jnp.float32)
+                if v.ndim == 0:  # scalar mean: weight by mask sum
+                    s = v * jnp.sum(mask)
+                else:
+                    s = jnp.sum(v * mask)
+                out[k] = (jax.lax.psum(s, ('inter', 'intra')),)
+            n = jax.lax.psum(jnp.sum(mask), ('inter', 'intra'))
+            return {k: v[0] for k, v in out.items()}, n
+
+        def call(params, mask, *batch):
+            fn = jax.shard_map(
+                step, mesh=comm.mesh,
+                in_specs=(P(),) + (comm.batch_spec(),) * (len(batch) + 1),
+                out_specs=(P(), P()), check_vma=False)
+            return fn(params, mask, *batch)
+
+        return jax.jit(call)
+
+    def evaluate(self, trainer=None):
+        if self._jitted is None:
+            self._jitted = self._build()
+        params = self.params_getter()
+        iterator = self.iterator
+        if hasattr(iterator, 'reset'):
+            iterator.reset()
+        sums = {}
+        count = 0.0
+        batch_size = getattr(iterator, 'batch_size', None)
+        for batch in iterator:
+            pad_to = batch_size or len(batch)
+            pad_to = -(-pad_to // self.comm.size) * self.comm.size
+            arrays = concat_examples(batch, padding=(pad_to, 0))
+            if isinstance(arrays, dict):
+                mask = arrays.pop('mask')
+                arrays = tuple(arrays.values())
+            else:
+                mask = arrays[-1]
+                arrays = arrays[:-1]
+            mask, arrays = self.comm.shard_batch(mask), \
+                self.comm.shard_batch(arrays)
+            metrics, n = self._jitted(params, mask, *arrays)
+            count += float(n)
+            for k, v in metrics.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+        if count == 0:
+            return {}
+        return {self.prefix + k: v / count for k, v in sums.items()}
+
+    def __call__(self, trainer=None):
+        return self.evaluate()

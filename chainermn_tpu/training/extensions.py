@@ -1,0 +1,140 @@
+"""Trainer extensions.
+
+Standalone equivalents of the Chainer extensions the reference examples
+register: ``LogReport``/``PrintReport`` (``train_mnist.py:107-115``,
+rank-0-gated), ``snapshot`` (``train_mnist.py:117-118`` via
+``--resume``), and the evaluator lives in
+:mod:`chainermn_tpu.training.evaluator`.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+class LogReport:
+    """Accumulate observations and append JSON lines to ``out/log``.
+
+    Gate to one process with ``rank0_only`` (the reference gates by
+    ``comm.rank == 0`` at ``train_mnist.py:107``).
+    """
+
+    trigger = (1, 'epoch')
+    priority = 200
+    name = 'log_report'
+
+    def __init__(self, keys=None, trigger=(1, 'epoch'), filename='log',
+                 rank0_only=True):
+        self.keys = keys
+        self.trigger = trigger
+        self.filename = filename
+        self.rank0_only = rank0_only
+        self.log = []
+        self._accum = {}
+        self._n = 0
+        self._start = time.time()
+
+    def accumulate(self, observation):
+        for k, v in observation.items():
+            if isinstance(v, (int, float)):
+                self._accum[k] = self._accum.get(k, 0.0) + v
+        self._n += 1
+
+    def __call__(self, trainer):
+        self.accumulate(trainer.observation)
+        entry = {k: v / self._n for k, v in self._accum.items()}
+        entry.update(epoch=trainer.updater.epoch,
+                     iteration=trainer.updater.iteration,
+                     elapsed_time=trainer.elapsed_time)
+        self.log.append(entry)
+        self._accum, self._n = {}, 0
+        import jax
+        if not self.rank0_only or jax.process_index() == 0:
+            if trainer.out:
+                with open(os.path.join(trainer.out, self.filename), 'w') as f:
+                    json.dump(self.log, f, indent=1)
+        return entry
+
+
+class PrintReport:
+    """Print selected observation keys as a table row (reference
+    registers it at ``train_mnist.py:108-111``)."""
+
+    trigger = (1, 'epoch')
+    priority = 100
+    name = 'print_report'
+
+    def __init__(self, entries, rank0_only=True, out=sys.stdout):
+        self.entries = entries
+        self.rank0_only = rank0_only
+        self._out = out
+        self._header_done = False
+
+    def __call__(self, trainer):
+        import jax
+        if self.rank0_only and jax.process_index() != 0:
+            return
+        if not self._header_done:
+            self._out.write(''.join('%-16s' % e for e in self.entries)
+                            + '\n')
+            self._header_done = True
+        obs = dict(trainer.observation,
+                   epoch=trainer.updater.epoch,
+                   iteration=trainer.updater.iteration,
+                   elapsed_time=trainer.elapsed_time)
+        row = []
+        for e in self.entries:
+            v = obs.get(e, '')
+            row.append('%-16s' % (('%.6g' % v) if isinstance(
+                v, (int, float)) else v))
+        self._out.write(''.join(row) + '\n')
+        self._out.flush()
+
+
+def snapshot(filename='snapshot_iter_{iteration}', rank0_only=True):
+    """Checkpoint trainer state (params + optimizer state + counters).
+
+    The reference delegates to ``chainer.serializers`` npz snapshots
+    (``train_mnist.py:117-118``); ours go through
+    :mod:`chainermn_tpu.serializers` (npz for host-size state, see
+    there for the sharded/orbax path).
+    """
+
+    def ext(trainer):
+        import jax
+        if rank0_only and jax.process_index() != 0:
+            return
+        from chainermn_tpu import serializers
+        u = trainer.updater
+        path = os.path.join(
+            trainer.out, filename.format(iteration=u.iteration))
+        serializers.save_npz(path, {
+            'params': u.params,
+            'opt_state': u.opt_state,
+            'iteration': u.iteration,
+            'epoch': u.epoch,
+        })
+    ext.trigger = (1, 'epoch')
+    ext.priority = 50
+    ext.name = 'snapshot'
+    return ext
+
+
+class ProgressBar:
+    """Minimal stderr progress line (parity placeholder for Chainer's
+    ProgressBar used at ``train_mnist.py:115``)."""
+
+    trigger = (1, 'iteration')
+    priority = 10
+    name = 'progress'
+
+    def __init__(self, update_interval=100):
+        self.update_interval = update_interval
+
+    def __call__(self, trainer):
+        u = trainer.updater
+        if u.iteration % self.update_interval:
+            return
+        sys.stderr.write('\riter %d epoch %d' % (u.iteration, u.epoch))
+        sys.stderr.flush()

@@ -1,0 +1,61 @@
+"""Trainer: the outer loop.
+
+Standalone equivalent of the Chainer ``Trainer`` the reference wires up
+in its examples (``train_mnist.py:99-121``): run the updater until a
+stop trigger, firing extensions (evaluation, logging, snapshots) on
+their own triggers, with observations flowing through a per-iteration
+dict instead of Chainer's global reporter.
+"""
+
+import os
+import time
+
+from chainermn_tpu.training import triggers as triggers_mod
+
+
+class _ExtensionEntry:
+    def __init__(self, extension, trigger, name, priority):
+        self.extension = extension
+        self.trigger = triggers_mod.get_trigger(trigger)
+        self.name = name
+        self.priority = priority
+
+
+class Trainer:
+
+    def __init__(self, updater, stop_trigger=(1, 'epoch'), out='result'):
+        self.updater = updater
+        self.stop_trigger = triggers_mod.get_trigger(stop_trigger)
+        self.out = out
+        self.observation = {}
+        self._extensions = []
+        self._done = False
+        self.elapsed_time = 0.0
+
+    def extend(self, extension, trigger=None, name=None, priority=None):
+        if trigger is None:
+            trigger = getattr(extension, 'trigger', (1, 'epoch'))
+        if priority is None:
+            priority = getattr(extension, 'priority', 100)
+        if name is None:
+            name = getattr(extension, 'name', None) or getattr(
+                extension, '__name__', type(extension).__name__)
+        self._extensions.append(
+            _ExtensionEntry(extension, trigger, name, priority))
+        return self
+
+    def run(self):
+        if self.out and not os.path.isdir(self.out):
+            os.makedirs(self.out, exist_ok=True)
+        start = time.time()
+        stop = self.stop_trigger
+        while not stop(self):
+            self.observation = self.updater.update()
+            self.elapsed_time = time.time() - start
+            for entry in sorted(self._extensions,
+                                key=lambda e: -e.priority):
+                if entry.trigger(self):
+                    result = entry.extension(self)
+                    if isinstance(result, dict):
+                        self.observation.update(result)
+        self._done = True

@@ -1,0 +1,31 @@
+"""Trigger objects deciding when extensions fire (Chainer-trainer
+surface the reference relies on, e.g. ``train_mnist.py:100,112``)."""
+
+
+class IntervalTrigger:
+    """Fires every ``period`` epochs or iterations."""
+
+    def __init__(self, period, unit):
+        if unit not in ('epoch', 'iteration'):
+            raise ValueError("unit must be 'epoch' or 'iteration'")
+        self.period = period
+        self.unit = unit
+        self._last_epoch = 0
+
+    def __call__(self, trainer):
+        u = trainer.updater
+        if self.unit == 'iteration':
+            return u.iteration % self.period == 0
+        if u.is_new_epoch and u.epoch % self.period == 0:
+            return True
+        return False
+
+
+def get_trigger(trigger):
+    """Normalize ``(n, 'epoch'|'iteration')`` tuples to a trigger."""
+    if trigger is None:
+        return lambda trainer: False
+    if callable(trigger):
+        return trigger
+    period, unit = trigger
+    return IntervalTrigger(period, unit)

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Data-parallel MNIST training.
+
+TPU-native rebuild of the reference demo
+(``examples/mnist/train_mnist.py``): same flags, same structure --
+communicator, multi-node optimizer, scattered dataset, trainer with
+evaluator/logging gated to rank 0 -- but launched as plain
+``python train_mnist.py`` on a TPU slice (the JAX runtime replaces the
+``mpiexec`` launcher; BASELINE.json north_star).
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+import chainermn_tpu  # noqa: E402
+from chainermn_tpu.datasets import mnist
+from chainermn_tpu.models import MLP, Classifier
+from chainermn_tpu import training
+from chainermn_tpu.training import extensions
+
+
+def main():
+    parser = argparse.ArgumentParser(description='ChainerMN-TPU MNIST')
+    parser.add_argument('--batchsize', '-b', type=int, default=100,
+                        help='global minibatch size')
+    parser.add_argument('--communicator', type=str, default='xla',
+                        help='communicator strategy name')
+    parser.add_argument('--epoch', '-e', type=int, default=20)
+    parser.add_argument('--unit', '-u', type=int, default=1000)
+    parser.add_argument('--out', '-o', default='result')
+    parser.add_argument('--resume', '-r', default='',
+                        help='resume from a snapshot (.npz)')
+    parser.add_argument('--cpu', action='store_true',
+                        help='force the virtual CPU mesh (testing)')
+    parser.add_argument('--mesh', type=str, default=None,
+                        help='override mesh shape, e.g. 2x4')
+    parser.add_argument('--quick', action='store_true',
+                        help='tiny run for smoke testing')
+    args = parser.parse_args()
+
+    if args.cpu:
+        # virtual 8-device mesh; must precede first backend use
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=8').strip()
+        jax.config.update('jax_platforms', 'cpu')
+
+    mesh_shape = None
+    if args.mesh:
+        mesh_shape = tuple(int(v) for v in args.mesh.split('x'))
+
+    comm = chainermn_tpu.create_communicator(args.communicator,
+                                             mesh_shape=mesh_shape)
+    if comm.rank == 0:
+        print('==========================================')
+        print('Num devices: {}'.format(comm.size))
+        print('Mesh: inter={} intra={}'.format(comm.inter_size,
+                                               comm.intra_size))
+        print('Using {} communicator'.format(args.communicator))
+        print('Num unit: {}'.format(args.unit))
+        print('Global mini-batch size: {}'.format(args.batchsize))
+        print('Num epoch: {}'.format(args.epoch))
+        print('==========================================')
+
+    model = MLP(n_units=args.unit, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))
+    clf = Classifier(model.apply)
+
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+
+    train, test = mnist.get_mnist()
+    # each process loads its shard; per-device sharding happens per batch
+    train = chainermn_tpu.scatter_dataset(train, comm)
+    test = chainermn_tpu.scatter_dataset(test, comm)
+
+    if args.quick:
+        train = chainermn_tpu.dataset.SubDataset(
+            train, 0, min(500, len(train)))
+        args.epoch = min(args.epoch, 2)
+
+    train_iter = training.SerialIterator(train, args.batchsize)
+    test_iter = training.SerialIterator(test, args.batchsize,
+                                        repeat=False, shuffle=False)
+
+    updater = training.StandardUpdater(
+        train_iter, optimizer, clf, params, comm, has_aux=True)
+    trainer = training.Trainer(updater, (args.epoch, 'epoch'),
+                               out=args.out)
+
+    evaluator = training.Evaluator(
+        test_iter, clf.eval_metrics, lambda: updater.params, comm)
+    evaluator = chainermn_tpu.create_multi_node_evaluator(evaluator, comm)
+    trainer.extend(evaluator, trigger=(1, 'epoch'))
+
+    if comm.rank == 0:
+        trainer.extend(extensions.snapshot(), trigger=(1, 'epoch'))
+        trainer.extend(extensions.LogReport(), trigger=(1, 'epoch'))
+        trainer.extend(extensions.PrintReport(
+            ['epoch', 'loss', 'accuracy', 'validation/main/loss',
+             'validation/main/accuracy', 'elapsed_time']),
+            trigger=(1, 'epoch'))
+
+    if args.resume:
+        from chainermn_tpu import serializers
+        state = serializers.load_npz(args.resume, {
+            'params': updater.params, 'opt_state': updater.opt_state,
+            'iteration': 0, 'epoch': 0})
+        updater.params = comm.replicate(state['params'])
+        updater.opt_state = comm.replicate(state['opt_state'])
+
+    trainer.run()
+    if comm.rank == 0:
+        print('final observation:', {
+            k: v for k, v in trainer.observation.items()})
+    return trainer
+
+
+if __name__ == '__main__':
+    main()

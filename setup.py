@@ -1,0 +1,21 @@
+from setuptools import find_packages, setup
+
+setup(
+    name='chainermn-tpu',
+    version='0.1.0',
+    description='TPU-native distributed deep learning framework '
+                '(ChainerMN capability surface, rebuilt on JAX/XLA)',
+    packages=find_packages(include=['chainermn_tpu*']),
+    install_requires=[
+        'jax',
+        'flax',
+        'optax',
+        'numpy',
+    ],
+    extras_require={
+        'checkpoint': ['orbax-checkpoint'],
+        'test': ['pytest'],
+    },
+    python_requires='>=3.9',
+    license='MIT',
+)

@@ -1,0 +1,101 @@
+"""functions.send/recv/pseudo_connect tests (reference
+``tests/functions_tests/``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu import functions
+from chainermn_tpu.communicators.mesh_utility import AXES
+
+
+@pytest.mark.parametrize('mesh_shape', [(1, 8), (2, 4)])
+def test_send_global_ranks(mesh_shape):
+    """send uses global device ranks on any mesh shape (a (2,4) mesh
+    must route 0->5 across rows, not replicate per row)."""
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=mesh_shape)
+
+    def f():
+        x = jnp.full((1,), comm.axis_rank(), jnp.float32)
+        return functions.send(x, comm, rank=5, src=4)
+
+    y = jax.jit(jax.shard_map(f, mesh=comm.mesh, in_specs=(),
+                              out_specs=P(AXES), check_vma=False))()
+    got = np.asarray(y)
+    expected = np.zeros(8)
+    expected[5] = 4.0
+    np.testing.assert_array_equal(got, expected)
+
+
+def test_send_backward_is_recv():
+    """The gradient of send(x, src->dst) w.r.t. x flows back dst->src
+    (reference Send.backward = recv,
+    point_to_point_communication.py:23-33)."""
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(1, 8))
+
+    def f():
+        def local(x):
+            y = functions.send(x, comm, rank=3, src=1)
+            # loss counts only what device 3 received
+            mask = (comm.axis_rank() == 3).astype(jnp.float32)
+            return jnp.sum(y * mask) * 2.0
+
+        x = jnp.ones((2,), jnp.float32)
+        return jax.grad(local)(x)
+
+    g = jax.jit(jax.shard_map(f, mesh=comm.mesh, in_specs=(),
+                              out_specs=P(AXES), check_vma=False))()
+    g = np.asarray(g).reshape(8, 2)
+    # only device 1 (the sender) has nonzero gradient, value 2.0
+    expected = np.zeros((8, 2))
+    expected[1] = 2.0
+    np.testing.assert_allclose(g, expected)
+
+
+def test_recv_mirror():
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(1, 8))
+
+    def f():
+        x = jnp.full((3,), comm.axis_rank(), jnp.float32)
+        return functions.recv(comm, rank=6, dst=2, x=x)
+
+    y = jax.jit(jax.shard_map(f, mesh=comm.mesh, in_specs=(),
+                              out_specs=P(AXES), check_vma=False))()
+    got = np.asarray(y).reshape(8, 3)[:, 0]
+    expected = np.zeros(8)
+    expected[2] = 6.0
+    np.testing.assert_array_equal(got, expected)
+
+
+@pytest.mark.parametrize('dtype', [jnp.float16, jnp.float32, jnp.float64])
+def test_pseudo_connect_identity_and_grads(dtype):
+    """Forward identity + gradient semantics (reference
+    tests/functions_tests/test_pseudo_connect.py: passthrough for
+    actuals, zeros for the delegate) across dtypes."""
+    delegate = jnp.ones((3,), dtype)
+    a = jnp.arange(4.0, dtype=dtype)
+    b = jnp.arange(6.0, dtype=dtype).reshape(2, 3)
+
+    out_a, out_b = functions.pseudo_connect(delegate, a, b)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(a))
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(b))
+
+    def loss(delegate, a, b):
+        oa, ob = functions.pseudo_connect(delegate, a, b)
+        return jnp.sum(oa.astype(jnp.float32) ** 2) + jnp.sum(
+            ob.astype(jnp.float32))
+
+    gd, ga, gb = jax.grad(loss, argnums=(0, 1, 2))(delegate, a, b)
+    np.testing.assert_allclose(np.asarray(gd), np.zeros((3,)))
+    np.testing.assert_allclose(np.asarray(ga),
+                               2 * np.arange(4.0, dtype=np.float32),
+                               rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(gb), np.ones((2, 3)))
+
+
+def test_pseudo_connect_none_delegate():
+    a = jnp.ones((2,))
+    assert functions.pseudo_connect(None, a) is a

@@ -1,0 +1,121 @@
+"""MultiNodeChainList topology tests.
+
+Port of reference ``tests/test_link.py`` (cycle, crossing, branching
+graphs, forward+backward) and the distributed-vs-local-replica
+equivalence of ``tests/functions_tests/test_point_to_point_communication.py:62-104``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import chainermn_tpu
+
+
+def _dense(key, n_in, n_out):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(key))
+    return {'w': jax.random.normal(k1, (n_in, n_out)) * 0.3,
+            'b': jax.random.normal(k2, (n_out,)) * 0.1}
+
+
+def _apply(p, x):
+    return jnp.tanh(x @ p['w'] + p['b'])
+
+
+@pytest.fixture
+def comm():
+    return chainermn_tpu.create_communicator('xla', mesh_shape=(1, 8))
+
+
+def test_chain_cycle(comm):
+    """Cycle topology (reference test_link.py Cycle model): rank0 ->
+    rank1 -> rank0."""
+    m = chainermn_tpu.MultiNodeChainList(comm)
+    m.add_link(_apply, rank_in=None, rank_out=1, rank=0)
+    m.add_link(_apply, rank_in=0, rank_out=0, rank=1)
+    m.add_link(_apply, rank_in=1, rank_out=None, rank=0)
+    params = [_dense(i, 6, 6) for i in range(3)]
+    x = jax.random.normal(jax.random.PRNGKey(9), (4, 6))
+
+    y = m(params, x)
+    expected = _apply(params[2], _apply(params[1], _apply(params[0], x)))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expected),
+                               rtol=1e-6)
+
+    # backward end-to-end
+    g = jax.grad(lambda ps: jnp.sum(m(ps, x) ** 2))(params)
+    g_ref = jax.grad(lambda ps: jnp.sum(
+        _apply(ps[2], _apply(ps[1], _apply(ps[0], x))) ** 2))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5)
+
+
+def test_chain_crossing(comm):
+    """Crossing topology (reference Cross0/Cross1): two chains exchange
+    activations mid-way."""
+    m = chainermn_tpu.MultiNodeChainList(comm)
+    m.add_link(_apply, rank_in=None, rank_out=1, rank=0)
+    m.add_link(_apply, rank_in=None, rank_out=0, rank=1)
+    m.add_link(_apply, rank_in=1, rank_out=None, rank=0)
+    m.add_link(_apply, rank_in=0, rank_out=None, rank=1)
+    params = [_dense(i, 5, 5) for i in range(4)]
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, 5))
+    y0, y1 = m(params, x)
+    np.testing.assert_allclose(
+        np.asarray(y0), np.asarray(_apply(params[2], _apply(params[1], x))),
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(y1), np.asarray(_apply(params[3], _apply(params[0], x))),
+        rtol=1e-6)
+
+
+def test_chain_branching(comm):
+    """Branching topology (reference BranchParent/BranchChild): one
+    parent feeds N children, parent consumes them in rank_in order."""
+    m = chainermn_tpu.MultiNodeChainList(comm)
+    m.add_link(_apply, rank_in=None, rank_out=[1, 2, 3], rank=0)
+    m.add_link(_apply, rank_in=0, rank_out=4, rank=1)
+    m.add_link(_apply, rank_in=0, rank_out=4, rank=2)
+    m.add_link(_apply, rank_in=0, rank_out=4, rank=3)
+    m.add_link(lambda p, a, b, c: _apply(p, a + b + c),
+               rank_in=[1, 2, 3], rank_out=None, rank=4)
+    params = [_dense(i, 4, 4) for i in range(5)]
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 4))
+    y = m(params, x)
+    h = _apply(params[0], x)
+    kids = [_apply(params[i], h) for i in (1, 2, 3)]
+    expected = _apply(params[4], kids[0] + kids[1] + kids[2])
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expected),
+                               rtol=1e-6)
+
+
+def test_chain_under_jit_with_placement(comm):
+    """The DAG works inside jit with device placement enabled."""
+    m = chainermn_tpu.MultiNodeChainList(comm, place=True)
+    m.add_link(_apply, rank_in=None, rank_out=1, rank=0)
+    m.add_link(_apply, rank_in=0, rank_out=None, rank=1)
+    params = [_dense(i, 4, 4) for i in range(2)]
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 4))
+    y = jax.jit(lambda ps, x: m(ps, x))(params, x)
+    expected = _apply(params[1], _apply(params[0], x))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(expected),
+                               rtol=1e-6)
+
+
+def test_unconsumed_message_raises(comm):
+    m = chainermn_tpu.MultiNodeChainList(comm)
+    m.add_link(_apply, rank_in=None, rank_out=1, rank=0)
+    m.add_link(_apply, rank_in=None, rank_out=None, rank=1)
+    params = [_dense(0, 3, 3), _dense(1, 3, 3)]
+    with pytest.raises(RuntimeError):
+        m(params, jnp.ones((2, 3)))
+
+
+def test_missing_input_raises(comm):
+    m = chainermn_tpu.MultiNodeChainList(comm)
+    m.add_link(_apply, rank_in=5, rank_out=None, rank=0)
+    with pytest.raises(RuntimeError):
+        m([_dense(0, 3, 3)], jnp.ones((2, 3)))

@@ -1,0 +1,55 @@
+"""End-to-end convergence test.
+
+Port of the reference gate (``tests/test_mnist.py:33-80`` /
+``.travis.yml:55``): full trainer run with the naive communicator must
+reach >= 0.95 validation accuracy within 5 epochs on the virtual
+multi-device mesh.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.datasets import mnist
+from chainermn_tpu.models import MLP, Classifier
+from chainermn_tpu import training
+
+
+@pytest.mark.parametrize('mesh_shape', [(1, 8), (2, 4)])
+def test_mnist_convergence(tmp_path, mesh_shape):
+    comm = chainermn_tpu.create_communicator('naive',
+                                             mesh_shape=mesh_shape)
+    model = MLP(n_units=100, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))
+    clf = Classifier(model.apply)
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+
+    train, test = mnist.get_mnist()
+    train_iter = training.SerialIterator(train, 104)
+    test_iter = training.SerialIterator(test, 104, repeat=False,
+                                        shuffle=False)
+    updater = training.StandardUpdater(
+        train_iter, optimizer, clf, params, comm, has_aux=True)
+    trainer = training.Trainer(updater, (5, 'epoch'), out=str(tmp_path))
+    evaluator = chainermn_tpu.create_multi_node_evaluator(
+        training.Evaluator(test_iter, clf.eval_metrics,
+                           lambda: updater.params, comm), comm)
+    trainer.extend(evaluator, trigger=(1, 'epoch'))
+    log = training.extensions.LogReport()
+    trainer.extend(log, trigger=(1, 'epoch'))
+    trainer.run()
+
+    acc = trainer.observation['validation/main/accuracy']
+    assert acc >= 0.95, 'validation accuracy %.4f < 0.95' % acc
+    assert trainer.updater.epoch == 5
+    assert len(log.log) == 5
+
+
+if __name__ == '__main__':
+    sys.exit(0 if test_mnist_convergence('result', (2, 4)) is None else 1)

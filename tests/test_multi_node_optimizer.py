@@ -1,0 +1,63 @@
+"""Multi-node optimizer semantics tests (reference
+``multi_node_optimizer.py:11-29``: first update broadcasts, later
+updates allreduce+step)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu
+from chainermn_tpu.communicators.mesh_utility import AXES
+
+
+def _run_steps(comm, broadcast_first=True, n_steps=3):
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(1.0), comm, broadcast_first=broadcast_first)
+
+    def steps():
+        r = comm.axis_rank().astype(jnp.float32)
+        # deliberately rank-divergent initial params
+        params = {'w': jnp.full((2,), r)}
+        state = opt.init(params)
+        history = []
+        for _ in range(n_steps):
+            grads = {'w': jnp.full((2,), r + 1.0)}  # mean = (size+1)/2
+            updates, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, updates)
+            history.append(params['w'][0])
+        return jnp.stack(history)
+
+    fn = jax.jit(jax.shard_map(steps, mesh=comm.mesh, in_specs=(),
+                               out_specs=P(AXES), check_vma=False))
+    return np.asarray(fn()).reshape(comm.size, n_steps)
+
+
+def test_first_update_broadcasts():
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    hist = _run_steps(comm)
+    # step 0: every device snapped to root (rank 0) params = 0.0;
+    # no optimizer step taken
+    np.testing.assert_allclose(hist[:, 0], np.zeros(8))
+    # step 1: sgd(1.0) with mean grad (0+1+...+7)/8 + 1 = 4.5
+    np.testing.assert_allclose(hist[:, 1], np.full(8, -4.5))
+    np.testing.assert_allclose(hist[:, 2], np.full(8, -9.0))
+
+
+def test_no_broadcast_mode():
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    hist = _run_steps(comm, broadcast_first=False)
+    # step 0 already applies the mean-gradient step from divergent
+    # starts: rank r starts at r, grad mean 4.5 -> r - 4.5
+    np.testing.assert_allclose(hist[:, 0],
+                               np.arange(8, dtype=np.float32) - 4.5)
+
+
+def test_params_required():
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(1.0), comm)
+    state = opt.init({'w': jnp.zeros((2,))})
+    with pytest.raises(ValueError, match='requires params'):
+        opt.update({'w': jnp.ones((2,))}, state)

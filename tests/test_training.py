@@ -1,0 +1,132 @@
+"""Training machinery tests: iterators, collation, serializers,
+snapshot/resume (reference delegates these to Chainer; ours are
+standalone so they need their own coverage)."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu import serializers
+from chainermn_tpu.datasets.mnist import TupleDataset
+from chainermn_tpu.models import MLP, Classifier
+from chainermn_tpu import training
+from chainermn_tpu.training import extensions
+from chainermn_tpu.training.convert import concat_examples
+
+
+def _toy_dataset(n=64):
+    rng = np.random.RandomState(0)
+    return TupleDataset(rng.randn(n, 8).astype(np.float32),
+                        rng.randint(0, 3, n).astype(np.int32))
+
+
+def test_serial_iterator_epochs():
+    it = training.SerialIterator(list(range(10)), 4, shuffle=False)
+    seen = []
+    for _ in range(5):
+        seen.append(it.next())
+    assert it.epoch == 2
+    assert all(len(b) == 4 for b in seen)  # constant batch size
+
+
+def test_serial_iterator_no_repeat():
+    it = training.SerialIterator(list(range(10)), 4, repeat=False,
+                                 shuffle=False)
+    batches = list(it)
+    assert [len(b) for b in batches] == [4, 4, 2]
+    assert it.epoch == 1
+
+
+def test_multiprocess_iterator_prefetch():
+    it = training.iterators.MultiprocessIterator(
+        list(range(20)), 5, shuffle=False)
+    first = it.next()
+    assert len(first) == 5
+    for _ in range(3):
+        it.next()
+    assert it.epoch == 1
+    it.finalize()
+
+
+def test_concat_examples_padding():
+    batch = [(np.ones((3,), np.float32), 1), (np.zeros((3,), np.float32),
+                                              2)]
+    x, y, mask = concat_examples(batch, padding=(4, 0))
+    assert x.shape == (4, 3) and y.shape == (4,)
+    np.testing.assert_array_equal(mask, [1, 1, 0, 0])
+
+
+def test_serializers_roundtrip(tmp_path):
+    tree = {'a': jnp.arange(6.).reshape(2, 3),
+            'nested': {'b': jnp.ones((4,), jnp.bfloat16)}, 'step': 7}
+    path = serializers.save_npz(str(tmp_path / 'ckpt'), tree)
+    loaded = serializers.load_npz(path, tree)
+    np.testing.assert_array_equal(np.asarray(loaded['a']),
+                                  np.asarray(tree['a']))
+    assert loaded['nested']['b'].dtype == jnp.bfloat16
+    # template mismatch is detected
+    bad = {'a': jnp.zeros((3, 2)), 'nested': {'b': jnp.ones((4,))},
+           'step': 0}
+    with pytest.raises(ValueError):
+        serializers.load_npz(path, bad)
+
+
+def _small_trainer(tmp_path, n_epoch=1):
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    ds = _toy_dataset()
+    model = MLP(n_units=16, n_out=3)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    clf = Classifier(model.apply)
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    it = training.SerialIterator(ds, 16)
+    upd = training.StandardUpdater(it, opt, clf, params, comm,
+                                   has_aux=True)
+    tr = training.Trainer(upd, (n_epoch, 'epoch'), out=str(tmp_path))
+    return tr, upd
+
+
+def test_snapshot_and_resume(tmp_path):
+    tr, upd = _small_trainer(tmp_path, n_epoch=2)
+    tr.extend(extensions.snapshot(), trigger=(1, 'epoch'))
+    tr.run()
+    snaps = sorted(glob.glob(os.path.join(str(tmp_path), 'snapshot_*')))
+    assert snaps, 'no snapshot written'
+    template = {'params': upd.params, 'opt_state': upd.opt_state,
+                'iteration': 0, 'epoch': 0}
+    state = serializers.load_npz(snaps[-1], template)
+    assert int(state['iteration']) == upd.iteration
+    # params in snapshot match live params
+    live = jax.tree_util.tree_leaves(upd.params)
+    saved = jax.tree_util.tree_leaves(state['params'])
+    for a, b in zip(live, saved):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+
+
+def test_trainer_iteration_trigger(tmp_path):
+    tr, upd = _small_trainer(tmp_path)
+    fired = []
+    tr.extend(lambda t: fired.append(t.updater.iteration),
+              trigger=(2, 'iteration'), name='probe')
+    tr.run()
+    assert fired == [2, 4]  # 64/16 = 4 iterations per epoch
+
+
+def test_updater_batch_divisibility(tmp_path):
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    ds = _toy_dataset(30)
+    model = MLP(n_units=16, n_out=3)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    clf = Classifier(model.apply)
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    it = training.SerialIterator(ds, 15)  # 15 % 8 != 0
+    upd = training.StandardUpdater(it, opt, clf, params, comm,
+                                   has_aux=True)
+    with pytest.raises(ValueError):
+        upd.update()
