@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Baseline: the reference points at PFN's published 128-GPU ChainerMN
+ResNet-50 run (``/root/reference/README.md:19``; 100 epochs of
+ImageNet-1k in 4.4 hours on 128 P100s) which works out to ~8100
+images/sec total, i.e. **~63 images/sec/chip** -- that per-chip number
+is the bar ``vs_baseline`` is computed against.
+
+Runs the full training step (forward+backward+allreduce+SGD step +
+cross-replica BN sync) on all locally visible devices via the same
+StandardUpdater-jitted program users run, bfloat16 NHWC, global batch
+sized per device count.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import chainermn_tpu
+from chainermn_tpu import training
+from chainermn_tpu.models import ResNet50, StatefulClassifier
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 63.0
+
+
+def main():
+    quick = '--quick' in sys.argv
+    n_dev = jax.device_count()
+    insize = 224
+    per_device_batch = 32
+    batch = per_device_batch * n_dev
+
+    comm = chainermn_tpu.create_communicator('xla')
+    model = ResNet50(num_classes=1000)
+    x0 = jnp.zeros((1, insize, insize, 3), jnp.float32)
+    variables = model.init({'params': jax.random.PRNGKey(0)}, x0,
+                           train=False)
+    params = variables['params']
+    model_state = {k: v for k, v in variables.items() if k != 'params'}
+    clf = StatefulClassifier(model)
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, insize, insize, 3).astype(np.float32)
+    y = rng.randint(0, 1000, batch).astype(np.int32)
+
+    class _OneBatch:
+        batch_size = batch
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            return [(x[i], y[i]) for i in range(batch)]
+        next = __next__
+
+    updater = training.StandardUpdater(
+        _OneBatch(), optimizer, clf.loss, params, comm,
+        model_state=model_state)
+
+    # warmup: broadcast step + 2 real steps (compile included)
+    for _ in range(3):
+        updater.update()
+
+    n_steps = 5 if quick else 20
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        updater.update()
+    jax.block_until_ready(updater.params)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * n_steps / dt
+    per_chip = imgs_per_sec / n_dev
+    print(json.dumps({
+        'metric': 'resnet50_train_images_per_sec_per_chip',
+        'value': round(per_chip, 2),
+        'unit': 'images/sec/chip',
+        'vs_baseline': round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -10,4 +10,30 @@ loss functions.
 """
 
 from chainermn_tpu.models.mlp import MLP  # noqa
-from chainermn_tpu.models.classifier import Classifier, classifier_loss  # noqa
+from chainermn_tpu.models.classifier import (  # noqa
+    Classifier, StatefulClassifier, classifier_loss)
+from chainermn_tpu.models.alex import Alex  # noqa
+from chainermn_tpu.models.nin import NIN  # noqa
+from chainermn_tpu.models.vgg import VGG, VGG16  # noqa
+from chainermn_tpu.models.googlenet import GoogLeNet  # noqa
+from chainermn_tpu.models.googlenetbn import GoogLeNetBN  # noqa
+from chainermn_tpu.models.resnet50 import (  # noqa
+    ResNet, ResNet50, ResNet101, ResNet152)
+from chainermn_tpu.models.seq2seq import Seq2seq, seq2seq_loss  # noqa
+
+
+def get_arch(name, **kwargs):
+    """Architecture registry (parity with the reference's arch table at
+    ``train_imagenet.py:103-109``)."""
+    archs = {
+        'alex': Alex,
+        'googlenet': GoogLeNet,
+        'googlenetbn': GoogLeNetBN,
+        'nin': NIN,
+        'resnet50': ResNet50,
+        'vgg16': VGG16,
+    }
+    if name not in archs:
+        raise ValueError('unknown architecture %r (choose from %s)'
+                         % (name, ', '.join(sorted(archs))))
+    return archs[name](**kwargs)

@@ -1,0 +1,33 @@
+"""AlexNet (reference ``examples/imagenet/models_v2/alex.py``,
+insize 227).  NHWC, bfloat16 compute."""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Alex(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    insize: int = 227
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(96, (11, 11), strides=(4, 4), padding='VALID',
+                            dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(256, (5, 5), padding=2, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding=1, dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(384, (3, 3), padding=1, dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(256, (3, 3), padding=1, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

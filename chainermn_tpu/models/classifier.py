@@ -32,6 +32,52 @@ def classifier_loss(apply_fn, label_smoothing=0.0):
     return loss_fn
 
 
+class StatefulClassifier:
+    """Classifier for models with BatchNorm state / dropout RNG.
+
+    Produces the updater's extended protocol:
+    ``loss(params, model_state, rng, x, y) ->
+    (loss, (metrics, new_model_state))`` and an eval function reading
+    running statistics.  Auxiliary-head outputs (GoogLeNet returns
+    ``(logits, (aux1, aux2))`` in train mode) are weighted 0.3 like the
+    reference (``models_v2/googlenet.py`` loss composition).
+    """
+
+    def __init__(self, model, aux_weight=0.3):
+        self.model = model
+        self.aux_weight = aux_weight
+
+    def _ce(self, logits, y):
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    def loss(self, params, model_state, rng, x, y):
+        variables = {'params': params, **model_state}
+        out, mutated = self.model.apply(
+            variables, x, train=True, mutable=list(model_state.keys()),
+            rngs={'dropout': rng})
+        if isinstance(out, tuple):
+            logits, auxes = out
+            loss = self._ce(logits, y)
+            for aux in auxes:
+                if aux is not None:
+                    loss = loss + self.aux_weight * self._ce(aux, y)
+        else:
+            logits = out
+            loss = self._ce(logits, y)
+        acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+        return loss, ({'accuracy': acc}, mutated)
+
+    def eval_metrics(self, params_and_state, x, y):
+        """Per-example metrics; ``params_and_state`` is the full
+        variables dict (pass ``{'params': p, **state}``)."""
+        out = self.model.apply(params_and_state, x, train=False)
+        logits = out[0] if isinstance(out, tuple) else out
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y)
+        acc = (jnp.argmax(logits, -1) == y).astype(jnp.float32)
+        return {'loss': loss, 'accuracy': acc}
+
+
 class Classifier:
     """Object flavor for symmetry with ``L.Classifier``; callable as a
     loss function."""

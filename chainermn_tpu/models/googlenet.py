@@ -1,0 +1,86 @@
+"""GoogLeNet / Inception-v1 (reference
+``examples/imagenet/models_v2/googlenet.py``, insize 224; auxiliary
+classifier heads included, weighted 0.3 like the reference loss)."""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Inception(nn.Module):
+    """Inception module: 1x1 / 3x3 / 5x5 / pool-proj branches."""
+    n1: int
+    n3r: int
+    n3: int
+    n5r: int
+    n5: int
+    proj: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = self.dtype
+        b1 = nn.relu(nn.Conv(self.n1, (1, 1), dtype=d)(x))
+        b3 = nn.relu(nn.Conv(self.n3r, (1, 1), dtype=d)(x))
+        b3 = nn.relu(nn.Conv(self.n3, (3, 3), padding=1, dtype=d)(b3))
+        b5 = nn.relu(nn.Conv(self.n5r, (1, 1), dtype=d)(x))
+        b5 = nn.relu(nn.Conv(self.n5, (5, 5), padding=2, dtype=d)(b5))
+        bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding='SAME')
+        bp = nn.relu(nn.Conv(self.proj, (1, 1), dtype=d)(bp))
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+class _AuxHead(nn.Module):
+    num_classes: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train):
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3))
+        x = nn.relu(nn.Conv(128, (1, 1), dtype=self.dtype)(x))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(1024, dtype=self.dtype)(x))
+        x = nn.Dropout(0.7, deterministic=not train)(x)
+        return nn.Dense(self.num_classes,
+                        dtype=jnp.float32)(x).astype(jnp.float32)
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    insize: int = 224
+    aux_heads: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        x = x.astype(d)
+        x = nn.relu(nn.Conv(64, (7, 7), strides=(2, 2), padding=3,
+                            dtype=d)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        x = nn.relu(nn.Conv(64, (1, 1), dtype=d)(x))
+        x = nn.relu(nn.Conv(192, (3, 3), padding=1, dtype=d)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        x = Inception(64, 96, 128, 16, 32, 32, dtype=d)(x)
+        x = Inception(128, 128, 192, 32, 96, 64, dtype=d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        x = Inception(192, 96, 208, 16, 48, 64, dtype=d)(x)
+        aux1 = (_AuxHead(self.num_classes, d)(x, train)
+                if self.aux_heads else None)
+        x = Inception(160, 112, 224, 24, 64, 64, dtype=d)(x)
+        x = Inception(128, 128, 256, 24, 64, 64, dtype=d)(x)
+        x = Inception(112, 144, 288, 32, 64, 64, dtype=d)(x)
+        aux2 = (_AuxHead(self.num_classes, d)(x, train)
+                if self.aux_heads else None)
+        x = Inception(256, 160, 320, 32, 128, 128, dtype=d)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        x = Inception(256, 160, 320, 32, 128, 128, dtype=d)(x)
+        x = Inception(384, 192, 384, 48, 128, 128, dtype=d)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.4, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        x = x.astype(jnp.float32)
+        if self.aux_heads and train:
+            return x, (aux1, aux2)
+        return x

@@ -1,0 +1,89 @@
+"""GoogLeNet-BN / BN-Inception (reference
+``examples/imagenet/models_v2/googlenetbn.py``, BASELINE config 5:
+multi-branch gradients stressing node-aware reduction).  Inception
+branches use 3x3 factorization + BatchNorm as in the reference's
+``InceptionBN``."""
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class InceptionBN(nn.Module):
+    """BN-Inception module: 1x1 / 3x3 / double-3x3 / pool-proj, each
+    conv followed by BatchNorm (reference InceptionBN)."""
+    n1: int
+    n3r: int
+    n3: int
+    d3r: int
+    d3: int
+    proj: int
+    pool: str = 'avg'  # 'avg' | 'max'
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+
+        def cbr(y, feats, kernel, stride=1, pad='SAME'):
+            y = conv(feats, kernel, strides=(stride, stride),
+                     padding=pad)(y)
+            return nn.relu(norm()(y))
+
+        s = self.stride
+        branches = []
+        if self.n1:
+            branches.append(cbr(x, self.n1, (1, 1)))
+        b3 = cbr(x, self.n3r, (1, 1))
+        branches.append(cbr(b3, self.n3, (3, 3), stride=s))
+        bd = cbr(x, self.d3r, (1, 1))
+        bd = cbr(bd, self.d3, (3, 3))
+        branches.append(cbr(bd, self.d3, (3, 3), stride=s))
+        pool_fn = nn.avg_pool if self.pool == 'avg' else nn.max_pool
+        bp = pool_fn(x, (3, 3), strides=(s, s), padding='SAME')
+        if self.proj:
+            bp = cbr(bp, self.proj, (1, 1))
+        branches.append(bp)
+        return jnp.concatenate(branches, axis=-1)
+
+
+class GoogLeNetBN(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    insize: int = 224
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        d = self.dtype
+        conv = partial(nn.Conv, use_bias=False, dtype=d)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=d,
+                       param_dtype=jnp.float32)
+        x = x.astype(d)
+        x = nn.relu(norm()(conv(64, (7, 7), strides=(2, 2),
+                                padding=3)(x)))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        x = nn.relu(norm()(conv(192, (3, 3), padding=1)(x)))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        x = InceptionBN(64, 64, 64, 64, 96, 32, dtype=d)(x, train)
+        x = InceptionBN(64, 64, 96, 64, 96, 64, dtype=d)(x, train)
+        x = InceptionBN(0, 128, 160, 64, 96, 0, pool='max', stride=2,
+                        dtype=d)(x, train)
+        x = InceptionBN(224, 64, 96, 96, 128, 128, dtype=d)(x, train)
+        x = InceptionBN(192, 96, 128, 96, 128, 128, dtype=d)(x, train)
+        x = InceptionBN(160, 128, 160, 128, 160, 128, dtype=d)(x, train)
+        x = InceptionBN(96, 128, 192, 160, 192, 128, dtype=d)(x, train)
+        x = InceptionBN(0, 128, 192, 192, 256, 0, pool='max', stride=2,
+                        dtype=d)(x, train)
+        x = InceptionBN(352, 192, 320, 160, 224, 128, dtype=d)(x, train)
+        x = InceptionBN(352, 192, 320, 192, 224, 128, pool='max',
+                        dtype=d)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -1,0 +1,34 @@
+"""Network-in-Network (reference ``examples/imagenet/models_v2/nin.py``,
+insize 227: 4 mlpconv stacks, global average pool head)."""
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class NIN(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    insize: int = 227
+
+    def _mlpconv(self, x, features, kernel, stride, pad):
+        x = nn.relu(nn.Conv(features, kernel, strides=stride, padding=pad,
+                            dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(features, (1, 1), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(features, (1, 1), dtype=self.dtype)(x))
+        return x
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.astype(self.dtype)
+        x = self._mlpconv(x, 96, (11, 11), (4, 4), 'VALID')
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = self._mlpconv(x, 256, (5, 5), (1, 1), 2)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = self._mlpconv(x, 384, (3, 3), (1, 1), 1)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = self._mlpconv(x, self.num_classes, (3, 3), (1, 1), 1)
+        x = jnp.mean(x, axis=(1, 2))  # global average pooling head
+        return x.astype(jnp.float32)

@@ -1,0 +1,87 @@
+"""ResNet-50 (the flagship benchmark workload).
+
+Capability parity with reference ``examples/imagenet/models_v2/resnet50.py``
+(insize 224, bottleneck ``Block``s of [3,4,6,3], reporting
+loss/accuracy).  TPU-native choices: NHWC layout (TPU conv native),
+bfloat16 compute with float32 BatchNorm statistics and parameters,
+stride on the 3x3 (the v1.5 variant -- better accuracy at equal FLOPs
+on MXU), and an init/apply surface that composes with the sharded
+updater.
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck (reference ``BottleNeckA``/``B``)."""
+    features: int
+    stride: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = nn.relu(norm()(y))
+        y = conv(self.features, (3, 3), strides=(self.stride,
+                                                 self.stride))(y)
+        y = nn.relu(norm()(y))
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1),
+                            strides=(self.stride, self.stride),
+                            name='proj')(residual)
+            residual = norm(name='proj_bn')(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    width: int = 64
+    dtype: Any = jnp.bfloat16
+    insize: int = 224  # reference resnet50.py insize=224
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
+                    dtype=self.dtype, name='conv_init')(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype,
+                         param_dtype=jnp.float32, name='bn_init')(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                stride = 2 if i > 0 and j == 0 else 1
+                x = Bottleneck(self.width * 2 ** i, stride=stride,
+                               dtype=self.dtype)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name='fc')(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet50(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet(stage_sizes=[3, 4, 6, 3], num_classes=num_classes,
+                  dtype=dtype)
+
+
+def ResNet101(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet(stage_sizes=[3, 4, 23, 3], num_classes=num_classes,
+                  dtype=dtype)
+
+
+def ResNet152(num_classes=1000, dtype=jnp.bfloat16):
+    return ResNet(stage_sizes=[3, 8, 36, 3], num_classes=num_classes,
+                  dtype=dtype)

@@ -1,0 +1,37 @@
+"""VGG-16 (BASELINE config 3: the tensor-fusion stress workload --
+~138M parameters in a handful of huge tensors)."""
+
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+_VGG16 = (2, 2, 3, 3, 3)
+_WIDTHS = (64, 128, 256, 512, 512)
+
+
+class VGG(nn.Module):
+    stage_sizes: Sequence[int] = _VGG16
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    insize: int = 224
+
+    @nn.compact
+    def __call__(self, x, train=True):
+        x = x.astype(self.dtype)
+        for n, width in zip(self.stage_sizes, _WIDTHS):
+            for _ in range(n):
+                x = nn.relu(nn.Conv(width, (3, 3), padding=1,
+                                    dtype=self.dtype)(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def VGG16(num_classes=1000, dtype=jnp.bfloat16):
+    return VGG(num_classes=num_classes, dtype=dtype)

@@ -1,0 +1,22 @@
+"""Parallelism strategies beyond data parallelism.
+
+The reference's model-parallel layer is rank-routed send/recv
+(``chainermn/link.py``, SURVEY 2.2); this package provides the
+TPU-native strategy set it points toward:
+
+- :mod:`pipeline` -- micro-batched pipeline parallelism (GPipe-style)
+  over a mesh axis via ``ppermute`` (supersedes the reference's 2-stage
+  sequential "pipelined neural network",
+  ``train_mnist_model_parallel.py:66``)
+- :mod:`tensor` -- tensor (operator) parallelism: column/row-sharded
+  matmuls with psum/all_gather on a mesh axis
+- :mod:`sequence` -- sequence/context parallelism: ring attention with
+  blockwise KV rotation (long-context first-class)
+- :mod:`moe` -- expert parallelism: all_to_all token dispatch
+"""
+
+from chainermn_tpu.parallel.pipeline import Pipeline  # noqa
+from chainermn_tpu.parallel.tensor import (  # noqa
+    column_parallel_dense, row_parallel_dense, tp_mlp)
+from chainermn_tpu.parallel.sequence import ring_attention  # noqa
+from chainermn_tpu.parallel.moe import MoELayer  # noqa

@@ -1,0 +1,107 @@
+"""Expert parallelism: switch-style MoE layer.
+
+One expert (or group of experts) per device along a mesh axis; top-1
+routing with capacity, token dispatch/return via ``lax.all_to_all`` --
+the standard TPU formulation (dense one-hot dispatch einsums so
+everything stays static-shape for XLA).  Not a reference parity item
+(SURVEY 2.2: EP absent there); first-class here because the mesh
+design must scale to it.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class MoELayer:
+    """Functional switch-FFN.
+
+    Params (per device, i.e. expert-sharded over ``axis``):
+      ``router``: (d_model, n_experts) -- replicated.
+      ``w_in``: (n_local_experts, d_model, d_ff), ``w_out``:
+      (n_local_experts, d_ff, d_model).
+    """
+
+    def __init__(self, axis='expert', capacity_factor=1.25,
+                 activation=None):
+        self.axis = axis
+        self.capacity_factor = capacity_factor
+        self.activation = activation or (lambda x: jnp.maximum(x, 0))
+
+    def init_params(self, rng, d_model, d_ff, n_experts_total,
+                    n_devices):
+        """Global parameter tree; shard ``w_in``/``w_out`` with
+        ``P('expert')`` (leading experts dim) and replicate the
+        router."""
+        if n_experts_total % n_devices:
+            raise ValueError('experts must divide devices')
+        k1, k2, k3 = jax.random.split(rng, 3)
+        s_in = d_model ** -0.5
+        s_out = d_ff ** -0.5
+        return {
+            'router': jax.random.normal(k1, (d_model, n_experts_total))
+            * 0.02,
+            'w_in': jax.random.normal(
+                k2, (n_experts_total, d_model, d_ff)) * s_in,
+            'w_out': jax.random.normal(
+                k3, (n_experts_total, d_ff, d_model)) * s_out,
+        }
+
+    def __call__(self, params, x):
+        """x: (tokens_local, d_model) inside shard_map; returns same
+        shape plus aux losses dict."""
+        axis = self.axis
+        n_dev = lax.axis_size(axis)
+        tokens, d_model = x.shape
+        n_experts = params['router'].shape[-1]
+        local_experts = n_experts // n_dev
+        capacity = max(1, int(self.capacity_factor * tokens // n_experts))
+
+        logits = x @ params['router']                     # (T, E)
+        probs = jnp.exp(logits - lax.stop_gradient(
+            logits.max(-1, keepdims=True)))
+        probs = probs / probs.sum(-1, keepdims=True)
+        expert_idx = jnp.argmax(probs, axis=-1)           # (T,)
+        gate = jnp.take_along_axis(
+            probs, expert_idx[:, None], axis=-1)[:, 0]    # (T,)
+
+        # position of each token within its expert's queue
+        onehot = jnp.eye(n_experts, dtype=jnp.int32)[expert_idx]
+        pos = jnp.cumsum(onehot, axis=0) * onehot         # 1-based
+        pos = pos.sum(-1) - 1                             # (T,)
+        keep = pos < capacity
+        gate = gate * keep
+
+        # dense dispatch tensor: (T, E, C)
+        disp = (onehot.astype(jnp.float32)[:, :, None]
+                * jnp.eye(capacity)[jnp.clip(pos, 0, capacity - 1)]
+                [:, None, :] * keep[:, None, None].astype(jnp.float32))
+        expert_in = jnp.einsum('td,tec->ecd', x, disp)    # (E, C, d)
+
+        # ship expert rows to their owning device
+        expert_in = expert_in.reshape(
+            n_dev, local_experts, capacity, d_model)
+        expert_in = lax.all_to_all(expert_in, axis, split_axis=0,
+                                   concat_axis=0, tiled=False)
+        # now (n_dev, local, C, d): rows from every device for MY experts
+        expert_in = jnp.swapaxes(expert_in, 0, 1).reshape(
+            local_experts, n_dev * capacity, d_model)
+
+        h = jnp.einsum('ecd,edf->ecf', expert_in, params['w_in'])
+        h = self.activation(h)
+        out = jnp.einsum('ecf,efd->ecd', h, params['w_out'])
+
+        out = out.reshape(local_experts, n_dev, capacity, d_model)
+        out = jnp.swapaxes(out, 0, 1)                     # (n_dev, local, C, d)
+        out = lax.all_to_all(out, axis, split_axis=0, concat_axis=0,
+                             tiled=False)
+        out = out.reshape(n_experts, capacity, d_model)
+        y = jnp.einsum('ecd,tec->td', out, disp)
+        y = y * gate[:, None]
+
+        # switch aux load-balancing loss
+        density = onehot.astype(jnp.float32).mean(0)
+        density_proxy = probs.mean(0)
+        aux = jnp.sum(density * density_proxy) * n_experts
+        return y, {'aux_loss': aux,
+                   'dropped_fraction': 1.0 - keep.mean()}

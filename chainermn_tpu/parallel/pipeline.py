@@ -1,0 +1,100 @@
+"""Micro-batched pipeline parallelism.
+
+The reference's model parallelism is a 2-stage sequential pipeline with
+no micro-batching (``examples/mnist/train_mnist_model_parallel.py``;
+SURVEY 2.2 calls out GPipe-style scheduling as the superset
+deliverable).  This is that deliverable, in the canonical TPU-native
+form: all stages are *one* SPMD program over a ``stage`` mesh axis;
+micro-batches stream through a ``lax.scan`` whose carry rotates
+activations stage-to-stage with ``ppermute``; JAX autodiff through the
+scan gives the reverse schedule (the backward ppermute runs opposite
+the forward rotation -- exactly the reference's Send/Recv backward
+pairing, ``point_to_point_communication.py:23-33``, at scale).
+
+Stages must be shape-homogeneous (same activation shape between
+stages), the standard constraint for collective-permute pipelines; the
+heterogeneous general-DAG surface is
+:class:`chainermn_tpu.MultiNodeChainList`.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+class Pipeline:
+    """GPipe-style pipeline over a mesh axis.
+
+    Args:
+      stage_fn: ``stage_fn(stage_params, x) -> y`` -- the per-stage
+        computation; same code on every stage (stage-dependent behavior
+        can branch on ``lax.axis_index(axis)``).
+      n_stages: pipeline depth (must equal the mesh axis size).
+      axis: mesh axis name carrying the stages.
+
+    Call :meth:`__call__` INSIDE ``shard_map`` over a mesh that has
+    ``axis``.  ``params`` is the stage-local parameter pytree (i.e. the
+    shard_map in_spec for params should shard the leading stacked-stage
+    dimension over ``axis`` -- see ``stack_stage_params``).
+    """
+
+    def __init__(self, stage_fn, n_stages, axis='stage'):
+        self.stage_fn = stage_fn
+        self.n_stages = n_stages
+        self.axis = axis
+
+    def __call__(self, params, x_microbatches):
+        """Run the schedule.
+
+        x_microbatches: (n_micro, micro_batch, ...) -- every stage
+        receives the same global input stack (only stage 0 reads it).
+        Returns (n_micro, micro_batch, ...) outputs valid on the LAST
+        stage (other stages hold garbage; mask or read stage -1).
+        """
+        n_micro = x_microbatches.shape[0]
+        n_stages = self.n_stages
+        axis = self.axis
+        stage = lax.axis_index(axis)
+        perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        total_ticks = n_micro + n_stages - 1
+        state = jnp.zeros_like(x_microbatches[0])
+        outputs = jnp.zeros((n_micro,) + x_microbatches.shape[1:],
+                            x_microbatches.dtype)
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests micro-batch t (while t < n_micro)
+            feed = x_microbatches[jnp.minimum(t, n_micro - 1)]
+            x_in = jnp.where(stage == 0, feed, state)
+            y = self.stage_fn(params, x_in)
+            # last stage emits micro-batch t - (n_stages - 1)
+            out_idx = t - (n_stages - 1)
+            emit = jnp.logical_and(stage == n_stages - 1, out_idx >= 0)
+            outputs = lax.cond(
+                emit,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y.astype(o.dtype), jnp.maximum(out_idx, 0), 0),
+                lambda o: o, outputs)
+            # rotate activations to the next stage
+            state = lax.ppermute(y, axis, perm)
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(total_ticks))
+        return outputs
+
+
+def stack_stage_params(params_per_stage):
+    """Stack per-stage parameter pytrees along a new leading dim for
+    sharding over the stage axis (``in_specs=P('stage', ...)``)."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *params_per_stage)
+
+
+def microbatch(x, n_micro):
+    """(B, ...) -> (n_micro, B // n_micro, ...)"""
+    if x.shape[0] % n_micro:
+        raise ValueError('batch %d not divisible into %d micro-batches'
+                         % (x.shape[0], n_micro))
+    return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])

@@ -1,0 +1,67 @@
+"""Sequence/context parallelism: ring attention.
+
+Long-context support the reference never had (SURVEY 5 lists it as the
+mesh-axis the design must leave room for; here it is first-class).
+The sequence is sharded over a mesh axis; each device holds a query
+block and rotates its key/value block around the ring with
+``ppermute``, accumulating attention in the numerically stable
+flash/blockwise form (running max + rescaled numerator/denominator).
+Communication overlaps compute chunk-by-chunk and peak memory is
+O(T_local^2 / ring) instead of O(T^2).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def ring_attention(q, k, v, axis, causal=False, scale=None):
+    """Blockwise ring attention inside ``shard_map``.
+
+    q, k, v: (B, T_local, H, D) -- the sequence dim is sharded over
+    ``axis``.  Returns (B, T_local, H, D) attention output for the
+    local query block, mathematically identical to full softmax
+    attention over the global sequence.
+    """
+    n_ring = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    t_local = q.shape[1]
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    # (B, H, Tq, D) layout for the score matmuls
+    qt = jnp.swapaxes(q, 1, 2) * scale
+    perm = [(i, (i + 1) % n_ring) for i in range(n_ring)]
+
+    neg_inf = jnp.finfo(jnp.float32).min
+
+    def block(carry, step):
+        k_blk, v_blk, m, num, den = carry
+        kt = jnp.swapaxes(k_blk, 1, 2)
+        vt = jnp.swapaxes(v_blk, 1, 2)
+        # source device of the current kv block after `step` rotations
+        src = (me - step) % n_ring
+        scores = jnp.einsum('bhqd,bhkd->bhqk', qt, kt).astype(jnp.float32)
+        if causal:
+            q_pos = me * t_local + jnp.arange(t_local)[:, None]
+            k_pos = src * t_local + jnp.arange(k_blk.shape[1])[None, :]
+            scores = jnp.where(q_pos >= k_pos, scores, neg_inf)
+        blk_max = jnp.max(scores, axis=-1)
+        new_m = jnp.maximum(m, blk_max)
+        # guard fully-masked rows (blk entirely in the future)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(scores - new_m[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        num = num * correction[..., None] + jnp.einsum(
+            'bhqk,bhkd->bhqd', p.astype(vt.dtype), vt).astype(jnp.float32)
+        den = den * correction + jnp.sum(p, axis=-1)
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, new_m, num, den), None
+
+    b, _, h, d = q.shape
+    m0 = jnp.full((b, h, t_local), neg_inf, jnp.float32)
+    num0 = jnp.zeros((b, h, t_local, d), jnp.float32)
+    den0 = jnp.zeros((b, h, t_local), jnp.float32)
+    (k, v, m, num, den), _ = lax.scan(
+        block, (k, v, m0, num0, den0), jnp.arange(n_ring))
+    out = num / jnp.maximum(den[..., None], 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)

@@ -1,0 +1,41 @@
+"""Tensor (operator) parallelism primitives.
+
+Megatron-style sharded matmul pair for use inside ``shard_map``: a
+column-parallel projection (weights split on the output dim, no
+communication in) followed by a row-parallel projection (weights split
+on the input dim, one ``psum`` out).  One collective per block instead
+of per layer -- the layout "How to Scale Your Model" prescribes for
+feed-forward/attention blocks on ICI meshes.  (SURVEY 2.2: TP is not a
+reference parity requirement but the natural extension of its sharded
+design.)
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def column_parallel_dense(x, w, b=None):
+    """``y_local = x @ w_local`` -- w sharded on columns (output dim);
+    output stays sharded on the feature dim, no collective."""
+    y = jnp.einsum('...d,df->...f', x, w)
+    if b is not None:
+        y = y + b
+    return y
+
+
+def row_parallel_dense(x_local, w, axis, b=None):
+    """``y = psum_axis(x_local @ w_local)`` -- w sharded on rows (input
+    dim), input arrives feature-sharded from a column-parallel layer;
+    the psum completes the logical matmul."""
+    y = jnp.einsum('...d,df->...f', x_local, w)
+    y = lax.psum(y, axis)
+    if b is not None:
+        y = y + b  # bias applied once, after the reduction
+    return y
+
+
+def tp_mlp(x, w_in, b_in, w_out, b_out, axis, activation=None):
+    """Column->activation->row feed-forward with one psum total."""
+    h = column_parallel_dense(x, w_in, b_in)
+    h = activation(h) if activation is not None else jnp.tanh(h)
+    return row_parallel_dense(h, w_out, axis, b_out)

@@ -80,6 +80,26 @@ def load_npz(path, template):
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def resume_updater(path, updater, comm):
+    """Restore a snapshot written by ``extensions.snapshot()`` into a
+    live updater: params, optimizer state, BatchNorm/model state, and
+    the iteration/epoch counters (so stop triggers and log filenames
+    continue rather than restart)."""
+    template = {'params': updater.params, 'opt_state': updater.opt_state,
+                'iteration': 0, 'epoch': 0}
+    if getattr(updater, 'model_state', None) is not None:
+        template['model_state'] = updater.model_state
+    state = load_npz(path, template)
+    updater.params = comm.replicate(state['params'])
+    updater.opt_state = comm.replicate(state['opt_state'])
+    if 'model_state' in template:
+        updater.model_state = comm.replicate(state['model_state'])
+    updater.iteration = int(state['iteration'])
+    if hasattr(updater.iterator, 'epoch'):
+        updater.iterator.epoch = int(state['epoch'])
+    return state
+
+
 def save_checkpoint(directory, tree, step=0):
     """Sharded checkpoint via orbax (each host writes its shards)."""
     import orbax.checkpoint as ocp

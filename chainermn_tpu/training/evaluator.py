@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from chainermn_tpu.communicators.mesh_utility import AXES
 from chainermn_tpu.training.convert import concat_examples
 
 
@@ -53,8 +54,8 @@ class Evaluator:
                     s = v * jnp.sum(mask)
                 else:
                     s = jnp.sum(v * mask)
-                out[k] = (jax.lax.psum(s, ('inter', 'intra')),)
-            n = jax.lax.psum(jnp.sum(mask), ('inter', 'intra'))
+                out[k] = (jax.lax.psum(s, AXES),)
+            n = jax.lax.psum(jnp.sum(mask), AXES)
             return {k: v[0] for k, v in out.items()}, n
 
         def call(params, mask, *batch):

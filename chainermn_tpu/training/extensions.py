@@ -14,41 +14,52 @@ import time
 
 
 class LogReport:
-    """Accumulate observations and append JSON lines to ``out/log``.
+    """Accumulate observations every iteration and emit interval means
+    to ``out/log`` on the emit trigger (the constructor's ``trigger``
+    argument, default per-epoch) -- Chainer-LogReport semantics.
 
-    Gate to one process with ``rank0_only`` (the reference gates by
-    ``comm.rank == 0`` at ``train_mnist.py:107``).
+    Register WITHOUT an explicit trigger (``trainer.extend(LogReport())``)
+    so it runs each iteration and can average; the emitted entry also
+    overwrites same-named keys in ``trainer.observation`` so a
+    lower-priority PrintReport prints interval means, not the last
+    batch.  Gate to one process with ``rank0_only`` (the reference
+    gates by ``comm.rank == 0`` at ``train_mnist.py:107``).
     """
 
-    trigger = (1, 'epoch')
+    trigger = (1, 'iteration')  # called every iteration; emit below
     priority = 200
     name = 'log_report'
 
     def __init__(self, keys=None, trigger=(1, 'epoch'), filename='log',
                  rank0_only=True):
+        from chainermn_tpu.training import triggers as triggers_mod
         self.keys = keys
-        self.trigger = trigger
+        self._emit_trigger = triggers_mod.get_trigger(trigger)
         self.filename = filename
         self.rank0_only = rank0_only
         self.log = []
         self._accum = {}
-        self._n = 0
+        self._counts = {}
         self._start = time.time()
 
     def accumulate(self, observation):
+        # per-key counts: sparse keys (e.g. validation metrics reported
+        # once per epoch) must not be diluted by the iteration count
         for k, v in observation.items():
             if isinstance(v, (int, float)):
                 self._accum[k] = self._accum.get(k, 0.0) + v
-        self._n += 1
+                self._counts[k] = self._counts.get(k, 0) + 1
 
     def __call__(self, trainer):
         self.accumulate(trainer.observation)
-        entry = {k: v / self._n for k, v in self._accum.items()}
+        if not self._emit_trigger(trainer):
+            return
+        entry = {k: v / self._counts[k] for k, v in self._accum.items()}
         entry.update(epoch=trainer.updater.epoch,
                      iteration=trainer.updater.iteration,
                      elapsed_time=trainer.elapsed_time)
         self.log.append(entry)
-        self._accum, self._n = {}, 0
+        self._accum, self._counts = {}, {}
         import jax
         if not self.rank0_only or jax.process_index() == 0:
             if trainer.out:
@@ -109,12 +120,15 @@ def snapshot(filename='snapshot_iter_{iteration}', rank0_only=True):
         u = trainer.updater
         path = os.path.join(
             trainer.out, filename.format(iteration=u.iteration))
-        serializers.save_npz(path, {
+        state = {
             'params': u.params,
             'opt_state': u.opt_state,
             'iteration': u.iteration,
             'epoch': u.epoch,
-        })
+        }
+        if getattr(u, 'model_state', None) is not None:
+            state['model_state'] = u.model_state
+        serializers.save_npz(path, state)
     ext.trigger = (1, 'epoch')
     ext.priority = 50
     ext.name = 'snapshot'

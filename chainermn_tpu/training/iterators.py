@@ -96,10 +96,33 @@ class MultiprocessIterator:
         self.iteration = 0
         self.is_new_epoch = False
         self._consumed_pos = 0
-        self._queue = queue_mod.Queue(maxsize=n_prefetch)
+        self._n_prefetch = n_prefetch
+        self._start_worker()
+
+    def _start_worker(self):
+        self._queue = queue_mod.Queue(maxsize=self._n_prefetch)
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._worker, daemon=True)
         self._thread.start()
+
+    def reset(self):
+        """Stop the current producer and restart from a fresh pass
+        (needed for repeat=False evaluation iterators reused across
+        epochs)."""
+        self._stop.set()
+        # drain so a blocked producer can observe the stop flag
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue_mod.Empty:
+            pass
+        self._thread.join(timeout=5)
+        self._inner.reset()
+        self.epoch = 0
+        self.iteration = 0
+        self.is_new_epoch = False
+        self._consumed_pos = 0
+        self._start_worker()
 
     def _worker(self):
         inner = self._inner

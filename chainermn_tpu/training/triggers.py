@@ -3,19 +3,26 @@ surface the reference relies on, e.g. ``train_mnist.py:100,112``)."""
 
 
 class IntervalTrigger:
-    """Fires every ``period`` epochs or iterations."""
+    """Fires every ``period`` epochs or iterations.
+
+    Edge-triggered on the count *advancing* past a multiple of the
+    period, so it cannot fire at count 0 (a ``(N, 'iteration')`` stop
+    trigger must not stop the run before the first update)."""
 
     def __init__(self, period, unit):
         if unit not in ('epoch', 'iteration'):
             raise ValueError("unit must be 'epoch' or 'iteration'")
         self.period = period
         self.unit = unit
-        self._last_epoch = 0
+        self._previous = 0
 
     def __call__(self, trainer):
         u = trainer.updater
         if self.unit == 'iteration':
-            return u.iteration % self.period == 0
+            count = u.iteration
+            fire = count // self.period > self._previous // self.period
+            self._previous = count
+            return fire
         if u.is_new_epoch and u.epoch % self.period == 0:
             return True
         return False

@@ -33,15 +33,29 @@ class StandardUpdater:
     """
 
     def __init__(self, iterator, optimizer, loss_fn, params, comm,
-                 has_aux=False, donate=True):
+                 has_aux=False, donate=True, model_state=None, rng=None):
+        """``model_state``: optional non-trainable collections (e.g.
+        BatchNorm running stats).  When given, ``loss_fn`` must have
+        the extended signature
+        ``loss_fn(params, model_state, rng, *batch) ->
+        (loss, (metrics, new_model_state))`` -- gradients are taken
+        w.r.t. ``params`` only, the returned state is mean-synced
+        across the mesh (cross-replica BatchNorm statistics), and
+        ``rng`` (defaulting to PRNGKey(0)) is folded per iteration and
+        per device for dropout-style randomness.
+        """
         self.iterator = iterator
         self.optimizer = optimizer
         self.comm = comm
         self.loss_fn = loss_fn
         self._has_aux = has_aux
+        self._has_state = model_state is not None
         self.params = comm.replicate(params)
+        self.model_state = (comm.replicate(model_state)
+                            if self._has_state else None)
         self.opt_state = comm.replicate(optimizer.init(params))
         self.iteration = 0
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._step = self._build_step(donate)
 
     def _build_step(self, donate):
@@ -50,30 +64,46 @@ class StandardUpdater:
         loss_fn = self.loss_fn
         has_aux = self._has_aux
 
-        def step(params, opt_state, *batch):
-            out = jax.value_and_grad(loss_fn, has_aux=has_aux)(
-                params, *batch)
-            if has_aux:
-                (loss, metrics), grads = out
+        has_state = self._has_state
+
+        def step(params, model_state, opt_state, rng, *batch):
+            if has_state:
+                dev_rng = jax.random.fold_in(rng, comm.axis_rank())
+
+                def wrapped(p):
+                    loss, (metrics, new_state) = loss_fn(
+                        p, model_state, dev_rng, *batch)
+                    return loss, (metrics, new_state)
+                (loss, (metrics, new_state)), grads = jax.value_and_grad(
+                    wrapped, has_aux=True)(params)
+                # cross-replica sync of running statistics
+                new_state = comm.allreduce(new_state, op='mean')
             else:
-                loss, grads = out
-                metrics = {}
+                out = jax.value_and_grad(loss_fn, has_aux=has_aux)(
+                    params, *batch)
+                if has_aux:
+                    (loss, metrics), grads = out
+                else:
+                    loss, grads = out
+                    metrics = {}
+                new_state = model_state
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             metrics = dict(metrics, loss=loss)
             metrics = comm.allreduce(metrics, op='mean')
-            return params, opt_state, metrics
+            return params, new_state, opt_state, metrics
 
         # arity of in_specs depends on the batch tuple; resolved at
         # trace time (jit caches per shape signature)
-        def mapped_call(params, opt_state, *batch):
+        def mapped_call(params, model_state, opt_state, rng, *batch):
             fn = jax.shard_map(
                 step, mesh=comm.mesh,
-                in_specs=(P(), P()) + (comm.batch_spec(),) * len(batch),
-                out_specs=(P(), P(), P()), check_vma=False)
-            return fn(params, opt_state, *batch)
+                in_specs=(P(), P(), P(), P()) +
+                (comm.batch_spec(),) * len(batch),
+                out_specs=(P(), P(), P(), P()), check_vma=False)
+            return fn(params, model_state, opt_state, rng, *batch)
 
-        jit_kwargs = {'donate_argnums': (0, 1)} if donate else {}
+        jit_kwargs = {'donate_argnums': (0, 1, 2)} if donate else {}
         return jax.jit(mapped_call, static_argnums=(), **jit_kwargs)
 
     def update(self):
@@ -87,8 +117,12 @@ class StandardUpdater:
                 'global batch size %d must be divisible by mesh size %d'
                 % (n, self.comm.size))
         arrays = self.comm.shard_batch(arrays)
-        self.params, self.opt_state, metrics = self._step(
-            self.params, self.opt_state, *arrays)
+        # stateless path reuses the cached key (the step ignores it)
+        step_rng = (jax.random.fold_in(self._rng, self.iteration)
+                    if self._has_state else self._rng)
+        self.params, self.model_state, self.opt_state, metrics = \
+            self._step(self.params, self.model_state, self.opt_state,
+                       step_rng, *arrays)
         self.iteration += 1
         return {k: float(v) for k, v in metrics.items()}
 

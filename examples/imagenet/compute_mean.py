@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Compute the dataset mean image (reference
+``examples/imagenet/compute_mean.py``)."""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+from chainermn_tpu.datasets import imagenet  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(description='Compute mean image')
+    parser.add_argument('--root', '-R', default=None,
+                        help='dataset root (synthetic if absent)')
+    parser.add_argument('--output', '-o', default='mean.npy')
+    parser.add_argument('--limit', type=int, default=256)
+    args = parser.parse_args()
+
+    if args.root:
+        os.environ['CHAINERMN_TPU_IMAGENET'] = args.root
+    train, _ = imagenet.get_imagenet()
+    mean = imagenet.compute_mean(train, limit=args.limit)
+    np.save(args.output, mean)
+    print('saved %s (shape %s)' % (args.output, mean.shape))
+
+
+if __name__ == '__main__':
+    main()

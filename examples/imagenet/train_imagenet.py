@@ -1,0 +1,148 @@
+#!/usr/bin/env python
+"""Distributed ImageNet training.
+
+TPU-native rebuild of the reference
+(``examples/imagenet/train_imagenet.py``): same arch registry and flag
+surface, launched as plain ``python train_imagenet.py`` over the whole
+TPU slice (no mpiexec).  Uses the StatefulClassifier path (BatchNorm +
+dropout), cross-replica BN, MomentumSGD lr=0.01 parity
+(``train_imagenet.py:185-187``).
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+import chainermn_tpu  # noqa: E402
+from chainermn_tpu import training  # noqa: E402
+from chainermn_tpu.datasets import imagenet  # noqa: E402
+from chainermn_tpu.models import StatefulClassifier, get_arch  # noqa: E402
+from chainermn_tpu.training import extensions  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description='ChainerMN-TPU ImageNet')
+    parser.add_argument('--arch', '-a', default='resnet50',
+                        help='alex|googlenet|googlenetbn|nin|resnet50|vgg16')
+    parser.add_argument('--batchsize', '-B', type=int, default=256,
+                        help='global batch size')
+    parser.add_argument('--epoch', '-E', type=int, default=10)
+    parser.add_argument('--communicator', default='xla')
+    parser.add_argument('--loaderjob', '-j', type=int, default=4)
+    parser.add_argument('--mean', '-m', default=None,
+                        help='mean image npy (computed if absent)')
+    parser.add_argument('--out', '-o', default='result')
+    parser.add_argument('--resume', '-r', default='')
+    parser.add_argument('--initmodel', default='')
+    parser.add_argument('--val_batchsize', '-b', type=int, default=64)
+    parser.add_argument('--cpu', action='store_true')
+    parser.add_argument('--mesh', default=None)
+    parser.add_argument('--quick', action='store_true')
+    parser.add_argument('--dtype', default='bfloat16',
+                        choices=['bfloat16', 'float32'])
+    args = parser.parse_args()
+
+    if args.cpu:
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=8').strip()
+        jax.config.update('jax_platforms', 'cpu')
+
+    mesh_shape = None
+    if args.mesh:
+        mesh_shape = tuple(int(v) for v in args.mesh.split('x'))
+    comm = chainermn_tpu.create_communicator(args.communicator,
+                                             mesh_shape=mesh_shape)
+
+    model = get_arch(args.arch, dtype=getattr(jnp, args.dtype))
+    insize = model.insize
+    if args.quick:
+        # tiny synthetic set + small spatial for smoke runs
+        insize = 64
+
+    if comm.rank == 0:
+        print('==========================================')
+        print('Num devices: {}'.format(comm.size))
+        print('Using {} communicator'.format(args.communicator))
+        print('Using {} arch ({} insize {})'.format(
+            args.arch, args.dtype, insize))
+        print('Global batch-size: {}'.format(args.batchsize))
+        print('Num epoch: {}'.format(args.epoch))
+        print('==========================================')
+
+    n_train = 512 if args.quick else 1280
+    raw_train, raw_val = imagenet.get_imagenet(
+        n_train, 128, size=insize + 32)
+    if args.mean and os.path.exists(args.mean):
+        mean = np.load(args.mean)
+    else:
+        mean = imagenet.compute_mean(raw_train, limit=64)
+
+    train = imagenet.PreprocessedDataset(raw_train, mean, insize)
+    val = imagenet.PreprocessedDataset(raw_val, mean, insize,
+                                       random=False)
+    train = chainermn_tpu.scatter_dataset(train, comm)
+    val = chainermn_tpu.scatter_dataset(val, comm)
+
+    train_iter = training.iterators.MultiprocessIterator(
+        train, args.batchsize, n_prefetch=args.loaderjob)
+    val_iter = training.SerialIterator(val, args.val_batchsize,
+                                       repeat=False, shuffle=False)
+
+    x0 = jnp.zeros((1, insize, insize, 3), jnp.float32)
+    variables = model.init({'params': jax.random.PRNGKey(0)}, x0,
+                           train=False)
+    params = variables['params']
+    model_state = {k: v for k, v in variables.items() if k != 'params'}
+    clf = StatefulClassifier(model)
+
+    if args.initmodel:
+        from chainermn_tpu import serializers
+        params = serializers.load_npz(args.initmodel, params)
+
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.01, momentum=0.9), comm)
+
+    updater = training.StandardUpdater(
+        train_iter, optimizer, clf.loss, params, comm,
+        model_state=model_state)
+    n_epoch = 1 if args.quick else args.epoch
+    trainer = training.Trainer(updater, (n_epoch, 'epoch'), out=args.out)
+
+    # params_getter hands the evaluator the full variables dict so BN
+    # running stats enter the jitted eval as arguments, not as traced
+    # constants (which would freeze them at their epoch-1 values)
+    evaluator = training.Evaluator(
+        val_iter, clf.eval_metrics,
+        lambda: {'params': updater.params, **updater.model_state}, comm)
+    evaluator = chainermn_tpu.create_multi_node_evaluator(evaluator, comm)
+    trainer.extend(evaluator, trigger=(1, 'epoch'))
+
+    if comm.rank == 0:
+        trainer.extend(extensions.snapshot(), trigger=(1, 'epoch'))
+        trainer.extend(extensions.LogReport())
+        trainer.extend(extensions.PrintReport(
+            ['epoch', 'iteration', 'loss', 'accuracy',
+             'validation/main/loss', 'validation/main/accuracy',
+             'elapsed_time']), trigger=(1, 'epoch'))
+
+    if args.resume:
+        from chainermn_tpu import serializers
+        serializers.resume_updater(args.resume, updater, comm)
+
+    trainer.run()
+    if comm.rank == 0:
+        print('final observation:', trainer.observation)
+    return trainer
+
+
+if __name__ == '__main__':
+    main()

@@ -103,7 +103,7 @@ def main():
 
     if comm.rank == 0:
         trainer.extend(extensions.snapshot(), trigger=(1, 'epoch'))
-        trainer.extend(extensions.LogReport(), trigger=(1, 'epoch'))
+        trainer.extend(extensions.LogReport())
         trainer.extend(extensions.PrintReport(
             ['epoch', 'loss', 'accuracy', 'validation/main/loss',
              'validation/main/accuracy', 'elapsed_time']),
@@ -111,11 +111,7 @@ def main():
 
     if args.resume:
         from chainermn_tpu import serializers
-        state = serializers.load_npz(args.resume, {
-            'params': updater.params, 'opt_state': updater.opt_state,
-            'iteration': 0, 'epoch': 0})
-        updater.params = comm.replicate(state['params'])
-        updater.opt_state = comm.replicate(state['opt_state'])
+        serializers.resume_updater(args.resume, updater, comm)
 
     trainer.run()
     if comm.rank == 0:

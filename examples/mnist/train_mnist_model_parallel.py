@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Model-parallel MNIST: a two-stage pipelined MLP.
+
+Rebuild of the reference
+(``examples/mnist/train_mnist_model_parallel.py``: MLP0 on rank 0,
+MLP1 on rank 1, exactly two workers).  Here the two stages are two
+devices of the mesh: ``MultiNodeChainList`` routes activations
+stage-to-stage (XLA inserts the transfers), JAX autodiff replaces the
+reference's delegate-variable backward plumbing, and the second stage's
+"empty dataset" trick (``:110-112``) is unnecessary because one
+controller feeds the whole program.
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+import chainermn_tpu  # noqa: E402
+from chainermn_tpu.datasets import mnist  # noqa: E402
+from chainermn_tpu.models import MLP  # noqa: E402
+from chainermn_tpu import training  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        description='ChainerMN-TPU MNIST model-parallel (2 stages)')
+    parser.add_argument('--batchsize', '-b', type=int, default=100)
+    parser.add_argument('--epoch', '-e', type=int, default=5)
+    parser.add_argument('--unit', '-u', type=int, default=200)
+    parser.add_argument('--out', '-o', default='result_mp')
+    parser.add_argument('--cpu', action='store_true')
+    parser.add_argument('--quick', action='store_true')
+    args = parser.parse_args()
+
+    if args.cpu:
+        flags = os.environ.get('XLA_FLAGS', '')
+        if '--xla_force_host_platform_device_count' not in flags:
+            os.environ['XLA_FLAGS'] = (
+                flags + ' --xla_force_host_platform_device_count=8').strip()
+        jax.config.update('jax_platforms', 'cpu')
+
+    n_stage_devices = min(2, jax.device_count())
+    comm = chainermn_tpu.create_communicator(
+        'xla', mesh_shape=(1, n_stage_devices),
+        devices=jax.devices()[:n_stage_devices])
+    print('Using %d devices for 2 model-parallel stages' % comm.size)
+
+    # stage 0: 784 -> unit (the reference's MLP0), lives on device 0
+    # stage 1: unit -> 10 (the reference's MLP1), lives on device 1
+    stage0 = MLP(n_units=args.unit, n_out=args.unit)
+    stage1 = MLP(n_units=args.unit, n_out=10)
+    p0 = stage0.init(jax.random.PRNGKey(0), jnp.zeros((1, 784)))
+    p1 = stage1.init(jax.random.PRNGKey(1), jnp.zeros((1, args.unit)))
+
+    model = chainermn_tpu.MultiNodeChainList(comm, place=comm.size == 2)
+    model.add_link(lambda p, x: stage0.apply(p, x), rank_in=None,
+                   rank_out=1, rank=0)
+    model.add_link(lambda p, h: stage1.apply(p, h), rank_in=0,
+                   rank_out=None, rank=1)
+
+    train, test = mnist.get_mnist()
+    if args.quick:
+        train = chainermn_tpu.dataset.SubDataset(train, 0, 500)
+        args.epoch = 1
+
+    optimizer = optax.adam(1e-3)
+    params = [p0, p1]
+    opt_state = optimizer.init(params)
+
+    @jax.jit
+    def train_step(params, opt_state, x, y):
+        def loss_fn(ps):
+            logits = model(ps, x)
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                logits, y).mean()
+            acc = jnp.mean((jnp.argmax(logits, -1) == y).astype(
+                jnp.float32))
+            return loss, acc
+        (loss, acc), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss, acc
+
+    @jax.jit
+    def eval_step(params, x, y):
+        logits = model(params, x)
+        return jnp.mean((jnp.argmax(logits, -1) == y).astype(jnp.float32))
+
+    it = training.SerialIterator(train, args.batchsize)
+    iters_per_epoch = max(1, len(train) // args.batchsize)
+    for epoch in range(args.epoch):
+        losses = []
+        for _ in range(iters_per_epoch):
+            batch = it.next()
+            x = np.stack([b[0] for b in batch])
+            y = np.stack([b[1] for b in batch])
+            params, opt_state, loss, acc = train_step(
+                params, opt_state, x, y)
+            losses.append(float(loss))
+        xs = np.stack([t[0] for t in test[0:500]])
+        ys = np.stack([t[1] for t in test[0:500]])
+        val_acc = float(eval_step(params, xs, ys))
+        print('epoch %d  mean loss %.4f  val accuracy %.4f'
+              % (epoch + 1, np.mean(losses), val_acc))
+    return val_acc
+
+
+if __name__ == '__main__':
+    main()

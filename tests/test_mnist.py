@@ -42,7 +42,7 @@ def test_mnist_convergence(tmp_path, mesh_shape):
                            lambda: updater.params, comm), comm)
     trainer.extend(evaluator, trigger=(1, 'epoch'))
     log = training.extensions.LogReport()
-    trainer.extend(log, trigger=(1, 'epoch'))
+    trainer.extend(log)
     trainer.run()
 
     acc = trainer.observation['validation/main/accuracy']

@@ -1,0 +1,115 @@
+"""Model zoo smoke tests: init/apply shapes, parameter counts in the
+expected ballpark, train/eval modes, seq2seq bucketing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from chainermn_tpu import models
+from chainermn_tpu.models.seq2seq import bucket_batches
+
+# (name, insize, rough param count in millions)
+ZOO = [
+    ('alex', 227, (55, 70)),
+    ('nin', 227, (5, 15)),
+    ('vgg16', 224, (130, 145)),
+    ('googlenet', 224, (10, 16)),
+    ('googlenetbn', 224, (8, 20)),
+    ('resnet50', 224, (23, 28)),
+]
+
+
+def _param_count(tree):
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(tree))
+
+
+@pytest.mark.parametrize('name,insize,mrange', ZOO)
+def test_zoo_forward(name, insize, mrange):
+    model = models.get_arch(name, num_classes=50, dtype=jnp.float32)
+    x = jnp.zeros((2, insize, insize, 3), jnp.float32)
+    variables = model.init(
+        {'params': jax.random.PRNGKey(0), 'dropout': jax.random.PRNGKey(1)},
+        x, train=False)
+    out = model.apply(variables, x, train=False)
+    logits = out[0] if isinstance(out, tuple) else out
+    assert logits.shape == (2, 50)
+    assert logits.dtype == jnp.float32
+    # params in the expected range for 1000 classes: re-init for 1000
+    model_full = models.get_arch(name, dtype=jnp.float32)
+    v_full = jax.eval_shape(
+        lambda: model_full.init(
+            {'params': jax.random.PRNGKey(0),
+             'dropout': jax.random.PRNGKey(1)},
+            jnp.zeros((1, insize, insize, 3)), train=False))
+    n = _param_count(v_full.get('params', v_full)) / 1e6
+    lo, hi = mrange
+    assert lo <= n <= hi, '%s has %.1fM params, expected [%d, %d]M' % (
+        name, n, lo, hi)
+
+
+def test_stateful_classifier_train_step():
+    model = models.get_arch('resnet50', num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 64, 64, 3), jnp.float32)  # small spatial for speed
+    variables = model.init({'params': jax.random.PRNGKey(0)}, x,
+                           train=False)
+    params = variables['params']
+    state = {k: v for k, v in variables.items() if k != 'params'}
+    clf = models.StatefulClassifier(model)
+    y = jnp.zeros((2,), jnp.int32)
+    (loss, (metrics, new_state)), grads = jax.value_and_grad(
+        clf.loss, has_aux=True)(params, state, jax.random.PRNGKey(2),
+                                x, y)
+    assert np.isfinite(float(loss))
+    assert 'accuracy' in metrics
+    assert 'batch_stats' in new_state
+    # batch stats actually moved
+    before = jax.tree_util.tree_leaves(state['batch_stats'])[0]
+    after = jax.tree_util.tree_leaves(new_state['batch_stats'])[0]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_googlenet_aux_heads():
+    model = models.GoogLeNet(num_classes=10, dtype=jnp.float32)
+    x = jnp.ones((2, 224, 224, 3), jnp.float32)
+    variables = model.init(
+        {'params': jax.random.PRNGKey(0), 'dropout': jax.random.PRNGKey(1)},
+        x, train=True)
+    out = model.apply(variables, x, train=True,
+                      rngs={'dropout': jax.random.PRNGKey(2)})
+    logits, (aux1, aux2) = out
+    assert logits.shape == aux1.shape == aux2.shape == (2, 10)
+
+
+def test_seq2seq_forward_and_loss():
+    model = models.Seq2seq(n_layers=1, n_source_vocab=50,
+                           n_target_vocab=60, n_units=32,
+                           dtype=jnp.float32)
+    xs = jnp.ones((4, 8), jnp.int32)
+    yin = jnp.ones((4, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), xs, yin)
+    logits = model.apply(params, xs, yin)
+    assert logits.shape == (4, 8, 60)
+    loss_fn = models.seq2seq_loss(model.apply)
+    yout = jnp.ones((4, 8), jnp.int32)
+    loss, metrics = loss_fn(params, xs, yin, yout)
+    assert np.isfinite(float(loss)) and 'perp' in metrics
+    g = jax.grad(lambda p: loss_fn(p, xs, yin, yout)[0])(params)
+    assert all(np.all(np.isfinite(np.asarray(l)))
+               for l in jax.tree_util.tree_leaves(g))
+
+
+def test_bucket_batches():
+    pairs = [([3, 4], [5]), ([3] * 30, [4] * 20), ([3] * 7, [9] * 7)]
+    buckets = bucket_batches(pairs, bucket_widths=(8, 16, 32))
+    assert set(buckets) == {8, 32}
+    xs, yin, yout = buckets[8]
+    assert xs.shape == (2, 8)
+    assert yin[0, 0] == 1  # BOS
+    assert 2 in yout[0]  # EOS
+
+
+def test_unknown_arch():
+    with pytest.raises(ValueError):
+        models.get_arch('resnet9000')

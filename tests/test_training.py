@@ -118,6 +118,61 @@ def test_trainer_iteration_trigger(tmp_path):
     assert fired == [2, 4]  # 64/16 = 4 iterations per epoch
 
 
+def test_iteration_stop_trigger_runs(tmp_path):
+    """A (N, 'iteration') stop trigger must not fire at iteration 0."""
+    tr, upd = _small_trainer(tmp_path)
+    tr.stop_trigger = training.triggers.get_trigger((3, 'iteration'))
+    tr.run()
+    assert upd.iteration == 3
+
+
+def test_log_report_averages(tmp_path):
+    tr, upd = _small_trainer(tmp_path, n_epoch=1)
+    log = extensions.LogReport()
+    tr.extend(log)
+    tr.run()
+    # 4 iterations/epoch accumulated into one entry: the logged loss is
+    # the mean, not the last batch's value
+    assert len(log.log) == 1
+    per_iter = []
+
+    tr2, upd2 = _small_trainer(tmp_path, n_epoch=1)
+    tr2.extend(lambda t: per_iter.append(t.observation['loss']),
+               trigger=(1, 'iteration'), name='probe', priority=500)
+    tr2.run()
+    assert log.log[0]['loss'] == pytest.approx(
+        sum(per_iter) / len(per_iter), rel=1e-6)
+
+
+def test_multiprocess_iterator_reset_reuse():
+    it = training.iterators.MultiprocessIterator(
+        list(range(10)), 4, repeat=False, shuffle=False)
+    first_pass = list(it)
+    it.reset()
+    second_pass = list(it)
+    assert [len(b) for b in first_pass] == [len(b) for b in second_pass] \
+        == [4, 4, 2]
+    it.finalize()
+
+
+def test_resume_updater_restores_counters(tmp_path):
+    tr, upd = _small_trainer(tmp_path, n_epoch=2)
+    tr.extend(extensions.snapshot(), trigger=(1, 'epoch'))
+    tr.run()
+    snaps = sorted(glob.glob(os.path.join(str(tmp_path), 'snapshot_*')))
+
+    tr2, upd2 = _small_trainer(tmp_path, n_epoch=2)
+    from chainermn_tpu import serializers
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    serializers.resume_updater(snaps[-1], upd2, comm)
+    assert upd2.iteration == upd.iteration
+    assert upd2.epoch == upd.epoch
+    for a, b in zip(jax.tree_util.tree_leaves(upd2.params),
+                    jax.tree_util.tree_leaves(upd.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6)
+
+
 def test_updater_batch_divisibility(tmp_path):
     comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
     ds = _toy_dataset(30)
