@@ -23,5 +23,6 @@ from chainermn_tpu.datasets import create_empty_dataset  # noqa
 from chainermn_tpu.link import MultiNodeChainList  # noqa
 from chainermn_tpu.multi_node_evaluator import create_multi_node_evaluator  # noqa
 from chainermn_tpu.multi_node_optimizer import create_multi_node_optimizer  # noqa
+from chainermn_tpu import utils  # noqa
 
 __version__ = '0.1.0'

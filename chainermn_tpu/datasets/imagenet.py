@@ -45,7 +45,10 @@ class PreprocessedDataset:
             left = (w - crop) // 2
         image = image[top:top + crop, left:left + crop, :]
         if self.mean is not None:
-            image = image - self.mean[:crop, :crop, :]
+            # mean window tracks the crop window (reference
+            # `train_imagenet.py:79-80`: mean[:, top:bottom, left:right])
+            image = image - self.mean[top:top + crop,
+                                      left:left + crop, :]
         image = image * (1.0 / 255.0)
         return image.astype(np.float32), np.int32(label)
 
@@ -110,6 +113,70 @@ def get_imagenet(train_size=1280, val_size=128, size=256):
         return train, val
     return (SyntheticImageNet(train_size, size=size),
             SyntheticImageNet(val_size, size=size, seed=99))
+
+
+class BatchAugmentPipeline:
+    """Batch-level augmentation over a contiguous preloaded sample
+    store, using the native C++ thread-pool kernel when built
+    (``csrc/chainermn_core.cpp`` ``cmn_augment_batch``) and numpy
+    otherwise.
+
+    The native path replaces the reference's worker *processes*
+    (``train_imagenet.py:174-182`` MultiprocessIterator + forkserver):
+    same crop/flip/mean-subtract math, but parallel C threads over
+    shared memory instead of pickled IPC.
+    """
+
+    def __init__(self, dataset, crop_size, mean=None, random=True,
+                 scale=1.0 / 255.0, seed=0):
+        first, _ = dataset[0]
+        self._store = np.empty((len(dataset),) + np.shape(first),
+                               np.float32)
+        self._labels = np.empty(len(dataset), np.int32)
+        for i in range(len(dataset)):
+            img, label = dataset[i]
+            self._store[i] = img
+            self._labels[i] = label
+        self.crop_size = crop_size
+        self.mean = (np.ascontiguousarray(mean, np.float32)
+                     if mean is not None else None)
+        self.random = random
+        self.scale = scale
+        self._rng = np.random.RandomState(seed)
+
+    def __len__(self):
+        return len(self._store)
+
+    def batch(self, indices):
+        """(images (B, crop, crop, C) float32, labels (B,) int32)."""
+        b = len(indices)
+        h, w = self._store.shape[1:3]
+        crop = self.crop_size
+        if self.random:
+            tops = self._rng.randint(0, h - crop + 1, b).astype(np.int32)
+            lefts = self._rng.randint(0, w - crop + 1, b).astype(np.int32)
+            flips = (self._rng.rand(b) > 0.5).astype(np.uint8)
+        else:
+            tops = np.full(b, (h - crop) // 2, np.int32)
+            lefts = np.full(b, (w - crop) // 2, np.int32)
+            flips = np.zeros(b, np.uint8)
+        labels = self._labels[np.asarray(indices, np.int64)]
+        from chainermn_tpu import native
+        if native.available:
+            images = native.augment_batch(
+                self._store, indices, tops, lefts, flips, crop,
+                mean=self.mean, scale=self.scale)
+            return images, labels
+        images = np.empty((b, crop, crop, self._store.shape[3]),
+                          np.float32)
+        for i, idx in enumerate(indices):
+            t, l = tops[i], lefts[i]
+            win = self._store[idx][t:t + crop, l:l + crop]
+            if self.mean is not None:
+                win = win - self.mean[t:t + crop, l:l + crop]
+            win = win * self.scale
+            images[i] = win[:, ::-1] if flips[i] else win
+        return images, labels
 
 
 def compute_mean(dataset, limit=256):

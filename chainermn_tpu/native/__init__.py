@@ -1,0 +1,13 @@
+"""ctypes bindings for the native runtime core (``csrc/``).
+
+Parity role: the reference's only native component is the Cython NCCL
+binding (``chainermn/nccl/nccl.pyx``), optional at build and import
+time (``nccl/__init__.py:1-9`` sets ``_available``).  Same contract
+here: if ``libchainermn_core.so`` is absent we try one on-demand g++
+build, and otherwise degrade gracefully (``available = False``; pure
+-Python fallbacks everywhere).
+"""
+
+from chainermn_tpu.native.core import (  # noqa
+    available, Arena, NativeCommunicator, CommError, augment_batch,
+    pack_arrays, unpack_arrays, lib_path)

@@ -1,0 +1,303 @@
+"""Loader + pythonic wrappers for ``libchainermn_core.so``."""
+
+import ctypes
+import os
+import subprocess
+import uuid
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, os.pardir, os.pardir, 'csrc',
+                    'chainermn_core.cpp')
+_SO = os.path.join(_HERE, 'libchainermn_core.so')
+
+_STATUS = ['success', 'unhandled error', 'system error', 'internal error',
+           'invalid argument', 'invalid usage', 'buffer overflow',
+           'timeout', 'rank mismatch']
+
+# dtype tables (mirror the enums in chainermn_core.cpp; the reference's
+# analogous table is nccl.pyx:79-91)
+_OPS = {'sum': 0, 'prod': 1, 'max': 2, 'min': 3}
+_DTYPES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
+           np.dtype(np.int32): 2, np.dtype(np.int64): 3}
+
+
+class CommError(RuntimeError):
+    """Parity: NcclError (nccl.pyx:94-104)."""
+
+    def __init__(self, status):
+        self.status = status
+        msg = (_STATUS[status] if 0 <= status < len(_STATUS)
+               else 'unknown error')
+        super().__init__('%s (status=%d)' % (msg, status))
+
+
+def _build():
+    cmd = ['g++', '-O3', '-std=c++17', '-shared', '-fPIC', '-pthread',
+           os.path.abspath(_SRC), '-o', _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _load():
+    src_mtime = os.path.getmtime(_SRC) if os.path.exists(_SRC) else 0
+    stale = (os.path.exists(_SO)
+             and os.path.getmtime(_SO) < src_mtime)
+    if (not os.path.exists(_SO) or stale) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    lib.cmn_error_string.restype = ctypes.c_char_p
+    lib.cmn_error_string.argtypes = [ctypes.c_int]
+    lib.cmn_arena_create.restype = ctypes.c_void_p
+    lib.cmn_arena_assign.argtypes = [ctypes.c_void_p, ctypes.c_size_t]
+    lib.cmn_arena_ptr.restype = ctypes.c_void_p
+    lib.cmn_arena_ptr.argtypes = [ctypes.c_void_p]
+    lib.cmn_arena_capacity.restype = ctypes.c_size_t
+    lib.cmn_arena_capacity.argtypes = [ctypes.c_void_p]
+    lib.cmn_arena_destroy.argtypes = [ctypes.c_void_p]
+    for name in ('cmn_pack', 'cmn_unpack'):
+        fn = getattr(lib, name)
+        fn.argtypes = [ctypes.c_void_p,
+                       ctypes.POINTER(ctypes.c_void_p),
+                       ctypes.POINTER(ctypes.c_size_t), ctypes.c_int]
+    lib.cmn_augment_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint8),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p, ctypes.c_float,
+        ctypes.c_void_p]
+    lib.cmn_comm_create.restype = ctypes.c_void_p
+    lib.cmn_comm_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int, ctypes.c_int64,
+                                    ctypes.c_double]
+    lib.cmn_comm_destroy.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.cmn_comm_rank.argtypes = [ctypes.c_void_p]
+    lib.cmn_comm_size.argtypes = [ctypes.c_void_p]
+    lib.cmn_allreduce.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_void_p, ctypes.c_int64,
+                                  ctypes.c_int, ctypes.c_int]
+    lib.cmn_reduce.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_int, ctypes.c_int, ctypes.c_int]
+    lib.cmn_bcast.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_int64, ctypes.c_int, ctypes.c_int]
+    lib.cmn_reduce_scatter.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                       ctypes.c_void_p, ctypes.c_int64,
+                                       ctypes.c_int, ctypes.c_int]
+    lib.cmn_allgather.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                  ctypes.c_void_p, ctypes.c_int64,
+                                  ctypes.c_int]
+    lib.cmn_barrier.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+_lib = _load()
+available = _lib is not None
+lib_path = _SO if available else None
+
+
+def _check(status):
+    if status != 0:
+        raise CommError(status)
+
+
+def _as_void_p_array(arrays):
+    ptrs = (ctypes.c_void_p * len(arrays))()
+    sizes = (ctypes.c_size_t * len(arrays))()
+    for i, a in enumerate(arrays):
+        ptrs[i] = a.ctypes.data_as(ctypes.c_void_p)
+        sizes[i] = a.nbytes
+    return ptrs, sizes
+
+
+class Arena:
+    """Grow-only aligned host buffer (parity: DeviceMemory,
+    ``_memory_utility.py:43-74``)."""
+
+    def __init__(self):
+        if not available:
+            raise RuntimeError('native core unavailable')
+        self._h = _lib.cmn_arena_create()
+
+    @property
+    def capacity(self):
+        return _lib.cmn_arena_capacity(self._h)
+
+    def assign(self, nbytes):
+        _check(_lib.cmn_arena_assign(self._h, nbytes))
+
+    def asarray(self, nbytes, dtype=np.uint8):
+        """numpy view of the first ``nbytes`` bytes."""
+        self.assign(nbytes)
+        ptr = _lib.cmn_arena_ptr(self._h)
+        buf = (ctypes.c_uint8 * nbytes).from_address(ptr)
+        return np.frombuffer(buf, dtype=dtype)
+
+    def __del__(self):
+        if getattr(self, '_h', None):
+            _lib.cmn_arena_destroy(self._h)
+            self._h = None
+
+
+def pack_arrays(arrays, arena=None):
+    """Fuse a list of contiguous numpy arrays into one flat buffer
+    (parity: pack_params, ``_memory_utility.py:77-83``)."""
+    arrays = [np.ascontiguousarray(a) for a in arrays]
+    total = sum(a.nbytes for a in arrays)
+    if arena is None:
+        out = np.empty(total, np.uint8)
+    else:
+        out = arena.asarray(total)
+    ptrs, sizes = _as_void_p_array(arrays)
+    _check(_lib.cmn_pack(out.ctypes.data_as(ctypes.c_void_p), ptrs,
+                         sizes, len(arrays)))
+    return out
+
+
+def unpack_arrays(flat, templates):
+    """Scatter a packed buffer back into arrays shaped like
+    ``templates`` (parity: unpack_params,
+    ``_memory_utility.py:86-92``)."""
+    outs = [np.empty_like(np.ascontiguousarray(t)) for t in templates]
+    ptrs, sizes = _as_void_p_array(outs)
+    _check(_lib.cmn_unpack(flat.ctypes.data_as(ctypes.c_void_p), ptrs,
+                           sizes, len(outs)))
+    return outs
+
+
+def augment_batch(samples, indices, tops, lefts, flips, crop, mean=None,
+                  scale=1.0 / 255.0, out=None):
+    """Parallel crop+flip+mean-subtract+scale.
+
+    samples: (N, H, W, C) float32 contiguous; indices/tops/lefts/flips:
+    per-batch-item source sample and augmentation; returns
+    (B, crop, crop, C) float32.
+    """
+    samples = np.ascontiguousarray(samples, np.float32)
+    n, h, w, c = samples.shape
+    b = len(indices)
+    indices = np.ascontiguousarray(indices, np.int64)
+    tops = np.ascontiguousarray(tops, np.int32)
+    lefts = np.ascontiguousarray(lefts, np.int32)
+    flips = np.ascontiguousarray(flips, np.uint8)
+    if out is None:
+        out = np.empty((b, crop, crop, c), np.float32)
+    mean_ptr = None
+    if mean is not None:
+        mean = np.ascontiguousarray(mean, np.float32)
+        if mean.shape != (h, w, c):
+            raise ValueError('mean shape %r != sample shape %r'
+                             % (mean.shape, (h, w, c)))
+        mean_ptr = mean.ctypes.data_as(ctypes.c_void_p)
+    _check(_lib.cmn_augment_batch(
+        samples.ctypes.data_as(ctypes.c_void_p), h, w, c,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        tops.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        lefts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        flips.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        b, crop, mean_ptr, scale,
+        out.ctypes.data_as(ctypes.c_void_p)))
+    return out
+
+
+class NativeCommunicator:
+    """Shared-memory host collective engine.
+
+    Parity surface with the reference's ``NcclCommunicator``
+    (``nccl.pyx:118-199``): 5 collectives + comm-id handshake + error
+    taxonomy, for same-host multi-process object/metric reduction.
+    On-device collectives are XLA's job; this is the eager host path.
+    """
+
+    @staticmethod
+    def make_comm_id():
+        """Parity: ncclGetUniqueId (nccl.pyx:107-115)."""
+        return '/cmn-' + uuid.uuid4().hex[:24]
+
+    def __init__(self, comm_id, n_ranks, rank, slot_bytes=1 << 20,
+                 timeout=60.0):
+        if not available:
+            raise RuntimeError('native core unavailable')
+        self._h = None
+        h = _lib.cmn_comm_create(comm_id.encode(), n_ranks, rank,
+                                 slot_bytes, timeout)
+        if not h:
+            raise CommError(2)
+        self._h = h
+        self._rank = rank
+        self._size = n_ranks
+        self._owner = rank == 0
+
+    rank = property(lambda self: self._rank)
+    size = property(lambda self: self._size)
+
+    def _buf(self, arr):
+        return arr.ctypes.data_as(ctypes.c_void_p)
+
+    def _dtype(self, arr):
+        try:
+            return _DTYPES[arr.dtype]
+        except KeyError:
+            raise CommError(4)
+
+    def allreduce(self, arr, op='sum'):
+        arr = np.ascontiguousarray(arr)
+        out = np.empty_like(arr)
+        _check(_lib.cmn_allreduce(self._h, self._buf(arr), self._buf(out),
+                                  arr.size, self._dtype(arr), _OPS[op]))
+        return out
+
+    def reduce(self, arr, op='sum', root=0):
+        arr = np.ascontiguousarray(arr)
+        out = np.empty_like(arr) if self._rank == root else None
+        _check(_lib.cmn_reduce(
+            self._h, self._buf(arr),
+            self._buf(out) if out is not None else None,
+            arr.size, self._dtype(arr), _OPS[op], root))
+        return out
+
+    def bcast(self, arr, root=0):
+        arr = np.ascontiguousarray(arr).copy()
+        _check(_lib.cmn_bcast(self._h, self._buf(arr), arr.size,
+                              self._dtype(arr), root))
+        return arr
+
+    def reduce_scatter(self, arr, op='sum'):
+        arr = np.ascontiguousarray(arr)
+        if arr.size % self._size:
+            raise CommError(4)
+        recvcount = arr.size // self._size
+        out = np.empty(recvcount, arr.dtype)
+        _check(_lib.cmn_reduce_scatter(self._h, self._buf(arr),
+                                       self._buf(out), recvcount,
+                                       self._dtype(arr), _OPS[op]))
+        return out
+
+    def allgather(self, arr):
+        arr = np.ascontiguousarray(arr)
+        out = np.empty(arr.size * self._size, arr.dtype)
+        _check(_lib.cmn_allgather(self._h, self._buf(arr),
+                                  self._buf(out), arr.size,
+                                  self._dtype(arr)))
+        return out
+
+    def barrier(self):
+        _check(_lib.cmn_barrier(self._h))
+
+    def destroy(self):
+        if self._h:
+            _lib.cmn_comm_destroy(self._h, 1 if self._owner else 0)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

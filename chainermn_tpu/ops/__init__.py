@@ -1,0 +1,23 @@
+"""Pallas TPU kernels for the hot ops.
+
+The reference has no kernel layer -- its FLOPs live in Chainer/CuPy and
+its only native code is the NCCL binding (``chainermn/nccl/nccl.pyx``).
+On TPU the compute path is XLA, and the ops worth hand-scheduling are
+the ones XLA fuses poorly: attention (materializes the (T, T) score
+matrix), large-vocab softmax cross-entropy (materializes probabilities),
+and whole-model elementwise optimizer sweeps (one HBM pass per param
+tensor instead of one fused pass).
+
+Every op has a pure-``jnp`` reference implementation used (a) as the
+numerics oracle in tests and (b) as the fallback on non-TPU backends
+where the Mosaic compiler is unavailable; there the Pallas path runs in
+interpret mode only when explicitly requested
+(``CHAINERMN_TPU_PALLAS_INTERPRET=1``).
+"""
+
+from chainermn_tpu.ops.flash_attention import (  # noqa
+    flash_attention, mha_reference)
+from chainermn_tpu.ops.cross_entropy import (  # noqa
+    softmax_cross_entropy, softmax_cross_entropy_reference)
+from chainermn_tpu.ops.layer_norm import layer_norm, layer_norm_reference  # noqa
+from chainermn_tpu.ops.optimizer import fused_momentum_sgd, momentum_sgd  # noqa

@@ -1,0 +1,31 @@
+"""Shared plumbing for the Pallas kernel layer."""
+
+import os
+
+import jax
+
+NEG_INF = -1e30
+
+
+def pallas_mode():
+    """How to run Pallas kernels on this backend.
+
+    Returns one of:
+      'native'    -- real Mosaic compilation (TPU backend)
+      'interpret' -- Pallas interpreter (correct but slow; opt-in on
+                     CPU via CHAINERMN_TPU_PALLAS_INTERPRET=1)
+      'fallback'  -- do not use Pallas; callers take the jnp path
+    """
+    if jax.default_backend() == 'tpu':
+        return 'native'
+    if os.environ.get('CHAINERMN_TPU_PALLAS_INTERPRET'):
+        return 'interpret'
+    return 'fallback'
+
+
+def use_pallas():
+    return pallas_mode() != 'fallback'
+
+
+def interpret_flag():
+    return pallas_mode() == 'interpret'

@@ -1,0 +1,276 @@
+"""Fused blockwise (flash) attention for TPU.
+
+The reference has no attention anywhere (era-appropriate CNN/MLP
+workloads only; SURVEY 5 "long-context: absent") -- this op is part of
+the long-context surface that is first-class here.  Design follows the
+standard flash-attention recurrence (running max ``m``, rescaled
+numerator/denominator), tiled so each (query-block, key-block) score
+tile lives only in VMEM and the (T, T) matrix is never materialized in
+HBM.  The MXU sees two large matmuls per tile; masking and the softmax
+bookkeeping ride the VPU.
+
+Layout: inputs are (B, T, H, D) like the rest of the framework; the
+kernel grid is (B*H, T/block_q) with the full K/V stream per grid row.
+
+The backward pass is the standard flash backward, expressed blockwise
+with ``lax.scan`` over key blocks (memory O(T * block) -- XLA fuses it
+well; a hand-written Mosaic backward is a further optimization, not a
+correctness need).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.ops._common import NEG_INF, interpret_flag, pallas_mode
+
+
+def mha_reference(q, k, v, causal=False, scale=None):
+    """Pure-jnp oracle: full softmax attention. (B, T, H, D) in/out."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = scores.shape[-2:]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', p.astype(v.dtype), v)
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale, causal, kv_len, block_q, block_k, t_kv):
+    """One (batch*head, query-block) grid cell; streams key blocks.
+
+    ``kv_len`` (static) masks out padded key positions >= kv_len.
+    """
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
+    n_blocks = t_kv // block_k
+    if causal:
+        # key blocks strictly after this query block contribute nothing
+        n_blocks = jnp.minimum(
+            n_blocks, pl.cdiv((qi + 1) * block_q, block_k))
+
+    d = q.shape[-1]
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    masked = causal or kv_len < t_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (block_q, block_k)
+        if masked:
+            q_pos = (qi * block_q
+                     + lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0))
+            k_pos = (j * block_k
+                     + lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1))
+            ok = k_pos < kv_len
+            if causal:
+                ok = jnp.logical_and(ok, q_pos >= k_pos)
+            s = jnp.where(ok, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
+
+
+def _fwd_pallas(q, k, v, causal, scale, kv_len, block_q, block_k):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    grid = (bh, t_q // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          kv_len=kv_len, block_q=block_q,
+                          block_k=block_k, t_kv=t_kv),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
+        ],
+        interpret=interpret_flag(),
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+def _fwd_blockwise_jnp(q, k, v, causal, scale, kv_len, block_k):
+    """Fallback forward: same recurrence as the kernel, via lax.scan."""
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    qf = q.astype(jnp.float32) * scale
+    n_blocks = t_kv // block_k
+    kb = k.reshape(bh, n_blocks, block_k, d).astype(jnp.float32)
+    vb = v.reshape(bh, n_blocks, block_k, d).astype(jnp.float32)
+
+    def body(carry, inp):
+        m, l, acc = carry
+        j, kj, vj = inp
+        s = jnp.einsum('bqd,bkd->bqk', qf, kj)
+        q_pos = jnp.arange(t_q)[:, None]
+        k_pos = j * block_k + jnp.arange(block_k)[None, :]
+        ok = k_pos < kv_len
+        if causal:
+            ok = jnp.logical_and(ok, q_pos >= k_pos)
+        s = jnp.where(ok, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum('bqk,bkd->bqd', p, vj)
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((bh, t_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, t_q), jnp.float32)
+    acc0 = jnp.zeros((bh, t_q, d), jnp.float32)
+    (m, l, acc), _ = lax.scan(
+        body, (m0, l0, acc0),
+        (jnp.arange(n_blocks), jnp.swapaxes(kb, 0, 1),
+         jnp.swapaxes(vb, 0, 1)))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    return out, m + jnp.log(l_safe)
+
+
+# ----------------------------------------------------------------------
+# backward (blockwise, lax.scan over key blocks)
+# ----------------------------------------------------------------------
+
+def _bwd_blockwise(q, k, v, out, lse, g, causal, scale, kv_len, block_k):
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    n_blocks = t_kv // block_k
+    qf = q.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * out.astype(jnp.float32), axis=-1)   # (bh, t_q)
+    kb = jnp.swapaxes(k.reshape(bh, n_blocks, block_k, d), 0, 1)
+    vb = jnp.swapaxes(v.reshape(bh, n_blocks, block_k, d), 0, 1)
+
+    def body(dq, inp):
+        j, kj, vj = inp
+        kjf = kj.astype(jnp.float32)
+        s = jnp.einsum('bqd,bkd->bqk', qf, kjf) * scale
+        q_pos = jnp.arange(t_q)[:, None]
+        k_pos = j * block_k + jnp.arange(block_k)[None, :]
+        ok = k_pos < kv_len
+        if causal:
+            ok = jnp.logical_and(ok, q_pos >= k_pos)
+        s = jnp.where(ok, s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                      # (bh, tq, bk)
+        dp = jnp.einsum('bqd,bkd->bqk', gf, vj.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum('bqk,bkd->bqd', ds, kjf)
+        dkj = jnp.einsum('bqk,bqd->bkd', ds, qf)
+        dvj = jnp.einsum('bqk,bqd->bkd', p, gf)
+        return dq, (dkj, dvj)
+
+    dq0 = jnp.zeros((bh, t_q, d), jnp.float32)
+    dq, (dk, dv) = lax.scan(
+        body, dq0, (jnp.arange(n_blocks), kb, vb))
+    dk = jnp.swapaxes(dk, 0, 1).reshape(bh, t_kv, d)
+    dv = jnp.swapaxes(dv, 0, 1).reshape(bh, t_kv, d)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# ----------------------------------------------------------------------
+# public op
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, kv_len, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, causal, scale, kv_len, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale, kv_len, block_q, block_k):
+    if pallas_mode() == 'fallback':
+        out, lse = _fwd_blockwise_jnp(q, k, v, causal, scale, kv_len,
+                                      block_k)
+    else:
+        out, lse = _fwd_pallas(q, k, v, causal, scale, kv_len,
+                               block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, scale, kv_len, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    return _bwd_blockwise(q, k, v, out, lse, g, causal, scale, kv_len,
+                          block_k)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=128, block_k=128):
+    """Fused attention. q: (B, Tq, H, D), k/v: (B, Tkv, H, D).
+
+    Sequence lengths are padded to kernel block multiples internally
+    (padded keys are masked out; padded query rows are dropped); with
+    ``causal=True``, Tq must equal Tkv (self-attention).
+    """
+    b, t_q, h, d = q.shape
+    t_kv = k.shape[1]
+    if causal and t_q != t_kv:
+        raise ValueError('causal attention requires t_q == t_kv, got '
+                         '%d vs %d' % (t_q, t_kv))
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, max(t_q, 1))
+    block_k = min(block_k, max(t_kv, 1))
+
+    def merge(x):
+        # (B, T, H, D) -> (B*H, T, D)
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    pad_q = (-t_q) % block_q
+    pad_k = (-t_kv) % block_k
+    qm, km, vm = merge(q), merge(k), merge(v)
+    if pad_q:
+        qm = jnp.pad(qm, ((0, 0), (0, pad_q), (0, 0)))
+    if pad_k:
+        km = jnp.pad(km, ((0, 0), (0, pad_k), (0, 0)))
+        vm = jnp.pad(vm, ((0, 0), (0, pad_k), (0, 0)))
+    out = _flash(qm, km, vm, causal, scale, t_kv, block_q, block_k)
+    out = out[:, :t_q]
+    return jnp.swapaxes(out.reshape(b, h, t_q, d), 1, 2)

@@ -34,8 +34,11 @@ def row_parallel_dense(x_local, w, axis, b=None):
     return y
 
 
-def tp_mlp(x, w_in, b_in, w_out, b_out, axis, activation=None):
-    """Column->activation->row feed-forward with one psum total."""
+def tp_mlp(x, w_in, b_in, w_out, b_out, axis, activation=jnp.tanh):
+    """Column->activation->row feed-forward with one psum total.
+
+    Pass ``activation=None`` for a purely linear block."""
     h = column_parallel_dense(x, w_in, b_in)
-    h = activation(h) if activation is not None else jnp.tanh(h)
+    if activation is not None:
+        h = activation(h)
     return row_parallel_dense(h, w_out, axis, b_out)

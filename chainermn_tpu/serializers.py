@@ -95,8 +95,11 @@ def resume_updater(path, updater, comm):
     if 'model_state' in template:
         updater.model_state = comm.replicate(state['model_state'])
     updater.iteration = int(state['iteration'])
-    if hasattr(updater.iterator, 'epoch'):
-        updater.iterator.epoch = int(state['epoch'])
+    it = updater.iterator
+    if hasattr(it, 'restore_epoch'):
+        it.restore_epoch(int(state['epoch']))
+    elif hasattr(it, 'epoch'):
+        it.epoch = int(state['epoch'])
     return state
 
 

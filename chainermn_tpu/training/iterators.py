@@ -36,6 +36,10 @@ class SerialIterator:
         return (self._rng.permutation(n) if self._shuffle
                 else np.arange(n))
 
+    def restore_epoch(self, epoch):
+        """Continue epoch accounting from a checkpoint."""
+        self.epoch = int(epoch)
+
     @property
     def epoch_detail(self):
         return self.epoch + self._pos / max(1, len(self.dataset))
@@ -102,21 +106,32 @@ class MultiprocessIterator:
     def _start_worker(self):
         self._queue = queue_mod.Queue(maxsize=self._n_prefetch)
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._worker, daemon=True)
+        # the worker captures ITS OWN queue/stop: a worker that
+        # outlives a reset (join timeout) keeps observing its original,
+        # set stop event and abandoned queue rather than the
+        # replacements, so it can never race the new worker on the
+        # shared inner iterator once it finishes its in-flight batch
+        self._thread = threading.Thread(
+            target=self._worker, args=(self._queue, self._stop),
+            daemon=True)
         self._thread.start()
+
+    def _stop_worker(self):
+        self._stop.set()
+        # drain so a producer blocked on put() can observe the stop flag
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue_mod.Empty:
+                pass
+            self._thread.join(timeout=0.2)
 
     def reset(self):
         """Stop the current producer and restart from a fresh pass
         (needed for repeat=False evaluation iterators reused across
         epochs)."""
-        self._stop.set()
-        # drain so a blocked producer can observe the stop flag
-        try:
-            while True:
-                self._queue.get_nowait()
-        except queue_mod.Empty:
-            pass
-        self._thread.join(timeout=5)
+        self._stop_worker()
         self._inner.reset()
         self.epoch = 0
         self.iteration = 0
@@ -124,19 +139,37 @@ class MultiprocessIterator:
         self._consumed_pos = 0
         self._start_worker()
 
-    def _worker(self):
+    def restore_epoch(self, epoch):
+        """Continue epoch accounting from a checkpoint: the producer's
+        counters are rebased so prefetched tuples carry the restored
+        epoch (plain attribute assignment would be overwritten by the
+        next ``__next__``)."""
+        self._stop_worker()
+        self._inner.epoch = int(epoch)
+        self.epoch = int(epoch)
+        self._start_worker()
+
+    def _worker(self, out_queue, stop):
         inner = self._inner
         try:
-            while not self._stop.is_set():
+            while not stop.is_set():
                 try:
                     batch = next(inner)
                 except StopIteration:
-                    self._queue.put(StopIteration)
+                    out_queue.put(StopIteration)
                     return
-                self._queue.put((batch, inner.epoch, inner.iteration,
-                                 inner.is_new_epoch, inner._pos))
+                item = (batch, inner.epoch, inner.iteration,
+                        inner.is_new_epoch, inner._pos)
+                # bounded put so a stale worker parks on stop, not on a
+                # full abandoned queue
+                while not stop.is_set():
+                    try:
+                        out_queue.put(item, timeout=0.2)
+                        break
+                    except queue_mod.Full:
+                        continue
         except Exception as e:  # surface worker failures to the consumer
-            self._queue.put(e)
+            out_queue.put(e)
 
     def __iter__(self):
         return self

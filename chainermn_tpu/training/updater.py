@@ -106,8 +106,8 @@ class StandardUpdater:
         jit_kwargs = {'donate_argnums': (0, 1, 2)} if donate else {}
         return jax.jit(mapped_call, static_argnums=(), **jit_kwargs)
 
-    def update(self):
-        batch = next(self.iterator)
+    def shard_batch(self, batch):
+        """Collate a list of examples and place it sharded on the mesh."""
         arrays = concat_examples(batch)
         if isinstance(arrays, dict):
             arrays = tuple(arrays.values())
@@ -116,7 +116,12 @@ class StandardUpdater:
             raise ValueError(
                 'global batch size %d must be divisible by mesh size %d'
                 % (n, self.comm.size))
-        arrays = self.comm.shard_batch(arrays)
+        return self.comm.shard_batch(arrays)
+
+    def update_core(self, arrays):
+        """Advance one iteration on already-sharded device arrays;
+        returns device-resident metrics (no host sync -- steps can
+        overlap)."""
         # stateless path reuses the cached key (the step ignores it)
         step_rng = (jax.random.fold_in(self._rng, self.iteration)
                     if self._has_state else self._rng)
@@ -124,6 +129,10 @@ class StandardUpdater:
             self._step(self.params, self.model_state, self.opt_state,
                        step_rng, *arrays)
         self.iteration += 1
+        return metrics
+
+    def update(self):
+        metrics = self.update_core(self.shard_batch(next(self.iterator)))
         return {k: float(v) for k, v in metrics.items()}
 
     # epoch accounting is delegated to the iterator

@@ -1,0 +1,3 @@
+"""Runtime utilities: platform setup, profiling, failure detection."""
+
+from chainermn_tpu.utils.platform import force_host_devices  # noqa

@@ -1,0 +1,22 @@
+"""Platform/backend setup helpers.
+
+The multi-device CPU simulation the test harness and examples use
+(the TPU-native analogue of the reference's ``mpiexec -n N`` CPU
+matrix, ``.travis.yml:55``).
+"""
+
+import os
+
+import jax
+
+
+def force_host_devices(n=8):
+    """Switch this process to the CPU backend with ``n`` virtual
+    devices.  Must run before first backend use; safe to call when the
+    flag is already present."""
+    flags = os.environ.get('XLA_FLAGS', '')
+    if '--xla_force_host_platform_device_count' not in flags:
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=%d' % n
+        ).strip()
+    jax.config.update('jax_platforms', 'cpu')

@@ -45,12 +45,7 @@ def main():
     args = parser.parse_args()
 
     if args.cpu:
-        # virtual 8-device mesh; must precede first backend use
-        flags = os.environ.get('XLA_FLAGS', '')
-        if '--xla_force_host_platform_device_count' not in flags:
-            os.environ['XLA_FLAGS'] = (
-                flags + ' --xla_force_host_platform_device_count=8').strip()
-        jax.config.update('jax_platforms', 'cpu')
+        chainermn_tpu.utils.force_host_devices(8)
 
     mesh_shape = None
     if args.mesh:

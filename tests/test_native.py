@@ -1,0 +1,153 @@
+"""Native runtime core (csrc/chainermn_core.cpp via ctypes).
+
+Parity model: the reference tests its native path (NCCL) only behind
+``@attr.nccl`` gates on real GPUs; here the native core is
+host-side, so it is exercised unconditionally -- including the
+collective engine across REAL spawned processes (the analogue of the
+reference's ``mpiexec -n 3`` matrix).
+"""
+
+import multiprocessing as mp
+
+import numpy as np
+import pytest
+
+from chainermn_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available, reason='native core not built')
+
+
+class TestArenaPack:
+    def test_arena_grow_only(self):
+        a = native.Arena()
+        a.assign(100)
+        cap = a.capacity
+        assert cap >= 100
+        a.assign(50)  # no shrink
+        assert a.capacity == cap
+        a.assign(1000)
+        assert a.capacity >= 1000
+
+    def test_pack_unpack_roundtrip(self):
+        rng = np.random.RandomState(0)
+        arrays = [rng.rand(17).astype(np.float32),
+                  rng.rand(3, 5).astype(np.float32),
+                  (rng.rand(2, 2, 2) * 100).astype(np.int32)]
+        flat = native.pack_arrays(arrays)
+        assert flat.nbytes == sum(a.nbytes for a in arrays)
+        back = native.unpack_arrays(flat, arrays)
+        for a, b in zip(arrays, back):
+            np.testing.assert_array_equal(a, b.reshape(a.shape))
+
+    def test_pack_into_arena(self):
+        arena = native.Arena()
+        arrays = [np.ones(4, np.float32), np.zeros(6, np.float32)]
+        flat = native.pack_arrays(arrays, arena=arena)
+        assert flat.nbytes == 40
+
+
+class TestAugment:
+    def test_matches_numpy_oracle(self):
+        rng = np.random.RandomState(1)
+        samples = rng.rand(5, 12, 14, 3).astype(np.float32)
+        mean = samples.mean(axis=0)
+        crop = 8
+        idx = [4, 0, 2]
+        tops, lefts, flips = [1, 0, 4], [3, 6, 0], [0, 1, 1]
+        out = native.augment_batch(samples, idx, tops, lefts, flips,
+                                   crop, mean=mean, scale=0.5)
+        for i in range(3):
+            t, l = tops[i], lefts[i]
+            win = (samples[idx[i]][t:t + crop, l:l + crop]
+                   - mean[t:t + crop, l:l + crop]) * 0.5
+            if flips[i]:
+                win = win[:, ::-1]
+            np.testing.assert_allclose(out[i], win, atol=1e-6)
+
+    def test_no_mean(self):
+        samples = np.full((1, 4, 4, 1), 255.0, np.float32)
+        out = native.augment_batch(samples, [0], [0], [0], [0], 4)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_bad_crop_rejected(self):
+        samples = np.zeros((1, 4, 4, 1), np.float32)
+        with pytest.raises(native.CommError):
+            native.augment_batch(samples, [0], [3], [3], [0], 4)
+
+
+def _collective_worker(comm_id, n, rank, q):
+    try:
+        c = native.NativeCommunicator(comm_id, n, rank,
+                                      slot_bytes=1 << 14, timeout=30.0)
+        x = np.arange(6, dtype=np.float32) + rank
+        results = {
+            'allreduce': c.allreduce(x, 'sum'),
+            'reduce': c.reduce(x, 'max', root=0),
+            'bcast': c.bcast(x if rank == 1
+                             else np.zeros(6, np.float32), root=1),
+            'reduce_scatter': c.reduce_scatter(
+                np.arange(n * 2, dtype=np.float32) + rank, 'sum'),
+            'allgather': c.allgather(np.array([rank], np.float64)),
+        }
+        c.barrier()
+        c.destroy()
+        q.put((rank, results))
+    except Exception as e:  # pragma: no cover - surfaced in assert
+        q.put((rank, repr(e)))
+
+
+class TestNativeCommunicator:
+    def test_collectives_across_processes(self):
+        ctx = mp.get_context('spawn')
+        n = 3
+        comm_id = native.NativeCommunicator.make_comm_id()
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_collective_worker,
+                             args=(comm_id, n, r, q)) for r in range(n)]
+        for p in procs:
+            p.start()
+        results = dict(q.get(timeout=90) for _ in range(n))
+        for p in procs:
+            p.join(timeout=30)
+        errs = {r: v for r, v in results.items() if isinstance(v, str)}
+        assert not errs, errs
+        base = np.arange(6, dtype=np.float32)
+        offset = sum(range(n))
+        for r in range(n):
+            np.testing.assert_array_equal(
+                results[r]['allreduce'], base * n + offset)
+            np.testing.assert_array_equal(results[r]['bcast'], base + 1)
+            np.testing.assert_array_equal(
+                results[r]['reduce_scatter'],
+                np.arange(n * 2, dtype=np.float32)[r * 2:(r + 1) * 2] * n
+                + offset)
+            np.testing.assert_array_equal(
+                results[r]['allgather'], np.arange(n, dtype=np.float64))
+        np.testing.assert_array_equal(results[0]['reduce'],
+                                      base + n - 1)
+        assert results[1]['reduce'] is None
+
+    def test_single_rank_identities(self):
+        c = native.NativeCommunicator(
+            native.NativeCommunicator.make_comm_id(), 1, 0)
+        x = np.arange(4, dtype=np.float32)
+        np.testing.assert_array_equal(c.allreduce(x), x)
+        np.testing.assert_array_equal(c.allgather(x), x)
+        c.destroy()
+
+    def test_error_taxonomy(self):
+        c = native.NativeCommunicator(
+            native.NativeCommunicator.make_comm_id(), 1, 0,
+            slot_bytes=64)
+        with pytest.raises(native.CommError) as ei:
+            c.allreduce(np.zeros(1000, np.float32))
+        assert 'buffer overflow' in str(ei.value)
+        with pytest.raises(native.CommError):
+            c.allreduce(np.zeros(2, np.float16))  # unsupported dtype
+        c.destroy()
+
+    def test_comm_id_unique(self):
+        ids = {native.NativeCommunicator.make_comm_id()
+               for _ in range(32)}
+        assert len(ids) == 32

@@ -1,0 +1,166 @@
+"""Pallas op layer: numerics vs pure-jnp oracles, fwd and bwd.
+
+Runs each op both on the default (fallback) path and, via the
+``interpret`` fixture param, through the actual Pallas kernels in
+interpreter mode -- the CPU-side analogue of compiling the Mosaic
+kernels on TPU.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from chainermn_tpu import ops
+from chainermn_tpu.ops import _common
+
+
+@pytest.fixture(params=['fallback', 'interpret'])
+def mode(request, monkeypatch):
+    if request.param == 'interpret':
+        monkeypatch.setenv('CHAINERMN_TPU_PALLAS_INTERPRET', '1')
+    else:
+        monkeypatch.delenv('CHAINERMN_TPU_PALLAS_INTERPRET',
+                           raising=False)
+    assert _common.pallas_mode() == request.param
+    return request.param
+
+
+def _rand(shape, key, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_matches_reference(self, mode, causal):
+        q = _rand((2, 64, 2, 16), 0)
+        k = _rand((2, 64, 2, 16), 1)
+        v = _rand((2, 64, 2, 16), 2)
+        out = ops.flash_attention(q, k, v, causal=causal,
+                                  block_q=32, block_k=32)
+        ref = ops.mha_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_unpadded_lengths(self, mode):
+        # T not a multiple of the block: padded keys must get no mass
+        q = _rand((1, 40, 1, 8), 3)
+        k = _rand((1, 72, 1, 8), 4)
+        v = _rand((1, 72, 1, 8), 5)
+        out = ops.flash_attention(q, k, v, block_q=32, block_k=32)
+        ref = ops.mha_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_lengths(self, mode):
+        q = _rand((2, 16, 2, 8), 6)
+        k = _rand((2, 48, 2, 8), 7)
+        v = _rand((2, 48, 2, 8), 8)
+        out = ops.flash_attention(q, k, v, block_q=16, block_k=16)
+        ref = ops.mha_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize('causal', [False, True])
+    def test_gradients(self, mode, causal):
+        q = _rand((1, 32, 2, 8), 9)
+        k = _rand((1, 32, 2, 8), 10)
+        v = _rand((1, 32, 2, 8), 11)
+
+        def f(q, k, v):
+            return jnp.sum(ops.flash_attention(
+                q, k, v, causal=causal, block_q=16, block_k=16) ** 2)
+
+        def f_ref(q, k, v):
+            return jnp.sum(ops.mha_reference(q, k, v, causal=causal) ** 2)
+
+        g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, g_ref):
+            np.testing.assert_allclose(a, b, atol=5e-4, rtol=5e-4)
+
+    def test_causal_requires_square(self, mode):
+        q = _rand((1, 16, 1, 8), 0)
+        k = _rand((1, 32, 1, 8), 1)
+        with pytest.raises(ValueError):
+            ops.flash_attention(q, k, k, causal=True)
+
+
+class TestCrossEntropy:
+    def test_matches_reference(self, mode):
+        logits = _rand((20, 33), 0)
+        labels = jnp.arange(20) % 33
+        loss = ops.softmax_cross_entropy(logits, labels)
+        ref = ops.softmax_cross_entropy_reference(logits, labels)
+        np.testing.assert_allclose(loss, ref, atol=1e-5, rtol=1e-5)
+
+    def test_gradients(self, mode):
+        logits = _rand((8, 16), 1)
+        labels = jnp.arange(8) % 16
+
+        def f(l):
+            return jnp.mean(ops.softmax_cross_entropy(l, labels))
+
+        def f_ref(l):
+            return jnp.mean(
+                ops.softmax_cross_entropy_reference(l, labels))
+
+        np.testing.assert_allclose(
+            jax.grad(f)(logits), jax.grad(f_ref)(logits),
+            atol=1e-5, rtol=1e-5)
+
+
+class TestLayerNorm:
+    def test_matches_reference(self, mode):
+        x = _rand((3, 7, 32), 2)
+        gamma = 1.0 + 0.1 * _rand((32,), 3)
+        beta = 0.1 * _rand((32,), 4)
+        out = ops.layer_norm(x, gamma, beta)
+        ref = ops.layer_norm_reference(x, gamma, beta)
+        np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+    def test_gradients(self, mode):
+        x = _rand((5, 16), 5)
+        gamma = 1.0 + 0.1 * _rand((16,), 6)
+        beta = 0.1 * _rand((16,), 7)
+
+        def f(x, g, b):
+            return jnp.sum(ops.layer_norm(x, g, b) ** 2)
+
+        def f_ref(x, g, b):
+            return jnp.sum(ops.layer_norm_reference(x, g, b) ** 2)
+
+        got = jax.grad(f, argnums=(0, 1, 2))(x, gamma, beta)
+        want = jax.grad(f_ref, argnums=(0, 1, 2))(x, gamma, beta)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+class TestFusedSGD:
+    def test_matches_optax(self, mode):
+        params = {'w': _rand((13, 7), 0), 'b': _rand((7,), 1)}
+        opt_ref = optax.sgd(0.1, momentum=0.9)
+        state_ref = opt_ref.init(params)
+        opt = ops.fused_momentum_sgd(0.1, momentum=0.9)
+        state = opt.init(params)
+        p_ref, p = params, params
+        for step in range(3):
+            grads = jax.tree_util.tree_map(
+                lambda x: jnp.cos(x + step), params)
+            upd_ref, state_ref = opt_ref.update(grads, state_ref, p_ref)
+            p_ref = optax.apply_updates(p_ref, upd_ref)
+            upd, state = opt.update(grads, state, p)
+            p = optax.apply_updates(p, upd)
+        for key in params:
+            np.testing.assert_allclose(p[key], p_ref[key],
+                                       atol=1e-6, rtol=1e-6)
+
+    def test_functional_api(self, mode):
+        params = {'w': _rand((9, 5), 2)}
+        vel = jax.tree_util.tree_map(jnp.zeros_like, params)
+        grads = jax.tree_util.tree_map(jnp.ones_like, params)
+        new_p, new_v = ops.momentum_sgd(params, grads, vel, lr=0.5,
+                                        momentum=0.0)
+        np.testing.assert_allclose(new_p['w'], params['w'] - 0.5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(new_v['w'], 1.0, atol=1e-6)
