@@ -1,3 +1,7 @@
 """Runtime utilities: platform setup, profiling, failure detection."""
 
 from chainermn_tpu.utils.platform import force_host_devices  # noqa
+from chainermn_tpu.utils import profiling  # noqa
+from chainermn_tpu.utils.failure import (  # noqa
+    NanGuard, DivergenceError, Heartbeat, check_finite, detect_stall,
+    heartbeat_extension)

@@ -1,0 +1,152 @@
+"""Failure detection.
+
+The reference has NONE (SURVEY 5: MPI fail-stop only -- a hung or
+diverged rank is discovered by the human).  This module supplies the
+three detectors a distributed run actually needs:
+
+- numeric: :func:`check_finite` / :class:`NanGuard` -- divergence
+  (NaN/Inf in loss, metrics, or params) stops the run with the first
+  offending pytree paths named.
+- liveness: :class:`Heartbeat` / :func:`detect_stall` -- each process
+  writes a heartbeat file; any watcher (another rank, the launcher, a
+  cron) can flag a stalled process without MPI-style global failure.
+- timeout: the native collective engine returns CMN_TIMEOUT from a
+  barrier whose peers never arrive (``csrc/chainermn_core.cpp``),
+  surfacing single-rank death to the surviving ranks.
+"""
+
+import json
+import os
+import threading
+import time
+
+import jax
+import numpy as np
+
+
+def check_finite(tree, prefix=''):
+    """Return the paths of non-finite leaves (empty list == healthy)."""
+    bad = []
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        arr = np.asarray(leaf)
+        if arr.dtype.kind in 'fc' and not np.all(np.isfinite(arr)):
+            key = prefix + '/'.join(
+                str(getattr(p, 'key', getattr(p, 'idx', p)))
+                for p in path)
+            bad.append(key)
+    return bad
+
+
+class DivergenceError(RuntimeError):
+    """Raised by NanGuard when training produces non-finite values."""
+
+
+class NanGuard:
+    """Trainer extension: stop on non-finite metrics (every iteration)
+    and, every ``param_interval`` iterations, audit the parameters
+    themselves (catches silent corruption that metrics lag behind)."""
+
+    trigger = (1, 'iteration')
+    priority = 250  # before LogReport records garbage
+    name = 'nan_guard'
+
+    def __init__(self, param_interval=100, raise_on_divergence=True):
+        self.param_interval = param_interval
+        self.raise_on_divergence = raise_on_divergence
+
+    def __call__(self, trainer):
+        obs = trainer.observation
+        bad = [k for k, v in obs.items()
+               if isinstance(v, float) and not np.isfinite(v)]
+        if not bad and self.param_interval and \
+                trainer.updater.iteration % self.param_interval == 0:
+            bad = check_finite(trainer.updater.params, 'params/')
+        if bad:
+            msg = ('non-finite values at iteration %d: %s'
+                   % (trainer.updater.iteration, ', '.join(bad)))
+            if self.raise_on_divergence:
+                raise DivergenceError(msg)
+            import sys
+            sys.stderr.write('NanGuard: %s\n' % msg)
+
+
+class Heartbeat:
+    """Per-process liveness file, updated from a daemon thread.
+
+    ``{path}`` gets JSON ``{pid, process_index, time, iteration}``
+    every ``interval`` seconds; pair with :func:`detect_stall` on any
+    observer."""
+
+    def __init__(self, path, interval=10.0):
+        self.path = path
+        self.interval = interval
+        self.iteration = 0
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)),
+                    exist_ok=True)
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _write(self):
+        tmp = self.path + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump({'pid': os.getpid(),
+                       'process_index': jax.process_index(),
+                       'time': time.time(),
+                       'iteration': self.iteration}, f)
+        os.replace(tmp, self.path)
+
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                self._write()
+            except OSError:
+                pass
+            self._stop.wait(self.interval)
+
+    def beat(self, iteration=None):
+        """Optionally called from the training loop to stamp progress."""
+        if iteration is not None:
+            self.iteration = iteration
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+            self._write()
+
+
+def detect_stall(path, timeout=60.0, now=None):
+    """True if the heartbeat at ``path`` is older than ``timeout``
+    seconds (or missing) -- the liveness check the reference's MPI
+    stack cannot express short of a hang."""
+    try:
+        with open(path) as f:
+            beat = json.load(f)
+    except (OSError, ValueError):
+        return True
+    now = time.time() if now is None else now
+    return (now - beat.get('time', 0)) > timeout
+
+
+def heartbeat_extension(out_dir, interval=10.0):
+    """Trainer extension wiring: one heartbeat file per process under
+    ``out_dir`` (``heartbeat-{process_index}.json``), iteration stamped
+    each call."""
+    hb = Heartbeat(os.path.join(
+        out_dir, 'heartbeat-%d.json' % jax.process_index()),
+        interval=interval)
+    hb.start()
+
+    def ext(trainer):
+        hb.beat(trainer.updater.iteration)
+    ext.trigger = (1, 'iteration')
+    ext.priority = 20
+    ext.name = 'heartbeat'
+    ext.heartbeat = hb
+    return ext

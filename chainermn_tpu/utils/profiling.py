@@ -1,0 +1,120 @@
+"""Profiling / tracing.
+
+The reference has NO tracing subsystem (SURVEY 5: closest artifact is
+the dummy communicator built to time pack/unpack overhead,
+``dummy_communicator.py:8-12``).  Here profiling is first-class:
+``jax.profiler`` device traces (viewable in TensorBoard/Perfetto), a
+step timer with throughput accounting, and a pack/unpack-style
+microbenchmark helper that fills the dummy communicator's role.
+"""
+
+import contextlib
+import json
+import os
+import time
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir):
+    """Capture a device trace for the enclosed block.
+
+    Produces a TensorBoard-loadable trace under ``logdir`` (XLA op
+    timeline, HBM usage on TPU)."""
+    jax.profiler.start_trace(logdir)
+    try:
+        yield logdir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name):
+    """Named region visible in the device trace."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class StepTimer:
+    """Throughput accounting for a training loop.
+
+    Trainer extension AND standalone: call ``tick(n_items)`` per step;
+    ``summary()`` gives steps/sec, items/sec and latency percentiles
+    (compile-affected first steps excluded via ``warmup``).
+    """
+
+    trigger = (1, 'iteration')
+    priority = 150
+    name = 'step_timer'
+
+    def __init__(self, items_per_step=None, warmup=2):
+        self.items_per_step = items_per_step
+        self.warmup = warmup
+        self._times = []
+        self._last = None
+
+    def __call__(self, trainer):  # extension protocol
+        self.tick()
+        if self._times:
+            trainer.observation.setdefault(
+                'steps_per_sec', 1.0 / self._times[-1])
+
+    def tick(self, n_items=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._times.append(now - self._last)
+        self._last = now
+
+    def summary(self):
+        times = self._times[self.warmup:] or self._times
+        if not times:
+            return {}
+        times = sorted(times)
+        n = len(times)
+        mean = sum(times) / n
+        out = {
+            'steps': n,
+            'mean_step_s': mean,
+            'steps_per_sec': 1.0 / mean,
+            'p50_step_s': times[n // 2],
+            'p99_step_s': times[min(n - 1, int(n * 0.99))],
+        }
+        if self.items_per_step:
+            out['items_per_sec'] = self.items_per_step / mean
+        return out
+
+    def dump(self, path):
+        with open(path, 'w') as f:
+            json.dump(self.summary(), f, indent=1)
+
+
+def benchmark_op(fn, *args, n_steps=20, warmup=3):
+    """Time a jitted callable end-to-end (the role the reference's
+    dummy communicator plays for pack/unpack overhead).  Returns
+    mean seconds per call."""
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n_steps
+
+
+def memory_stats(device=None):
+    """Per-device memory statistics where the backend exposes them
+    (TPU: bytes_in_use / peak_bytes_in_use; CPU returns {})."""
+    device = device or jax.devices()[0]
+    stats = getattr(device, 'memory_stats', lambda: None)()
+    return stats or {}
+
+
+def save_device_profile(logdir, fn, *args):
+    """Trace one execution of ``fn(*args)`` into ``logdir`` and return
+    the output; convenience wrapper used by the examples'
+    ``--profile`` flags."""
+    os.makedirs(logdir, exist_ok=True)
+    with trace(logdir):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return out

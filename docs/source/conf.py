@@ -1,0 +1,21 @@
+# Sphinx configuration for chainermn_tpu.
+import os
+import sys
+
+sys.path.insert(0, os.path.abspath(os.path.join(
+    os.path.dirname(__file__), os.pardir, os.pardir)))
+
+project = 'ChainerMN-TPU'
+copyright = '2026'
+author = 'chainermn_tpu developers'
+
+extensions = [
+    'sphinx.ext.autodoc',
+    'sphinx.ext.napoleon',
+    'sphinx.ext.viewcode',
+]
+
+templates_path = []
+exclude_patterns = []
+html_theme = 'alabaster'
+autodoc_member_order = 'bysource'
