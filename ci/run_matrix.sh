@@ -1,0 +1,17 @@
+#!/usr/bin/env bash
+# Device-count matrix, mirroring the reference CI's
+#   for NP in 1 2 3; do mpiexec -n ${NP} nosetests ...; done
+# (.travis.yml:55) with XLA's virtual host devices in place of MPI
+# processes.  The full suite runs at 8; the device-agnostic
+# distributed tests run additionally at 1, 2 and 3.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+for N in 1 2 3; do
+  echo "=== device matrix: ${N} virtual device(s) ==="
+  XLA_FLAGS="--xla_force_host_platform_device_count=${N}" \
+    python -m pytest tests/test_device_matrix.py -q
+done
+
+echo "=== full suite: 8 virtual devices ==="
+python -m pytest tests/ -q

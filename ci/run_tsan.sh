@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# ThreadSanitizer pass over the native collective engine -- the race
+# detection the reference never had (SURVEY 5: "race detection /
+# sanitizers: none").
+set -euo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p build
+
+g++ -O1 -g -std=c++17 -fsanitize=thread -pthread \
+    csrc/chainermn_core.cpp csrc/test_collectives_stress.cpp \
+    -o build/tsan_stress
+TSAN_OPTIONS="halt_on_error=1" ./build/tsan_stress 4 200
+
+# plain optimized build as a functional stress pass
+g++ -O3 -std=c++17 -pthread \
+    csrc/chainermn_core.cpp csrc/test_collectives_stress.cpp \
+    -o build/stress
+./build/stress 8 500
+echo "native stress + tsan OK"
