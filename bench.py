@@ -76,12 +76,28 @@ def main():
 
     imgs_per_sec = batch * n_steps / dt
     per_chip = imgs_per_sec / n_dev
-    print(json.dumps({
+    result = {
         'metric': 'resnet50_train_images_per_sec_per_chip',
         'value': round(per_chip, 2),
         'unit': 'images/sec/chip',
         'vs_baseline': round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-    }))
+    }
+    if '--cost' in sys.argv:
+        # XLA's own FLOP count: lets the recorded number be
+        # sanity-checked against hardware peak (AOT-compiles a second
+        # copy of the step; adds minutes on TPU).  cost_analysis is of
+        # the per-device partitioned module, so these are per-chip.
+        try:
+            cost = updater.compiled_cost_analysis(arrays)
+            flops = cost.get('flops', 0.0)
+        except Exception as e:
+            print('cost analysis failed: %r' % e, file=sys.stderr)
+            flops = 0.0
+        if flops:
+            result['step_gflops_per_chip'] = round(flops / 1e9, 1)
+            result['achieved_tflops_per_chip'] = round(
+                flops * n_steps / dt / 1e12, 1)
+    print(json.dumps(result))
 
 
 if __name__ == '__main__':

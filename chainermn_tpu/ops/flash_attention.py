@@ -12,10 +12,11 @@ bookkeeping ride the VPU.
 Layout: inputs are (B, T, H, D) like the rest of the framework; the
 kernel grid is (B*H, T/block_q) with the full K/V stream per grid row.
 
-The backward pass is the standard flash backward, expressed blockwise
-with ``lax.scan`` over key blocks (memory O(T * block) -- XLA fuses it
-well; a hand-written Mosaic backward is a further optimization, not a
-correctness need).
+The backward pass is the standard flash backward split into two Mosaic
+kernels on TPU (dq over query blocks; dk/dv over key blocks, each
+streaming the opposite operand) with ``delta = rowsum(g * out)``
+precomputed; non-TPU backends use an equivalent blockwise ``lax.scan``
+formulation that doubles as the numerics oracle.
 """
 
 import functools
@@ -173,7 +174,170 @@ def _fwd_blockwise_jnp(q, k, v, causal, scale, kv_len, block_k):
 
 
 # ----------------------------------------------------------------------
-# backward (blockwise, lax.scan over key blocks)
+# backward -- Pallas kernels (dq; dk/dv) on TPU, jnp scan fallback.
+# Standard flash backward: delta = rowsum(g * out) precomputed, then
+#   p  = exp(s - lse);  dp = g @ v^T;  ds = p * (dp - delta) * scale
+#   dq += ds @ k;  dk += ds^T @ q;  dv += p^T @ g
+# ----------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                   dq_ref, *, scale, causal, kv_len, block_q, block_k,
+                   t_kv):
+    import jax.experimental.pallas as pl
+
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)                  # (block_q, D)
+    g = g_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0]                            # (block_q,)
+    delta = delta_ref[0][:, 0]
+    n_blocks = t_kv // block_k
+    if causal:
+        n_blocks = jnp.minimum(
+            n_blocks, pl.cdiv((qi + 1) * block_q, block_k))
+    masked = causal or kv_len < t_kv
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if masked:
+            q_pos = (qi * block_q
+                     + lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0))
+            k_pos = (j * block_k
+                     + lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1))
+            ok = k_pos < kv_len
+            if causal:
+                ok = jnp.logical_and(ok, q_pos >= k_pos)
+            s = jnp.where(ok, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = lax.fori_loop(0, n_blocks, body,
+                       jnp.zeros_like(q))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, kv_len, t_kv,
+                    block_q, block_k, t_q):
+    import jax.experimental.pallas as pl
+
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)                  # (block_k, D)
+    v = v_ref[0].astype(jnp.float32)
+    n_blocks = t_q // block_q
+    j0 = 0
+    if causal:
+        # query blocks strictly before this key block contribute nothing
+        j0 = (ki * block_k) // block_q
+    masked = causal or kv_len < t_kv
+    d = k.shape[-1]
+
+    def body(j, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        g = g_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q), 0]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q), 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if masked:
+            q_pos = (j * block_q
+                     + lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 0))
+            k_pos = (ki * block_k
+                     + lax.broadcasted_iota(jnp.int32,
+                                            (block_q, block_k), 1))
+            ok = k_pos < kv_len
+            if causal:
+                ok = jnp.logical_and(ok, q_pos >= k_pos)
+            s = jnp.where(ok, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv = dv + jax.lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            g, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, d), jnp.float32)
+    dk, dv = lax.fori_loop(j0, n_blocks, body, (dk0, dk0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, out, lse, g, causal, scale, kv_len,
+                block_q, block_k):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)                           # (bh, t_q)
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+
+    def spec_q(block):
+        return pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0),
+                            memory_space=pltpu.VMEM)
+
+    full_kv = pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0),
+                           memory_space=pltpu.VMEM)
+    full_q = pl.BlockSpec((1, t_q, d), lambda b, i: (b, 0, 0),
+                          memory_space=pltpu.VMEM)
+    row_q_blk = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM)
+    row_q_full = pl.BlockSpec((1, t_q, 1), lambda b, i: (b, 0, 0),
+                              memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          kv_len=kv_len, block_q=block_q,
+                          block_k=block_k, t_kv=t_kv),
+        grid=(bh, t_q // block_q),
+        in_specs=[spec_q(block_q), full_kv, full_kv, spec_q(block_q),
+                  row_q_blk, row_q_blk],
+        out_specs=spec_q(block_q),
+        out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        interpret=interpret_flag(),
+    )(q, k, v, g, lse3, delta3)
+
+    kv_blk = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          kv_len=kv_len, t_kv=t_kv, block_q=block_q,
+                          block_k=block_k, t_q=t_q),
+        grid=(bh, t_kv // block_k),
+        in_specs=[full_q, kv_blk, kv_blk, full_q, row_q_full,
+                  row_q_full],
+        out_specs=[kv_blk, kv_blk],
+        out_shape=[jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype)],
+        interpret=interpret_flag(),
+    )(q, k, v, g, lse3, delta3)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# backward (blockwise, lax.scan over key blocks) -- fallback/oracle
 # ----------------------------------------------------------------------
 
 def _bwd_blockwise(q, k, v, out, lse, g, causal, scale, kv_len, block_k):
@@ -234,8 +398,11 @@ def _flash_fwd(q, k, v, causal, scale, kv_len, block_q, block_k):
 
 def _flash_bwd(causal, scale, kv_len, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    return _bwd_blockwise(q, k, v, out, lse, g, causal, scale, kv_len,
-                          block_k)
+    if pallas_mode() == 'fallback':
+        return _bwd_blockwise(q, k, v, out, lse, g, causal, scale,
+                              kv_len, block_k)
+    return _bwd_pallas(q, k, v, out, lse, g, causal, scale, kv_len,
+                       block_q, block_k)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

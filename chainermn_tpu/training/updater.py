@@ -135,6 +135,18 @@ class StandardUpdater:
         metrics = self.update_core(self.shard_batch(next(self.iterator)))
         return {k: float(v) for k, v in metrics.items()}
 
+    def compiled_cost_analysis(self, arrays):
+        """XLA cost analysis (flops etc.) of the compiled train step
+        for the given sharded batch."""
+        step_rng = (jax.random.fold_in(self._rng, self.iteration)
+                    if self._has_state else self._rng)
+        lowered = self._step.lower(self.params, self.model_state,
+                                   self.opt_state, step_rng, *arrays)
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return dict(cost or {})
+
     # epoch accounting is delegated to the iterator
     @property
     def epoch(self):

@@ -185,3 +185,20 @@ def test_updater_batch_divisibility(tmp_path):
                                    has_aux=True)
     with pytest.raises(ValueError):
         upd.update()
+
+
+def test_orbax_sharded_checkpoint(tmp_path):
+    """Sharded checkpoint via orbax (the rank-aware snapshot path
+    SURVEY 5 flags as the reference's gap)."""
+    import warnings
+    import jax.numpy as jnp
+    from chainermn_tpu import serializers
+    tree = {'a': jnp.arange(8.0),
+            'b': {'c': jnp.ones((2, 3), jnp.bfloat16)}}
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        serializers.save_checkpoint(str(tmp_path / 'ckpt'), tree, step=3)
+        back = serializers.restore_checkpoint(str(tmp_path / 'ckpt'),
+                                              tree, step=3)
+    np.testing.assert_allclose(back['a'], tree['a'])
+    assert back['b']['c'].dtype == jnp.bfloat16
