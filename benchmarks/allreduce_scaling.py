@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""Allreduce scaling-efficiency harness.
+
+The BASELINE metric is "images/sec/chip + allreduce scaling efficiency
+8 -> 256 chips".  This harness measures the gradient-allreduce step in
+isolation over growing mesh sizes: a ResNet-50-sized gradient pytree
+(~25.6M params) is mean-reduced with each communicator strategy, and
+efficiency is reported relative to the smallest mesh (perfect scaling
+== the per-step time stays flat as devices are added, since the
+payload per device is constant).
+
+On real TPU slices the mesh sizes come from the slice; on CPU the
+virtual-device flag provides the scaling axis for harness validation
+(`--devices 1,2,4,8`).  Prints one JSON line per (strategy, mesh).
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--devices', default=None,
+                        help='comma list of mesh sizes (default: all '
+                             'visible devices in powers of two)')
+    parser.add_argument('--strategies', default='xla,hierarchical,'
+                        'two_dimensional,flat,naive')
+    parser.add_argument('--params', type=int, default=25_600_000,
+                        help='gradient payload size (default: '
+                             'ResNet-50-sized)')
+    parser.add_argument('--steps', type=int, default=20)
+    parser.add_argument('--cpu', type=int, default=0, metavar='N',
+                        help='force an N-virtual-device CPU platform')
+    args = parser.parse_args()
+
+    if args.cpu:
+        import chainermn_tpu.utils as u
+        u.force_host_devices(args.cpu)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import PartitionSpec as P
+
+    import chainermn_tpu
+
+    n_all = jax.device_count()
+    if args.devices:
+        sizes = [int(v) for v in args.devices.split(',')]
+    else:
+        sizes = [s for s in (1, 2, 4, 8, 16, 32, 64, 128, 256)
+                 if s <= n_all]
+
+    # ResNet-50-shaped payload: a few large + many small leaves
+    leaves = {}
+    remaining = args.params
+    i = 0
+    for size in (2048 * 1000, 512 * 512 * 9, 2048 * 512, 1024 * 256):
+        while remaining > size:
+            leaves['w%d' % i] = size
+            remaining -= size
+            i += 1
+            if len(leaves) > 160:
+                break
+    leaves['tail'] = max(remaining, 1)
+
+    baseline = {}
+    for name in args.strategies.split(','):
+        for n in sizes:
+            inter = 2 if n % 2 == 0 and n > 1 else 1
+            if name == 'single_node':
+                inter = 1
+            comm = chainermn_tpu.create_communicator(
+                name, mesh_shape=(inter, n // inter),
+                devices=jax.devices()[:n])
+            grads = {k: jnp.ones((v,), jnp.float32)
+                     for k, v in leaves.items()}
+
+            def red(g):
+                return comm.allreduce_grad(g)
+
+            fn = jax.jit(jax.shard_map(
+                red, mesh=comm.mesh, in_specs=P(),
+                out_specs=P(), check_vma=False))
+            out = fn(grads)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                out = fn(out)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / args.steps
+            key = name
+            baseline.setdefault(key, dt)
+            eff = baseline[key] / dt
+            print(json.dumps({
+                'metric': 'allreduce_time_ms',
+                'strategy': name,
+                'devices': n,
+                'value': round(dt * 1e3, 3),
+                'payload_mb': round(args.params * 4 / 1e6, 1),
+                'scaling_efficiency': round(eff, 3),
+            }))
+
+
+if __name__ == '__main__':
+    main()

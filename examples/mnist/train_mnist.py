@@ -40,6 +40,9 @@ def main():
                         help='force the virtual CPU mesh (testing)')
     parser.add_argument('--mesh', type=str, default=None,
                         help='override mesh shape, e.g. 2x4')
+    parser.add_argument('--profile', default='',
+                        help='capture a device trace into this dir '
+                             '(view in TensorBoard)')
     parser.add_argument('--quick', action='store_true',
                         help='tiny run for smoke testing')
     args = parser.parse_args()
@@ -108,7 +111,13 @@ def main():
         from chainermn_tpu import serializers
         serializers.resume_updater(args.resume, updater, comm)
 
-    trainer.run()
+    trainer.extend(chainermn_tpu.utils.NanGuard(), trigger=(1, 'iteration'))
+    if args.profile:
+        from chainermn_tpu.utils import profiling
+        with profiling.trace(args.profile):
+            trainer.run()
+    else:
+        trainer.run()
     if comm.rank == 0:
         print('final observation:', {
             k: v for k, v in trainer.observation.items()})
