@@ -20,3 +20,4 @@ from chainermn_tpu.parallel.tensor import (  # noqa
     column_parallel_dense, row_parallel_dense, tp_mlp)
 from chainermn_tpu.parallel.sequence import ring_attention  # noqa
 from chainermn_tpu.parallel.moe import MoELayer  # noqa
+from chainermn_tpu.parallel import zero  # noqa

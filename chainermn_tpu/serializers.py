@@ -91,7 +91,19 @@ def resume_updater(path, updater, comm):
         template['model_state'] = updater.model_state
     state = load_npz(path, template)
     updater.params = comm.replicate(state['params'])
-    updater.opt_state = comm.replicate(state['opt_state'])
+    if getattr(updater, '_zero', False):
+        # restore the ZeRO layout: stacked state goes back sharded
+        # over the mesh, not replicated (replication would cost the
+        # N-times memory the sharding exists to avoid)
+        import jax
+        from jax.sharding import NamedSharding
+        shardings = jax.tree_util.tree_map(
+            lambda spec: NamedSharding(comm.mesh, spec),
+            updater._zero_specs)
+        updater.opt_state = jax.device_put(state['opt_state'],
+                                           shardings)
+    else:
+        updater.opt_state = comm.replicate(state['opt_state'])
     if 'model_state' in template:
         updater.model_state = comm.replicate(state['model_state'])
     updater.iteration = int(state['iteration'])

@@ -11,6 +11,7 @@ Python work beyond feeding the next batch.
 """
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -33,7 +34,8 @@ class StandardUpdater:
     """
 
     def __init__(self, iterator, optimizer, loss_fn, params, comm,
-                 has_aux=False, donate=True, model_state=None, rng=None):
+                 has_aux=False, donate=True, model_state=None, rng=None,
+                 zero=False):
         """``model_state``: optional non-trainable collections (e.g.
         BatchNorm running stats).  When given, ``loss_fn`` must have
         the extended signature
@@ -43,6 +45,22 @@ class StandardUpdater:
         across the mesh (cross-replica BatchNorm statistics), and
         ``rng`` (defaulting to PRNGKey(0)) is folded per iteration and
         per device for dropout-style randomness.
+
+        ``zero=True`` shards the optimizer state over the mesh
+        (ZeRO-1; see :mod:`chainermn_tpu.parallel.zero`): gradients
+        are mean-reduce-scattered, the update runs on each device's
+        shard, parameter deltas are all-gathered.  Pass the RAW optax
+        optimizer here -- the first-update-broadcast semantics of the
+        multi-node wrapper are applied internally (wrapping twice
+        would average shards that are intentionally different).
+
+        ONLY ELEMENTWISE optimizers (sgd/momentum, adam, adamw, ...)
+        preserve the replicated trajectory under zero=True: the
+        transformation sees flat 1-D per-device shards, so anything
+        that reads cross-element structure -- clip_by_global_norm,
+        per-layer trust ratios (LARS/LAMB), adafactor's shape-based
+        factoring -- computes over shards instead of true leaves and
+        silently diverges from zero=False.
         """
         self.iterator = iterator
         self.optimizer = optimizer
@@ -50,10 +68,30 @@ class StandardUpdater:
         self.loss_fn = loss_fn
         self._has_aux = has_aux
         self._has_state = model_state is not None
+        self._zero = zero
         self.params = comm.replicate(params)
         self.model_state = (comm.replicate(model_state)
                             if self._has_state else None)
-        self.opt_state = comm.replicate(optimizer.init(params))
+        if zero:
+            from jax.sharding import NamedSharding
+            from chainermn_tpu.multi_node_optimizer import (
+                MultiNodeOptimizerState)
+            from chainermn_tpu.parallel import zero as zero_mod
+            local_state = optimizer.init(
+                zero_mod.shard_templates(params, comm.size))
+            if isinstance(local_state, MultiNodeOptimizerState):
+                raise ValueError(
+                    'zero=True needs the raw optax optimizer, not the '
+                    'multi-node wrapper (broadcast-first is built in)')
+            from chainermn_tpu.communicators.mesh_utility import AXES
+            self._zero_specs = zero_mod.state_specs(local_state, AXES)
+            stacked = zero_mod.expand_state(local_state, comm.size)
+            shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(comm.mesh, spec),
+                self._zero_specs)
+            self.opt_state = jax.device_put(stacked, shardings)
+        else:
+            self.opt_state = comm.replicate(optimizer.init(params))
         self.iteration = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._step = self._build_step(donate)
@@ -64,9 +102,12 @@ class StandardUpdater:
         loss_fn = self.loss_fn
         has_aux = self._has_aux
 
+        from chainermn_tpu.communicators.mesh_utility import AXES
         has_state = self._has_state
+        is_zero = self._zero
+        axes = AXES
 
-        def step(params, model_state, opt_state, rng, *batch):
+        def grads_and_metrics(params, model_state, rng, *batch):
             if has_state:
                 dev_rng = jax.random.fold_in(rng, comm.axis_rank())
 
@@ -87,21 +128,75 @@ class StandardUpdater:
                     loss, grads = out
                     metrics = {}
                 new_state = model_state
-            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return grads, dict(metrics, loss=loss), new_state
+
+        def step(params, model_state, opt_state, rng, *batch):
+            grads, metrics, new_state = grads_and_metrics(
+                params, model_state, rng, *batch)
+            updates, opt_state = optimizer.update(grads, opt_state,
+                                                  params)
             params = optax.apply_updates(params, updates)
-            metrics = dict(metrics, loss=loss)
+            metrics = comm.allreduce(metrics, op='mean')
+            return params, new_state, opt_state, metrics
+
+        def zero_step(params, model_state, opt_state, rng, needs_bcast,
+                      *batch):
+            from jax import lax
+            from chainermn_tpu.parallel import zero as z
+            grads, metrics, new_state = grads_and_metrics(
+                params, model_state, rng, *batch)
+            n = comm.size
+            rank = comm.axis_rank()
+
+            def first_call(_):
+                # initial weight sync, no step (reference
+                # multi_node_optimizer.py:23-26)
+                synced = comm.broadcast_data(params)
+                return synced, opt_state
+
+            def later_call(_):
+                g_sh = jax.tree_util.tree_map(
+                    lambda g: z.scatter_grad_leaf(g, n, axes), grads)
+                p_sh = jax.tree_util.tree_map(
+                    lambda p: z.param_shard_leaf(p, n, rank), params)
+                opt_local = z.squeeze_state(opt_state)
+                updates, new_opt = optimizer.update(g_sh, opt_local,
+                                                    p_sh)
+                upd_full = jax.tree_util.tree_map(
+                    lambda u, p: z.gather_update_leaf(u, p, axes),
+                    updates, params)
+                return (optax.apply_updates(params, upd_full),
+                        z.unsqueeze_state(new_opt))
+
+            params, opt_state = lax.cond(
+                needs_bcast, first_call, later_call, operand=None)
             metrics = comm.allreduce(metrics, op='mean')
             return params, new_state, opt_state, metrics
 
         # arity of in_specs depends on the batch tuple; resolved at
         # trace time (jit caches per shape signature)
-        def mapped_call(params, model_state, opt_state, rng, *batch):
-            fn = jax.shard_map(
-                step, mesh=comm.mesh,
-                in_specs=(P(), P(), P(), P()) +
-                (comm.batch_spec(),) * len(batch),
-                out_specs=(P(), P(), P(), P()), check_vma=False)
-            return fn(params, model_state, opt_state, rng, *batch)
+        if is_zero:
+            zero_specs = self._zero_specs
+
+            def mapped_call(params, model_state, opt_state, rng,
+                            needs_bcast, *batch):
+                fn = jax.shard_map(
+                    zero_step, mesh=comm.mesh,
+                    in_specs=(P(), P(), zero_specs, P(), P()) +
+                    (comm.batch_spec(),) * len(batch),
+                    out_specs=(P(), P(), zero_specs, P()),
+                    check_vma=False)
+                return fn(params, model_state, opt_state, rng,
+                          needs_bcast, *batch)
+        else:
+            def mapped_call(params, model_state, opt_state, rng,
+                            *batch):
+                fn = jax.shard_map(
+                    step, mesh=comm.mesh,
+                    in_specs=(P(), P(), P(), P()) +
+                    (comm.batch_spec(),) * len(batch),
+                    out_specs=(P(), P(), P(), P()), check_vma=False)
+                return fn(params, model_state, opt_state, rng, *batch)
 
         jit_kwargs = {'donate_argnums': (0, 1, 2)} if donate else {}
         return jax.jit(mapped_call, static_argnums=(), **jit_kwargs)
@@ -125,9 +220,16 @@ class StandardUpdater:
         # stateless path reuses the cached key (the step ignores it)
         step_rng = (jax.random.fold_in(self._rng, self.iteration)
                     if self._has_state else self._rng)
-        self.params, self.model_state, self.opt_state, metrics = \
-            self._step(self.params, self.model_state, self.opt_state,
-                       step_rng, *arrays)
+        if self._zero:
+            needs_bcast = jnp.asarray(self.iteration == 0)
+            self.params, self.model_state, self.opt_state, metrics = \
+                self._step(self.params, self.model_state,
+                           self.opt_state, step_rng, needs_bcast,
+                           *arrays)
+        else:
+            self.params, self.model_state, self.opt_state, metrics = \
+                self._step(self.params, self.model_state,
+                           self.opt_state, step_rng, *arrays)
         self.iteration += 1
         return metrics
 
