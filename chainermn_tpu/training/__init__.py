@@ -8,7 +8,9 @@ iterators, extensions, triggers), built around one jitted
 ``shard_map`` train step instead of an eager per-process loop.
 """
 
-from chainermn_tpu.training.iterators import SerialIterator  # noqa
+from chainermn_tpu.training.iterators import (  # noqa
+    SerialIterator, MultiprocessIterator, PipelineIterator)
+from chainermn_tpu.training import iterators  # noqa
 from chainermn_tpu.training.trainer import Trainer  # noqa
 from chainermn_tpu.training.updater import StandardUpdater  # noqa
 from chainermn_tpu.training.evaluator import Evaluator  # noqa

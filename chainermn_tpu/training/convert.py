@@ -16,6 +16,15 @@ def concat_examples(batch, padding=None):
     if len(batch) == 0:
         raise ValueError('batch is empty')
     first = batch[0]
+    if (isinstance(batch, tuple)
+            and all(isinstance(b, np.ndarray) and b.ndim >= 1
+                    for b in batch)):
+        # already-collated column arrays (batch-level pipelines like
+        # datasets.BatchAugmentPipeline produce these directly)
+        if padding is not None:
+            raise ValueError('padding is only supported for lists of '
+                             'examples, not pre-collated arrays')
+        return batch
     if isinstance(first, tuple):
         cols = tuple(np.stack([np.asarray(b[i]) for b in batch])
                      for i in range(len(first)))

@@ -77,6 +77,76 @@ class SerialIterator:
     next = __next__
 
 
+class PipelineIterator:
+    """Batch-level iterator over a
+    :class:`chainermn_tpu.datasets.BatchAugmentPipeline` (or anything
+    with ``__len__`` and ``batch(indices) -> (X, Y)``): yields
+    pre-collated column arrays assembled by the native C++ thread-pool
+    kernel, replacing per-item Python work entirely.  Epoch accounting
+    matches :class:`SerialIterator`."""
+
+    def __init__(self, pipeline, batch_size, repeat=True, shuffle=True,
+                 seed=0):
+        self.pipeline = pipeline
+        self.batch_size = batch_size
+        self._repeat = repeat
+        self._shuffle = shuffle
+        self._rng = np.random.RandomState(seed)
+        self.reset()
+
+    def reset(self):
+        self.epoch = 0
+        self.iteration = 0
+        self.is_new_epoch = False
+        self._pos = 0
+        self._order = self._new_order()
+
+    def restore_epoch(self, epoch):
+        self.epoch = int(epoch)
+
+    def _new_order(self):
+        n = len(self.pipeline)
+        return (self._rng.permutation(n) if self._shuffle
+                else np.arange(n))
+
+    @property
+    def epoch_detail(self):
+        return self.epoch + self._pos / max(1, len(self.pipeline))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = len(self.pipeline)
+        if n == 0:
+            raise StopIteration
+        if self._pos >= n:
+            if not self._repeat:
+                raise StopIteration
+            self._pos = 0
+            self._order = self._new_order()
+        i_end = min(self._pos + self.batch_size, n)
+        idx = self._order[self._pos:i_end]
+        self._pos = i_end
+        self.is_new_epoch = False
+        if self._pos >= n:
+            self.epoch += 1
+            self.is_new_epoch = True
+            if self._repeat:
+                self._pos = 0
+                self._order = self._new_order()
+        # top up to a constant batch size when repeating (static
+        # shapes keep the jitted step cache-hot)
+        if self._repeat and len(idx) < self.batch_size:
+            extra = self.batch_size - len(idx)
+            idx = np.concatenate([idx, self._order[:extra]])
+            self._pos = extra
+        self.iteration += 1
+        return self.pipeline.batch(idx.astype(np.int64))
+
+    next = __next__
+
+
 class MultiprocessIterator:
     """Prefetching iterator.
 

@@ -35,7 +35,7 @@ class StandardUpdater:
 
     def __init__(self, iterator, optimizer, loss_fn, params, comm,
                  has_aux=False, donate=True, model_state=None, rng=None,
-                 zero=False):
+                 zero=False, accum_steps=1):
         """``model_state``: optional non-trainable collections (e.g.
         BatchNorm running stats).  When given, ``loss_fn`` must have
         the extended signature
@@ -61,6 +61,11 @@ class StandardUpdater:
         per-layer trust ratios (LARS/LAMB), adafactor's shape-based
         factoring -- computes over shards instead of true leaves and
         silently diverges from zero=False.
+
+        ``accum_steps=k`` splits each per-device batch into k
+        micro-batches processed by ``lax.scan`` with gradients
+        averaged before the (single) optimizer step -- k-times larger
+        effective batch at 1/k activation memory.
         """
         self.iterator = iterator
         self.optimizer = optimizer
@@ -69,8 +74,21 @@ class StandardUpdater:
         self._has_aux = has_aux
         self._has_state = model_state is not None
         self._zero = zero
-        self.params = comm.replicate(params)
-        self.model_state = (comm.replicate(model_state)
+        if accum_steps < 1:
+            raise ValueError('accum_steps must be >= 1')
+        self._accum_steps = accum_steps
+        def _owned(tree):
+            # device_put may alias caller buffers when the sharding
+            # already matches; with donation enabled the first step
+            # would then delete the caller's arrays.  Copy once.
+            if not donate:
+                return tree
+            return jax.tree_util.tree_map(
+                lambda x: x.copy() if isinstance(x, jax.Array) else x,
+                tree)
+
+        self.params = _owned(comm.replicate(params))
+        self.model_state = (_owned(comm.replicate(model_state))
                             if self._has_state else None)
         if zero:
             from jax.sharding import NamedSharding
@@ -107,7 +125,9 @@ class StandardUpdater:
         is_zero = self._zero
         axes = AXES
 
-        def grads_and_metrics(params, model_state, rng, *batch):
+        accum = self._accum_steps
+
+        def grads_and_metrics_once(params, model_state, rng, *batch):
             if has_state:
                 dev_rng = jax.random.fold_in(rng, comm.axis_rank())
 
@@ -129,6 +149,33 @@ class StandardUpdater:
                     metrics = {}
                 new_state = model_state
             return grads, dict(metrics, loss=loss), new_state
+
+        def grads_and_metrics(params, model_state, rng, *batch):
+            if accum == 1:
+                return grads_and_metrics_once(params, model_state, rng,
+                                              *batch)
+
+            # micro-batch scan: (B, ...) -> (accum, B/accum, ...);
+            # grads/metrics averaged, model_state threaded through
+            micro = tuple(
+                b.reshape((accum, b.shape[0] // accum) + b.shape[1:])
+                for b in batch)
+
+            def body(carry, mb):
+                state_c, rng_c = carry
+                g, m, new_state = grads_and_metrics_once(
+                    params, state_c, rng_c, *mb)
+                rng_c = (jax.random.fold_in(rng_c, 1)
+                         if has_state else rng_c)
+                return (new_state, rng_c), (g, m)
+
+            (new_state, _), (gs, ms) = jax.lax.scan(
+                body, (model_state, rng), micro)
+            grads = jax.tree_util.tree_map(
+                lambda g: jnp.mean(g, axis=0), gs)
+            metrics = jax.tree_util.tree_map(
+                lambda m: jnp.mean(m, axis=0), ms)
+            return grads, metrics, new_state
 
         def step(params, model_state, opt_state, rng, *batch):
             grads, metrics, new_state = grads_and_metrics(
@@ -207,10 +254,11 @@ class StandardUpdater:
         if isinstance(arrays, dict):
             arrays = tuple(arrays.values())
         n = arrays[0].shape[0]
-        if n % self.comm.size:
+        if n % (self.comm.size * self._accum_steps):
             raise ValueError(
                 'global batch size %d must be divisible by mesh size %d'
-                % (n, self.comm.size))
+                ' x accum_steps %d'
+                % (n, self.comm.size, self._accum_steps))
         return self.comm.shard_batch(arrays)
 
     def update_core(self, arrays):

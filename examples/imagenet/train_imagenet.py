@@ -36,6 +36,10 @@ def main():
     parser.add_argument('--epoch', '-E', type=int, default=10)
     parser.add_argument('--communicator', default='xla')
     parser.add_argument('--loaderjob', '-j', type=int, default=4)
+    parser.add_argument('--pipeline', choices=['thread', 'native'],
+                        default='thread',
+                        help='input pipeline: per-item prefetch thread '
+                             'or native C++ batch augmentation')
     parser.add_argument('--mean', '-m', default=None,
                         help='mean image npy (computed if absent)')
     parser.add_argument('--out', '-o', default='result')
@@ -82,14 +86,22 @@ def main():
     else:
         mean = imagenet.compute_mean(raw_train, limit=64)
 
-    train = imagenet.PreprocessedDataset(raw_train, mean, insize)
     val = imagenet.PreprocessedDataset(raw_val, mean, insize,
                                        random=False)
-    train = chainermn_tpu.scatter_dataset(train, comm)
     val = chainermn_tpu.scatter_dataset(val, comm)
 
-    train_iter = training.iterators.MultiprocessIterator(
-        train, args.batchsize, n_prefetch=args.loaderjob)
+    if args.pipeline == 'native':
+        # batch-level augmentation in the C++ thread pool (falls back
+        # to numpy when the native core is unbuilt)
+        raw_shard = chainermn_tpu.scatter_dataset(raw_train, comm)
+        pipe = imagenet.BatchAugmentPipeline(raw_shard, insize,
+                                             mean=mean)
+        train_iter = training.PipelineIterator(pipe, args.batchsize)
+    else:
+        train = imagenet.PreprocessedDataset(raw_train, mean, insize)
+        train = chainermn_tpu.scatter_dataset(train, comm)
+        train_iter = training.iterators.MultiprocessIterator(
+            train, args.batchsize, n_prefetch=args.loaderjob)
     val_iter = training.SerialIterator(val, args.val_batchsize,
                                        repeat=False, shuffle=False)
 

@@ -202,3 +202,60 @@ def test_orbax_sharded_checkpoint(tmp_path):
                                               tree, step=3)
     np.testing.assert_allclose(back['a'], tree['a'])
     assert back['b']['c'].dtype == jnp.bfloat16
+
+
+def test_gradient_accumulation_matches_full_batch():
+    """accum_steps=k with the same global batch must match the k=1
+    trajectory (SGD is linear in the gradient mean)."""
+    from chainermn_tpu.models import MLP, classifier_loss
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    rng = np.random.RandomState(1)
+    x = rng.rand(32, 5).astype(np.float32)
+    y = (x.sum(axis=1) > 2.5).astype(np.int32)
+    ds = list(zip(x, y))
+    model = MLP(n_units=16, n_out=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 5)))['params']
+    loss_fn = classifier_loss(
+        lambda p, xb: model.apply({'params': p}, xb))
+
+    def run(accum):
+        opt = chainermn_tpu.create_multi_node_optimizer(
+            optax.sgd(0.1), comm)
+        it = training.SerialIterator(ds, 32, shuffle=False)
+        upd = training.StandardUpdater(it, opt, loss_fn, params, comm,
+                                       has_aux=True, accum_steps=accum)
+        return [upd.update()['loss'] for _ in range(3)], upd.params
+
+    losses1, p1 = run(1)
+    losses2, p2 = run(2)
+    np.testing.assert_allclose(losses1, losses2, atol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(p1),
+                    jax.tree_util.tree_leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-6)
+
+
+def test_pipeline_iterator_with_updater():
+    """PipelineIterator yields pre-collated arrays straight through
+    concat_examples into the jitted step."""
+    from chainermn_tpu.datasets.imagenet import (
+        BatchAugmentPipeline, SyntheticImageNet)
+    from chainermn_tpu.models import MLP, classifier_loss
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(1, 8))
+    base = SyntheticImageNet(n=32, size=12, n_classes=4)
+    pipe = BatchAugmentPipeline(base, crop_size=8)
+    it = training.PipelineIterator(pipe, 16)
+    model = MLP(n_units=8, n_out=4)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8 * 8 * 3)))['params']
+    loss_fn = classifier_loss(
+        lambda p, xb: model.apply({'params': p},
+                                  xb.reshape(xb.shape[0], -1)))
+    opt = chainermn_tpu.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    upd = training.StandardUpdater(it, opt, loss_fn, params, comm,
+                                   has_aux=True)
+    m = upd.update()
+    m = upd.update()
+    assert np.isfinite(m['loss'])
+    assert it.epoch == 1  # 32 samples / batch 16 -> 2 iterations
