@@ -6,17 +6,44 @@ matrix, ``.travis.yml:55``).
 """
 
 import os
+import re
 
 import jax
 
 
-def force_host_devices(n=8):
+def force_host_devices(n=8, require=False):
     """Switch this process to the CPU backend with ``n`` virtual
-    devices.  Must run before first backend use; safe to call when the
-    flag is already present."""
+    devices and return the live CPU device count.
+
+    Must run before first backend use.  An already-present
+    ``--xla_force_host_platform_device_count`` flag is respected (it
+    may be a deliberate smaller CI-matrix setting).  With
+    ``require=True`` a RuntimeError is raised when fewer than ``n``
+    devices actually materialize -- either the pre-existing flag asked
+    for fewer, or the backend was initialized before this call could
+    take effect.
+    """
     flags = os.environ.get('XLA_FLAGS', '')
-    if '--xla_force_host_platform_device_count' not in flags:
+    m = re.search(r'--xla_force_host_platform_device_count=(\d+)', flags)
+    if m is None:
         os.environ['XLA_FLAGS'] = (
             flags + ' --xla_force_host_platform_device_count=%d' % n
         ).strip()
     jax.config.update('jax_platforms', 'cpu')
+    devices = jax.devices()
+    if devices[0].platform != 'cpu':
+        # config update is a no-op once backends are live: the one job
+        # of this function failed, never continue silently on real
+        # hardware
+        raise RuntimeError(
+            'could not force the CPU backend: jax already initialized '
+            'platform %r before force_host_devices ran'
+            % devices[0].platform)
+    count = len(devices)
+    if require and count < n:
+        raise RuntimeError(
+            'asked for %d virtual CPU devices but the backend exposes '
+            '%d (pre-existing flag: %s); set XLA_FLAGS='
+            '--xla_force_host_platform_device_count=%d before first '
+            'jax use' % (n, count, m.group(1) if m else 'unset', n))
+    return count
