@@ -14,30 +14,143 @@ Runs the full training step (forward+backward+allreduce+SGD step +
 cross-replica BN sync) on all locally visible devices via the same
 StandardUpdater-jitted program users run, bfloat16 NHWC, global batch
 sized per device count.
+
+Robustness (VERDICT r1 item 2): the parent process never imports jax.
+It first probes the backend in a subprocess with a hard timeout and
+bounded retries -- a hung or unavailable TPU yields a machine-readable
+``{"error": "backend_unavailable", ...}`` line instead of a traceback
+or a silent hang.  The measurement itself runs in a watchdogged child
+(``--child``) with a persistent XLA compilation cache so repeat runs
+skip the multi-minute ResNet-50 compile, and stage progress goes to
+stderr.
+
+Flags: ``--quick`` (5 timed steps, 2 warmups), ``--cpu`` (8-device
+virtual CPU mesh, plumbing check only), ``--no-cost`` (skip the MFU
+cost-analysis fields).
 """
 
 import json
+import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-import optax
-
-import chainermn_tpu
-from chainermn_tpu import training
-from chainermn_tpu.models import ResNet50, StatefulClassifier
-
 BASELINE_IMG_PER_SEC_PER_CHIP = 63.0
+# TPU v5e: 197 TFLOP/s dense bf16 per chip
+V5E_BF16_PEAK_TFLOPS = 197.0
+METRIC = {
+    'metric': 'resnet50_train_images_per_sec_per_chip',
+    'unit': 'images/sec/chip',
+}
+
+PROBE_SRC = """
+import jax, jax.numpy as jnp
+d = jax.devices()
+assert d, 'no devices'
+jax.jit(lambda a: a @ a)(jnp.ones((512, 512), jnp.bfloat16)
+                         ).block_until_ready()
+print('PROBE_OK', jax.default_backend(), len(d))
+"""
 
 
-def main():
-    quick = '--quick' in sys.argv
+def _log(msg):
+    print('[bench %.1fs] %s' % (time.monotonic() - _log.t0, msg),
+          file=sys.stderr, flush=True)
+
+
+_log.t0 = time.monotonic()
+
+
+def emit(result, rc=0):
+    print(json.dumps(result), flush=True)
+    sys.exit(rc)
+
+
+def probe_backend(attempts=2, timeout=150, interval=10):
+    """True if a subprocess can init the backend and run a tiny jit;
+    otherwise returns the failure detail of the last attempt."""
+    detail = ''
+    for i in range(attempts):
+        _log('backend probe attempt %d/%d (timeout %ds)'
+             % (i + 1, attempts, timeout))
+        try:
+            p = subprocess.run(
+                [sys.executable, '-c', PROBE_SRC], timeout=timeout,
+                capture_output=True, text=True, cwd=os.path.dirname(
+                    os.path.abspath(__file__)))
+            if p.returncode == 0 and 'PROBE_OK' in p.stdout:
+                _log('probe ok: %s' % p.stdout.strip())
+                return True
+            detail = (p.stderr or p.stdout).strip()[-2000:]
+        except subprocess.TimeoutExpired:
+            detail = 'probe timed out after %ds (backend hung)' % timeout
+        last = detail.splitlines()[-1] if detail else '(no output)'
+        _log('probe failed: %s' % last)
+        if i + 1 < attempts:
+            time.sleep(interval)
+    return detail
+
+
+def run_child(argv):
+    """Watchdog wrapper: run the measurement in a child process,
+    relaying stderr; on timeout/crash emit diagnostic JSON."""
+    quick = '--quick' in argv
+    timeout = 720 if quick else 1500
+    cmd = [sys.executable, os.path.abspath(__file__), '--child'] + argv
+    _log('starting measurement child (timeout %ds)' % timeout)
+    try:
+        p = subprocess.run(cmd, timeout=timeout, stdout=subprocess.PIPE,
+                           text=True)  # stderr inherited -> live progress
+    except subprocess.TimeoutExpired:
+        emit(dict(METRIC, value=0.0, vs_baseline=0.0,
+                  error='bench_timeout',
+                  detail='child exceeded %ds' % timeout), rc=1)
+    lines = [ln for ln in (p.stdout or '').splitlines() if ln.strip()]
+    if p.returncode == 0 and lines:
+        try:
+            result = json.loads(lines[-1])
+        except ValueError:
+            emit(dict(METRIC, value=0.0, vs_baseline=0.0,
+                      error='bad_child_output',
+                      detail=lines[-1][-2000:]), rc=1)
+        emit(result)
+    emit(dict(METRIC, value=0.0, vs_baseline=0.0, error='bench_failed',
+              detail='child rc=%d, stdout tail: %s'
+              % (p.returncode, '\n'.join(lines)[-2000:])), rc=1)
+
+
+def measure(argv):
+    """The actual benchmark (runs inside the watchdogged child)."""
+    quick = '--quick' in argv
+    want_cost = '--no-cost' not in argv
+
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         '.jax_compile_cache')
+    jax.config.update('jax_compilation_cache_dir', cache)
+    jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
+    jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+
+    if '--cpu' in argv:
+        from chainermn_tpu.utils import force_host_devices
+        force_host_devices(8)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import ResNet50, StatefulClassifier
+
     n_dev = jax.device_count()
-    insize = 224
-    per_device_batch = 32
+    on_cpu = jax.default_backend() == 'cpu'
+    insize = 64 if on_cpu else (128 if quick else 224)
+    per_device_batch = 8 if on_cpu else 32
     batch = per_device_batch * n_dev
+    _log('backend=%s n_dev=%d insize=%d batch=%d'
+         % (jax.default_backend(), n_dev, insize, batch))
 
     comm = chainermn_tpu.create_communicator('xla')
     model = ResNet50(num_classes=1000)
@@ -62,42 +175,71 @@ def main():
     # not host-side re-collation of an identical batch
     arrays = updater.shard_batch([(x[i], y[i]) for i in range(batch)])
 
-    # warmup: broadcast step + 2 real steps (compile included)
-    for _ in range(3):
+    _log('compiling + warming up (first ResNet-50 TPU compile ~4-6 min '
+         'uncached; cached runs are seconds)')
+    n_warmup = 2 if quick else 3
+    for i in range(n_warmup):
         updater.update_core(arrays)
-    jax.block_until_ready(updater.params)
+        jax.block_until_ready(updater.params)
+        _log('warmup step %d/%d done' % (i + 1, n_warmup))
 
     n_steps = 5 if quick else 20
+    _log('timing %d steps' % n_steps)
     t0 = time.perf_counter()
     for _ in range(n_steps):
         updater.update_core(arrays)
     jax.block_until_ready(updater.params)
     dt = time.perf_counter() - t0
+    _log('timed %d steps in %.2fs' % (n_steps, dt))
 
     imgs_per_sec = batch * n_steps / dt
     per_chip = imgs_per_sec / n_dev
-    result = {
-        'metric': 'resnet50_train_images_per_sec_per_chip',
-        'value': round(per_chip, 2),
-        'unit': 'images/sec/chip',
-        'vs_baseline': round(per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 3),
-    }
-    if '--cost' in sys.argv:
+    # the 63 img/s/chip baseline is a 224px number; a conv net's
+    # per-image flops scale ~(insize/224)^2, so normalize the bar when
+    # --quick runs at 128px rather than inflating the ratio
+    baseline = BASELINE_IMG_PER_SEC_PER_CHIP * (224.0 / insize) ** 2
+    result = dict(
+        METRIC,
+        value=round(per_chip, 2),
+        vs_baseline=round(per_chip / baseline, 3),
+        n_devices=n_dev,
+        backend=jax.default_backend(),
+        insize=insize,
+        per_device_batch=per_device_batch,
+    )
+    if want_cost:
         # XLA's own FLOP count: lets the recorded number be
-        # sanity-checked against hardware peak (AOT-compiles a second
-        # copy of the step; adds minutes on TPU).  cost_analysis is of
-        # the per-device partitioned module, so these are per-chip.
+        # sanity-checked against hardware peak.  AOT-compiles a second
+        # copy of the step -- a disk-cache hit after the jit compile
+        # above, so cheap.
+        _log('cost analysis (compile-cache hit)')
         try:
             cost = updater.compiled_cost_analysis(arrays)
-            flops = cost.get('flops', 0.0)
+            flops = float(cost.get('flops', 0.0))
         except Exception as e:
-            print('cost analysis failed: %r' % e, file=sys.stderr)
+            _log('cost analysis failed: %r' % e)
             flops = 0.0
-        if flops:
+        if flops > 0:
+            achieved = flops * n_steps / dt / 1e12
             result['step_gflops_per_chip'] = round(flops / 1e9, 1)
-            result['achieved_tflops_per_chip'] = round(
-                flops * n_steps / dt / 1e12, 1)
-    print(json.dumps(result))
+            result['achieved_tflops_per_chip'] = round(achieved, 3)
+            if not on_cpu:
+                result['pct_of_v5e_bf16_peak'] = round(
+                    100.0 * achieved / V5E_BF16_PEAK_TFLOPS, 1)
+    print(json.dumps(result), flush=True)
+
+
+def main():
+    argv = [a for a in sys.argv[1:]]
+    if '--child' in argv:
+        measure([a for a in argv if a != '--child'])
+        return
+    if '--cpu' not in argv:
+        ok = probe_backend()
+        if ok is not True:
+            emit(dict(METRIC, value=0.0, vs_baseline=0.0,
+                      error='backend_unavailable', detail=ok), rc=1)
+    run_child(argv)
 
 
 if __name__ == '__main__':

@@ -11,6 +11,20 @@ import re
 import jax
 
 
+def ensure_host_device_flag(n=8):
+    """Append ``--xla_force_host_platform_device_count=n`` to
+    ``XLA_FLAGS`` unless some value for it is already present.  Safe
+    on any platform (only affects the host backend); must run before
+    first backend use to have an effect."""
+    flags = os.environ.get('XLA_FLAGS', '')
+    m = re.search(r'--xla_force_host_platform_device_count=(\d+)', flags)
+    if m is None:
+        os.environ['XLA_FLAGS'] = (
+            flags + ' --xla_force_host_platform_device_count=%d' % n
+        ).strip()
+    return m
+
+
 def force_host_devices(n=8, require=False):
     """Switch this process to the CPU backend with ``n`` virtual
     devices and return the live CPU device count.
@@ -23,12 +37,7 @@ def force_host_devices(n=8, require=False):
     for fewer, or the backend was initialized before this call could
     take effect.
     """
-    flags = os.environ.get('XLA_FLAGS', '')
-    m = re.search(r'--xla_force_host_platform_device_count=(\d+)', flags)
-    if m is None:
-        os.environ['XLA_FLAGS'] = (
-            flags + ' --xla_force_host_platform_device_count=%d' % n
-        ).strip()
+    m = ensure_host_device_flag(n)
     jax.config.update('jax_platforms', 'cpu')
     devices = jax.devices()
     if devices[0].platform != 'cpu':
