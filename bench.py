@@ -36,8 +36,15 @@ import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 63.0
-# TPU v5e: 197 TFLOP/s dense bf16 per chip
-V5E_BF16_PEAK_TFLOPS = 197.0
+# dense bf16 TFLOP/s per chip, by device_kind substring
+BF16_PEAK_TFLOPS = {
+    'v4': 275.0,
+    'v5e': 197.0,
+    'v5 lite': 197.0,
+    'v5p': 459.0,
+    'v6e': 918.0,
+    'v6 lite': 918.0,
+}
 METRIC = {
     'metric': 'resnet50_train_images_per_sec_per_chip',
     'unit': 'images/sec/chip',
@@ -223,9 +230,13 @@ def measure(argv):
             achieved = flops * n_steps / dt / 1e12
             result['step_gflops_per_chip'] = round(flops / 1e9, 1)
             result['achieved_tflops_per_chip'] = round(achieved, 3)
-            if not on_cpu:
-                result['pct_of_v5e_bf16_peak'] = round(
-                    100.0 * achieved / V5E_BF16_PEAK_TFLOPS, 1)
+            kind = jax.devices()[0].device_kind
+            peak = next((v for k, v in BF16_PEAK_TFLOPS.items()
+                         if k in kind.lower()), None)
+            if not on_cpu and peak:
+                result['device_kind'] = kind
+                result['pct_of_bf16_peak'] = round(
+                    100.0 * achieved / peak, 1)
     print(json.dumps(result), flush=True)
 
 

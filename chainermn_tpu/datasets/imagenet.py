@@ -130,8 +130,18 @@ class BatchAugmentPipeline:
     def __init__(self, dataset, crop_size, mean=None, random=True,
                  scale=1.0 / 255.0, seed=0):
         first, _ = dataset[0]
-        self._store = np.empty((len(dataset),) + np.shape(first),
-                               np.float32)
+        first = np.asarray(first)
+        # keep INTEGER datasets in their native dtype (uint8-backed
+        # real data stays uint8, 4x smaller) but normalize floats to
+        # float32 (a float64-yielding dataset must not double RAM);
+        # the per-batch float32 staging below is bounded by the batch
+        # size.  The whole-store preload still bounds this pipeline to
+        # datasets that fit in host RAM -- for bigger corpora use
+        # MultiprocessIterator over PreprocessedDataset.
+        store_dtype = (first.dtype if first.dtype.kind in 'iu'
+                       else np.float32)
+        self._store = np.empty((len(dataset),) + first.shape,
+                               store_dtype)
         self._labels = np.empty(len(dataset), np.int32)
         for i in range(len(dataset)):
             img, label = dataset[i]
@@ -160,18 +170,33 @@ class BatchAugmentPipeline:
             tops = np.full(b, (h - crop) // 2, np.int32)
             lefts = np.full(b, (w - crop) // 2, np.int32)
             flips = np.zeros(b, np.uint8)
-        labels = self._labels[np.asarray(indices, np.int64)]
+        idx64 = np.asarray(indices, np.int64)
+        # validate once for BOTH the native and the numpy path (numpy
+        # negative indexing would otherwise silently wrap)
+        if b and (idx64.min() < 0 or idx64.max() >= len(self._store)):
+            raise ValueError('batch indices out of range [0, %d)'
+                             % len(self._store))
+        labels = self._labels[idx64]
         from chainermn_tpu import native
         if native.available:
+            if self._store.dtype == np.float32:
+                src, src_idx = self._store, idx64
+            else:
+                # stage only this batch's source samples as float32
+                # (the C kernel consumes float32); B*H*W*C*4 bytes,
+                # not N*H*W*C*4
+                src = self._store[idx64].astype(np.float32)
+                src_idx = np.arange(b, dtype=np.int64)
             images = native.augment_batch(
-                self._store, indices, tops, lefts, flips, crop,
+                src, src_idx, tops, lefts, flips, crop,
                 mean=self.mean, scale=self.scale)
             return images, labels
         images = np.empty((b, crop, crop, self._store.shape[3]),
                           np.float32)
-        for i, idx in enumerate(indices):
+        for i, idx in enumerate(idx64):
             t, l = tops[i], lefts[i]
-            win = self._store[idx][t:t + crop, l:l + crop]
+            win = self._store[idx][t:t + crop, l:l + crop].astype(
+                np.float32)
             if self.mean is not None:
                 win = win - self.mean[t:t + crop, l:l + crop]
             win = win * self.scale

@@ -187,6 +187,18 @@ def augment_batch(samples, indices, tops, lefts, flips, crop, mean=None,
     tops = np.ascontiguousarray(tops, np.int32)
     lefts = np.ascontiguousarray(lefts, np.int32)
     flips = np.ascontiguousarray(flips, np.uint8)
+    # the C kernel is not told N/H/W: every index must be validated
+    # here or an out-of-range value drives an out-of-bounds read
+    if crop > h or crop > w:
+        raise ValueError('crop %d exceeds sample size (%d, %d)'
+                         % (crop, h, w))
+    if b:
+        if indices.min() < 0 or indices.max() >= n:
+            raise ValueError('sample_indices out of range [0, %d)' % n)
+        if tops.min() < 0 or tops.max() > h - crop:
+            raise ValueError('tops out of range [0, %d]' % (h - crop))
+        if lefts.min() < 0 or lefts.max() > w - crop:
+            raise ValueError('lefts out of range [0, %d]' % (w - crop))
     if out is None:
         out = np.empty((b, crop, crop, c), np.float32)
     mean_ptr = None

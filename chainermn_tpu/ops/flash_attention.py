@@ -10,13 +10,20 @@ HBM.  The MXU sees two large matmuls per tile; masking and the softmax
 bookkeeping ride the VPU.
 
 Layout: inputs are (B, T, H, D) like the rest of the framework; the
-kernel grid is (B*H, T/block_q) with the full K/V stream per grid row.
+kernel grid is (B*H, T/block_q, T/block_k) -- the opposite-operand
+stream is a *grid dimension*, so VMEM holds one (block_q, block_k)
+tile plus the running (m, l, acc) scratch regardless of sequence
+length (a full-stream block spec would put K+V linear-in-T in VMEM
+and blow the ~16MB budget at the 32k lengths TransformerLM allows).
+The softmax recurrence carries across the innermost grid axis in VMEM
+scratch; outputs are written on its final step.
 
 The backward pass is the standard flash backward split into two Mosaic
 kernels on TPU (dq over query blocks; dk/dv over key blocks, each
-streaming the opposite operand) with ``delta = rowsum(g * out)``
-precomputed; non-TPU backends use an equivalent blockwise ``lax.scan``
-formulation that doubles as the numerics oracle.
+streaming the opposite operand the same way) with
+``delta = rowsum(g * out)`` precomputed; non-TPU backends use an
+equivalent blockwise ``lax.scan`` formulation that doubles as the
+numerics oracle.
 """
 
 import functools
@@ -45,59 +52,74 @@ def mha_reference(q, k, v, causal=False, scale=None):
 # forward
 # ----------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                scale, causal, kv_len, block_q, block_k, t_kv):
-    """One (batch*head, query-block) grid cell; streams key blocks.
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref,
+                acc_ref, *, scale, causal, kv_len, block_q, block_k,
+                t_kv):
+    """One (batch*head, query-block, key-block) grid cell.
 
-    ``kv_len`` (static) masks out padded key positions >= kv_len.
+    The key-block axis is the innermost (sequential) grid dimension;
+    the running (m, l, acc) state lives in VMEM scratch across its
+    steps, so only one K/V tile is resident at a time.  ``m``/``l``
+    are kept lane-replicated at (block_q, 128) -- the Mosaic-friendly
+    layout for per-row scalars.  ``kv_len`` (static) masks out padded
+    key positions >= kv_len.
     """
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # (block_q, D)
-    n_blocks = t_kv // block_k
-    if causal:
-        # key blocks strictly after this query block contribute nothing
-        n_blocks = jnp.minimum(
-            n_blocks, pl.cdiv((qi + 1) * block_q, block_k))
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
 
-    d = q.shape[-1]
-    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((block_q,), jnp.float32)
-    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
     masked = causal or kv_len < t_kv
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32) * scale      # (block_q, D)
+        k = k_ref[0].astype(jnp.float32)              # (block_k, D)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # (block_q, block_k)
+            preferred_element_type=jnp.float32)       # (block_q, block_k)
         if masked:
             q_pos = (qi * block_q
                      + lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 0))
-            k_pos = (j * block_k
+            k_pos = (kj * block_k
                      + lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 1))
             ok = k_pos < kv_len
             if causal:
                 ok = jnp.logical_and(ok, q_pos >= k_pos)
             s = jnp.where(ok, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
+        m_prev = m_ref[...]                           # (block_q, 128)
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        m_ref[...] = m_new
+        l_ref[...] = (l_prev * alpha
+                      + jnp.sum(p, axis=-1, keepdims=True))
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jax.lax.dot_general(
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return m_new, l, acc
 
-    m, l, acc = lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, None]
+    if causal:
+        # key blocks strictly after this query block contribute nothing
+        pl.when(kj * block_k < (qi + 1) * block_q)(_accum)
+    else:
+        _accum()
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(l_safe))[:, :1]
 
 
 def _fwd_pallas(q, k, v, causal, scale, kv_len, block_q, block_k):
@@ -106,29 +128,34 @@ def _fwd_pallas(q, k, v, causal, scale, kv_len, block_q, block_k):
 
     bh, t_q, d = q.shape
     t_kv = k.shape[1]
-    grid = (bh, t_q // block_q)
+    grid = (bh, t_q // block_q, t_kv // block_k)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           kv_len=kv_len, block_q=block_q,
                           block_k=block_k, t_kv=t_kv),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
             jax.ShapeDtypeStruct((bh, t_q, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 128), jnp.float32),   # m (replicated)
+            pltpu.VMEM((block_q, 128), jnp.float32),   # l (replicated)
+            pltpu.VMEM((block_q, d), jnp.float32),     # acc
         ],
         interpret=interpret_flag(),
     )(q, k, v)
@@ -181,24 +208,29 @@ def _fwd_blockwise_jnp(q, k, v, causal, scale, kv_len, block_k):
 # ----------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                   dq_ref, *, scale, causal, kv_len, block_q, block_k,
-                   t_kv):
+                   dq_ref, acc_ref, *, scale, causal, kv_len, block_q,
+                   block_k, t_kv):
+    """dq: grid (bh, query-block, key-block); K/V tiles stream over
+    the innermost axis, dq accumulates in VMEM scratch."""
     import jax.experimental.pallas as pl
 
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)                  # (block_q, D)
-    g = g_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, 0]                            # (block_q,)
-    delta = delta_ref[0][:, 0]
-    n_blocks = t_kv // block_k
-    if causal:
-        n_blocks = jnp.minimum(
-            n_blocks, pl.cdiv((qi + 1) * block_q, block_k))
+    kj = pl.program_id(2)
+    n_kv = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
     masked = causal or kv_len < t_kv
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32)              # (block_q, D)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]                        # (block_q,)
+        delta = delta_ref[0][:, 0]
+        k = k_ref[0].astype(jnp.float32)              # (block_k, D)
+        v = v_ref[0].astype(jnp.float32)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -206,7 +238,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             q_pos = (qi * block_q
                      + lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 0))
-            k_pos = (j * block_k
+            k_pos = (kj * block_k
                      + lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 1))
             ok = k_pos < kv_len
@@ -218,42 +250,50 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(
+        acc_ref[...] = acc_ref[...] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    dq = lax.fori_loop(0, n_blocks, body,
-                       jnp.zeros_like(q))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    if causal:
+        pl.when(kj * block_k < (qi + 1) * block_q)(_accum)
+    else:
+        _accum()
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, kv_len, t_kv,
-                    block_q, block_k, t_q):
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    kv_len, t_kv, block_q, block_k, t_q):
+    """dk/dv: grid (bh, key-block, query-block); Q/G/lse/delta tiles
+    stream over the innermost axis, dk/dv accumulate in VMEM scratch."""
     import jax.experimental.pallas as pl
 
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)                  # (block_k, D)
-    v = v_ref[0].astype(jnp.float32)
-    n_blocks = t_q // block_q
-    j0 = 0
-    if causal:
-        # query blocks strictly before this key block contribute nothing
-        j0 = (ki * block_k) // block_q
-    masked = causal or kv_len < t_kv
-    d = k.shape[-1]
+    qj = pl.program_id(2)
+    n_q = pl.num_programs(2)
 
-    def body(j, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        g = g_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(j * block_q, block_q), 0]
-        delta = delta_ref[0, pl.ds(j * block_q, block_q), 0]
+    @pl.when(qj == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    masked = causal or kv_len < t_kv
+
+    def _accum():
+        k = k_ref[0].astype(jnp.float32)              # (block_k, D)
+        v = v_ref[0].astype(jnp.float32)
+        q = q_ref[0].astype(jnp.float32)              # (block_q, D)
+        g = g_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0]                        # (block_q,)
+        delta = delta_ref[0][:, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # (bq, bk)
         if masked:
-            q_pos = (j * block_q
+            q_pos = (qj * block_q
                      + lax.broadcasted_iota(jnp.int32,
                                             (block_q, block_k), 0))
             k_pos = (ki * block_k
@@ -264,22 +304,27 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                 ok = jnp.logical_and(ok, q_pos >= k_pos)
             s = jnp.where(ok, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
-        dv = dv + jax.lax.dot_general(
+        dv_acc[...] = dv_acc[...] + jax.lax.dot_general(
             p, g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
             g, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale
-        dk = dk + jax.lax.dot_general(
+        dk_acc[...] = dk_acc[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk, dv
 
-    dk0 = jnp.zeros((block_k, d), jnp.float32)
-    dk, dv = lax.fori_loop(j0, n_blocks, body, (dk0, dk0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    if causal:
+        # query blocks strictly before this key block contribute nothing
+        pl.when((qj + 1) * block_q > ki * block_k)(_accum)
+    else:
+        _accum()
+
+    @pl.when(qj == n_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _bwd_pallas(q, k, v, out, lse, g, causal, scale, kv_len,
@@ -294,43 +339,47 @@ def _bwd_pallas(q, k, v, out, lse, g, causal, scale, kv_len,
     lse3 = lse[..., None]
     delta3 = delta[..., None]
 
-    def spec_q(block):
-        return pl.BlockSpec((1, block, d), lambda b, i: (b, i, 0),
+    def q_blk(ix):
+        return pl.BlockSpec((1, block_q, d), ix,
                             memory_space=pltpu.VMEM)
 
-    full_kv = pl.BlockSpec((1, t_kv, d), lambda b, i: (b, 0, 0),
-                           memory_space=pltpu.VMEM)
-    full_q = pl.BlockSpec((1, t_q, d), lambda b, i: (b, 0, 0),
-                          memory_space=pltpu.VMEM)
-    row_q_blk = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
-                             memory_space=pltpu.VMEM)
-    row_q_full = pl.BlockSpec((1, t_q, 1), lambda b, i: (b, 0, 0),
-                              memory_space=pltpu.VMEM)
+    def kv_blk(ix):
+        return pl.BlockSpec((1, block_k, d), ix,
+                            memory_space=pltpu.VMEM)
 
+    def row_blk(ix):
+        return pl.BlockSpec((1, block_q, 1), ix,
+                            memory_space=pltpu.VMEM)
+
+    # dq: (b, i=query block, j=key block)
+    by_i = lambda b, i, j: (b, i, 0)   # noqa: E731
+    by_j = lambda b, i, j: (b, j, 0)   # noqa: E731
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           kv_len=kv_len, block_q=block_q,
                           block_k=block_k, t_kv=t_kv),
-        grid=(bh, t_q // block_q),
-        in_specs=[spec_q(block_q), full_kv, full_kv, spec_q(block_q),
-                  row_q_blk, row_q_blk],
-        out_specs=spec_q(block_q),
+        grid=(bh, t_q // block_q, t_kv // block_k),
+        in_specs=[q_blk(by_i), kv_blk(by_j), kv_blk(by_j), q_blk(by_i),
+                  row_blk(by_i), row_blk(by_i)],
+        out_specs=q_blk(by_i),
         out_shape=jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret_flag(),
     )(q, k, v, g, lse3, delta3)
 
-    kv_blk = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0),
-                          memory_space=pltpu.VMEM)
+    # dk/dv: (b, i=key block, j=query block)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           kv_len=kv_len, t_kv=t_kv, block_q=block_q,
                           block_k=block_k, t_q=t_q),
-        grid=(bh, t_kv // block_k),
-        in_specs=[full_q, kv_blk, kv_blk, full_q, row_q_full,
-                  row_q_full],
-        out_specs=[kv_blk, kv_blk],
+        grid=(bh, t_kv // block_k, t_q // block_q),
+        in_specs=[q_blk(by_j), kv_blk(by_i), kv_blk(by_i), q_blk(by_j),
+                  row_blk(by_j), row_blk(by_j)],
+        out_specs=[kv_blk(by_i), kv_blk(by_i)],
         out_shape=[jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
         interpret=interpret_flag(),
     )(q, k, v, g, lse3, delta3)
     return dq, dk, dv

@@ -75,10 +75,13 @@ def _leaf_update_pallas(g, v, lr, momentum):
         interpret=interpret_flag(),
     )(g2, v2)
 
-    def from2d(x):
-        return x.reshape(-1)[:n].reshape(shape).astype(dtype)
+    def from2d(x, out_dtype):
+        return x.reshape(-1)[:n].reshape(shape).astype(out_dtype)
 
-    return from2d(v_new), from2d(delta)
+    # velocity keeps its own (float32) state dtype -- casting it to
+    # g.dtype would silently carry bf16 momentum state on the native
+    # path and diverge from the jnp/optax trajectory
+    return from2d(v_new, v.dtype), from2d(delta, dtype)
 
 
 def _leaf_update_jnp(g, v, lr, momentum):

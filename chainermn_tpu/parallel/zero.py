@@ -18,6 +18,71 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def check_elementwise(optimizer, atol=1e-7):
+    """Probe whether ``optimizer`` is an ELEMENTWISE transform; raise
+    ValueError if not.
+
+    ZeRO-1 presents each device with flat 1-D shards of every leaf, so
+    any transform that reads cross-element structure (global-norm
+    clipping, LARS/LAMB trust ratios, adafactor's shape-based
+    factoring) computes over shards instead of true leaves and
+    silently diverges from the replicated trajectory.  Instead of
+    matching known-bad combinator names, two behavioral probes verify
+    the defining properties that make sharded == replicated:
+
+    1. *locality* -- perturbing ONE gradient element must not move any
+       OTHER element's update (catches global-norm clipping, LARS/LAMB
+       trust ratios);
+    2. *shape invariance* -- a 2-D leaf and its flattened 1-D twin
+       must produce elementwise-identical updates (catches adafactor's
+       shape-based factoring, which ZeRO's flattening would silently
+       disable).
+    """
+    import numpy as np
+
+    def fail(reason):
+        raise ValueError(
+            'zero=True requires an elementwise optimizer, but this '
+            'transform is not: %s.  Under ZeRO-1 every leaf becomes a '
+            'flat 1-D per-device shard, so such transforms compute '
+            'over shards instead of true leaves and the trajectory '
+            'silently diverges from zero=False.  Use zero=False for '
+            'this optimizer, or pass zero_check=False if the probe is '
+            'a false positive for your transform.' % reason)
+
+    # probe 1: locality
+    probe = {'a': jnp.linspace(0.5, 1.0, 5, dtype=jnp.float32),
+             'b': jnp.linspace(-1.0, -0.5, 3, dtype=jnp.float32)}
+    g1 = jax.tree_util.tree_map(jnp.ones_like, probe)
+    g2 = {'a': g1['a'].at[0].set(37.0), 'b': g1['b']}
+    u1, _ = optimizer.update(g1, optimizer.init(probe), probe)
+    u2, _ = optimizer.update(g2, optimizer.init(probe), probe)
+    others = np.concatenate([
+        np.abs(np.asarray(u1['a'] - u2['a']))[1:],
+        np.abs(np.asarray(u1['b'] - u2['b']))])
+    if np.any(others > atol):
+        fail('perturbing one gradient element moved updates at %d '
+             'other position(s) (max %.3g)'
+             % (int(np.sum(others > atol)), float(others.max())))
+
+    # probe 2: shape invariance.  The leaf must be large enough that
+    # shape-based special-casing actually engages (adafactor only
+    # factors dims >= its min_dim_size_to_factor, default 128).
+    side = 128
+    w = jnp.asarray(np.linspace(0.1, 1.0, side * side), jnp.float32)
+    g = jnp.cos(w * 3.0)
+    p2d, g2d = {'w': w.reshape(side, side)}, {'w': g.reshape(side, side)}
+    p1d, g1d = {'w': w}, {'w': g}
+    u2d, _ = optimizer.update(g2d, optimizer.init(p2d), p2d)
+    u1d, _ = optimizer.update(g1d, optimizer.init(p1d), p1d)
+    diff = np.abs(np.asarray(u2d['w']).reshape(-1)
+                  - np.asarray(u1d['w']))
+    if np.any(diff > atol):
+        fail('a 2-D leaf and its flattened 1-D twin produce different '
+             'updates (max diff %.3g) -- the transform reads leaf '
+             'shape' % float(diff.max()))
+
+
 def shard_len(size, n):
     """Per-device shard length for a flat leaf of ``size`` elements."""
     return -(-size // n)

@@ -35,7 +35,7 @@ class StandardUpdater:
 
     def __init__(self, iterator, optimizer, loss_fn, params, comm,
                  has_aux=False, donate=True, model_state=None, rng=None,
-                 zero=False, accum_steps=1):
+                 zero=False, accum_steps=1, zero_check=True):
         """``model_state``: optional non-trainable collections (e.g.
         BatchNorm running stats).  When given, ``loss_fn`` must have
         the extended signature
@@ -60,7 +60,10 @@ class StandardUpdater:
         that reads cross-element structure -- clip_by_global_norm,
         per-layer trust ratios (LARS/LAMB), adafactor's shape-based
         factoring -- computes over shards instead of true leaves and
-        silently diverges from zero=False.
+        silently diverges from zero=False.  This is ENFORCED at
+        construction by a behavioral probe
+        (:func:`chainermn_tpu.parallel.zero.check_elementwise`);
+        ``zero_check=False`` bypasses it.
 
         ``accum_steps=k`` splits each per-device batch into k
         micro-batches processed by ``lax.scan`` with gradients
@@ -101,6 +104,8 @@ class StandardUpdater:
                 raise ValueError(
                     'zero=True needs the raw optax optimizer, not the '
                     'multi-node wrapper (broadcast-first is built in)')
+            if zero_check:
+                zero_mod.check_elementwise(optimizer)
             from chainermn_tpu.communicators.mesh_utility import AXES
             self._zero_specs = zero_mod.state_specs(local_state, AXES)
             stacked = zero_mod.expand_state(local_state, comm.size)
@@ -290,8 +295,15 @@ class StandardUpdater:
         for the given sharded batch."""
         step_rng = (jax.random.fold_in(self._rng, self.iteration)
                     if self._has_state else self._rng)
-        lowered = self._step.lower(self.params, self.model_state,
-                                   self.opt_state, step_rng, *arrays)
+        if self._zero:
+            # mirror update_core's signature: needs_bcast sits between
+            # step_rng and the batch arrays
+            lowered = self._step.lower(
+                self.params, self.model_state, self.opt_state, step_rng,
+                jnp.asarray(self.iteration == 0), *arrays)
+        else:
+            lowered = self._step.lower(self.params, self.model_state,
+                                       self.opt_state, step_rng, *arrays)
         cost = lowered.compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}

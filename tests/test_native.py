@@ -71,9 +71,20 @@ class TestAugment:
         np.testing.assert_allclose(out, 1.0)
 
     def test_bad_crop_rejected(self):
+        # wrapper-level validation fires before the C kernel can read
+        # out of bounds (ADVICE r1: cmn_augment_batch is not told N)
         samples = np.zeros((1, 4, 4, 1), np.float32)
-        with pytest.raises(native.CommError):
+        with pytest.raises(ValueError):
             native.augment_batch(samples, [0], [3], [3], [0], 4)
+        with pytest.raises(ValueError):
+            native.augment_batch(samples, [0], [0], [0], [0], 5)
+
+    def test_bad_indices_rejected(self):
+        samples = np.zeros((2, 4, 4, 1), np.float32)
+        with pytest.raises(ValueError):
+            native.augment_batch(samples, [-1], [0], [0], [0], 4)
+        with pytest.raises(ValueError):
+            native.augment_batch(samples, [2], [0], [0], [0], 4)
 
 
 def _collective_worker(comm_id, n, rank, q):

@@ -164,3 +164,20 @@ class TestFusedSGD:
         np.testing.assert_allclose(new_p['w'], params['w'] - 0.5,
                                    atol=1e-6)
         np.testing.assert_allclose(new_v['w'], 1.0, atol=1e-6)
+
+    def test_bf16_grads_keep_f32_velocity(self, mode):
+        """Velocity keeps its own f32 state dtype even with bf16
+        params/grads on the kernel path (ADVICE r1: the native path
+        used to downcast momentum state to the gradient dtype)."""
+        params = {'w': _rand((9, 5), 3).astype(jnp.bfloat16)}
+        opt = ops.fused_momentum_sgd(0.1, momentum=0.9)
+        state = opt.init(params)
+        grads = jax.tree_util.tree_map(
+            lambda x: jnp.ones_like(x, jnp.bfloat16), params)
+        for _ in range(2):
+            upd, state = opt.update(grads, state, params)
+            params = optax.apply_updates(params, upd)
+        vel = jax.tree_util.tree_leaves(state)
+        assert all(v.dtype == jnp.float32 for v in vel
+                   if hasattr(v, 'dtype') and v.ndim), state
+        assert params['w'].dtype == jnp.bfloat16

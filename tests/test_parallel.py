@@ -225,3 +225,39 @@ def test_moe_layer_runs_and_balances():
     g = jax.jit(jax.grad(loss))(params)
     for leaf in jax.tree_util.tree_leaves(g):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_moe_sort_dispatch_matches_dense():
+    """The sort-based dispatch (VERDICT r1 item 7) must reproduce the
+    dense one-hot formulation exactly: same expert buffers, same
+    capacity cut (first-come priority), same combine, same grads."""
+    from chainermn_tpu.parallel.moe import (
+        dense_dispatch_reference, sort_dispatch)
+    rng = np.random.RandomState(11)
+    tokens, d_model, n_experts, capacity = 64, 8, 4, 9  # forces drops
+    x = jnp.asarray(rng.randn(tokens, d_model), jnp.float32)
+    expert_idx = jnp.asarray(rng.randint(0, n_experts, tokens))
+
+    ein_s, comb_s, keep_s = sort_dispatch(x, expert_idx, n_experts,
+                                          capacity)
+    ein_d, comb_d, keep_d = dense_dispatch_reference(
+        x, expert_idx, n_experts, capacity)
+    np.testing.assert_array_equal(np.asarray(keep_s), np.asarray(keep_d))
+    np.testing.assert_allclose(np.asarray(ein_s), np.asarray(ein_d),
+                               atol=1e-6)
+    out = jnp.asarray(rng.randn(n_experts, capacity, d_model),
+                      jnp.float32)
+    np.testing.assert_allclose(np.asarray(comb_s(out)),
+                               np.asarray(comb_d(out)), atol=1e-6)
+
+    # gradients through dispatch+combine agree
+    def run(dispatch):
+        def f(x):
+            ein, comb, keep = dispatch(x, expert_idx, n_experts,
+                                       capacity)
+            return jnp.sum(comb(jnp.tanh(ein)) ** 2)
+        return jax.grad(f)(x)
+
+    np.testing.assert_allclose(np.asarray(run(sort_dispatch)),
+                               np.asarray(run(dense_dispatch_reference)),
+                               atol=1e-5)

@@ -259,3 +259,34 @@ def test_pipeline_iterator_with_updater():
     m = upd.update()
     assert np.isfinite(m['loss'])
     assert it.epoch == 1  # 32 samples / batch 16 -> 2 iterations
+
+
+def test_batch_pipeline_uint8_store():
+    """uint8-backed datasets stay uint8 in the preload store (4x
+    smaller; ADVICE r1) and produce the same batches as float32."""
+    from chainermn_tpu.datasets.imagenet import BatchAugmentPipeline
+
+    class U8Set:
+        def __len__(self):
+            return 6
+
+        def __getitem__(self, i):
+            rng = np.random.RandomState(i)
+            return (rng.randint(0, 255, (12, 12, 3)).astype(np.uint8),
+                    np.int32(i % 3))
+
+    class F32Set(U8Set):
+        def __getitem__(self, i):
+            img, label = U8Set.__getitem__(self, i)
+            return img.astype(np.float32), label
+
+    mean = np.full((12, 12, 3), 100.0, np.float32)
+    pu = BatchAugmentPipeline(U8Set(), crop_size=8, mean=mean, seed=3)
+    pf = BatchAugmentPipeline(F32Set(), crop_size=8, mean=mean, seed=3)
+    assert pu._store.dtype == np.uint8
+    assert pf._store.dtype == np.float32
+    iu, lu = pu.batch([0, 2, 5, 1])
+    if_, lf = pf.batch([0, 2, 5, 1])
+    assert iu.dtype == np.float32
+    np.testing.assert_allclose(iu, if_, atol=1e-5)
+    np.testing.assert_array_equal(lu, lf)
