@@ -44,7 +44,6 @@ def main():
 
     import jax
     import jax.numpy as jnp
-    import numpy as np
     from jax.sharding import PartitionSpec as P
 
     import chainermn_tpu

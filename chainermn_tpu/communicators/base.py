@@ -24,7 +24,8 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.communicators import mesh_utility
-from chainermn_tpu.communicators.mesh_utility import AXIS_INTER, AXIS_INTRA, AXES
+from chainermn_tpu.communicators.mesh_utility import (
+    AXIS_INTER, AXIS_INTRA, AXES)
 
 
 def _is_tracing(tree):
@@ -208,6 +209,74 @@ class CommunicatorBase:
                 return stack.sum(axis=0)
             raise ValueError(op)
         return jax.tree_util.tree_map(red, vals)
+
+    # -- eager cross-process object channel ----------------------------
+    def _kv_client(self):
+        try:
+            from jax._src import distributed
+            client = distributed.global_state.client
+        except ImportError:  # pragma: no cover - jax internals moved
+            client = None
+        if client is None:
+            raise RuntimeError(
+                'cross-process object p2p needs jax.distributed to be '
+                'initialized (multi-controller); with one process use '
+                'plain Python values')
+        return client
+
+    def _p2p_channel(self):
+        """Stable per-mesh channel namespace so two communicators over
+        different meshes cannot cross wires.  NOTE: a communicator
+        REBUILT over the same mesh resumes the same channel at seq 0;
+        do not rebuild mid-conversation with unconsumed messages (pass
+        a distinct ``channel`` to send_obj/recv_obj to segregate)."""
+        import hashlib
+        fp = ','.join(str(d.id) for d in self.mesh.devices.flat)
+        fp += '|' + str(dict(self.mesh.shape))
+        return hashlib.sha1(fp.encode()).hexdigest()[:12]
+
+    def send_obj(self, obj, dest, tag=0, channel=None):
+        """Eagerly ship an arbitrary picklable object to process
+        ``dest``.
+
+        Parity: the reference's typed wire protocol / pickle p2p
+        (``_base.py:23-74``, ``dataset.py:29-43``) -- its eager MPI
+        channel for things that are not traced arrays (datasets,
+        configs, metrics).  Implemented over the jax.distributed
+        key-value store, so it works across hosts (DCN), not just
+        same-host like the shm engine.  FIFO per (src, dest, tag,
+        channel).
+        """
+        import base64
+        import pickle
+        client = self._kv_client()
+        channel = channel or self._p2p_channel()
+        seqs = self.__dict__.setdefault('_send_seq', {})
+        seq = seqs.get((dest, tag, channel), 0)
+        key = 'chainermn_tpu/p2p/%s/%d/%d/%d/%d' % (
+            channel, jax.process_index(), dest, tag, seq)
+        client.key_value_set(
+            key, base64.b64encode(pickle.dumps(obj)).decode('ascii'))
+        seqs[(dest, tag, channel)] = seq + 1
+
+    def recv_obj(self, source, tag=0, timeout=120.0, channel=None):
+        """Blocking receive of the next object from process
+        ``source`` (mirror of :meth:`send_obj`).  On timeout the
+        sequence cursor is NOT advanced, so the call can simply be
+        retried."""
+        import base64
+        import pickle
+        client = self._kv_client()
+        channel = channel or self._p2p_channel()
+        seqs = self.__dict__.setdefault('_recv_seq', {})
+        seq = seqs.get((source, tag, channel), 0)
+        key = 'chainermn_tpu/p2p/%s/%d/%d/%d/%d' % (
+            channel, source, jax.process_index(), tag, seq)
+        payload = client.blocking_key_value_get(key, int(timeout * 1000))
+        # only a successful get consumes the slot
+        seqs[(source, tag, channel)] = seq + 1
+        client.key_value_delete(key)
+        return pickle.loads(base64.b64decode(payload))
 
     # ------------------------------------------------------------------
     def __repr__(self):

@@ -108,11 +108,14 @@ class MultiNodeChainList:
         return self._run_dag(
             params, inputs,
             transfer=lambda y, src, dst: self._pin(y, dst),
-            emit=lambda y, rank: y)
+            emit=lambda y, rank: y,
+            ingest=self._pin)
 
-    def _run_dag(self, params, inputs, transfer, emit):
-        """Shared DAG walk; ``transfer(y, src, dst)`` realizes a
-        cross-rank edge, ``emit(y, rank)`` realizes a global output."""
+    def _run_dag(self, params, inputs, transfer, emit,
+                 ingest=lambda x, rank: x):
+        """Shared mode-agnostic DAG walk; ``transfer(y, src, dst)``
+        realizes a cross-rank edge, ``emit(y, rank)`` a global output,
+        ``ingest(x, rank)`` a stage's input arrival."""
         queues = {}
         outputs = []
         for (link, rank, rank_in, rank_out), p in zip(self._links, params):
@@ -129,8 +132,7 @@ class MultiNodeChainList:
                             'declaration order' % (rank, src))
                     xs.append(q.pop(0))
                 xs = tuple(xs)
-            if not self._spmd:
-                xs = tuple(self._pin(x, rank) for x in xs)
+            xs = tuple(ingest(x, rank) for x in xs)
             y = link(p, *xs) if p is not None else link(*xs)
             if rank_out is None:
                 outputs.append(emit(y, rank))

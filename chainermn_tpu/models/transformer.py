@@ -15,7 +15,6 @@ softmax bookkeeping stay float32.
 from typing import Any, Optional
 
 import flax.linen as nn
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -17,10 +17,16 @@ _STATUS = ['success', 'unhandled error', 'system error', 'internal error',
            'timeout', 'rank mismatch']
 
 # dtype tables (mirror the enums in chainermn_core.cpp; the reference's
-# analogous table is nccl.pyx:79-91)
+# analogous table incl. NCCL_HALF is nccl.pyx:79-91)
 _OPS = {'sum': 0, 'prod': 1, 'max': 2, 'min': 3}
 _DTYPES = {np.dtype(np.float32): 0, np.dtype(np.float64): 1,
-           np.dtype(np.int32): 2, np.dtype(np.int64): 3}
+           np.dtype(np.int32): 2, np.dtype(np.int64): 3,
+           np.dtype(np.float16): 5}
+try:
+    import ml_dtypes
+    _DTYPES[np.dtype(ml_dtypes.bfloat16)] = 4
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    pass
 
 
 class CommError(RuntimeError):

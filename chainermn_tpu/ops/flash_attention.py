@@ -129,6 +129,17 @@ def _fwd_pallas(q, k, v, causal, scale, kv_len, block_q, block_k):
     bh, t_q, d = q.shape
     t_kv = k.shape[1]
     grid = (bh, t_q // block_q, t_kv // block_k)
+    if causal:
+        # clamp the fetched K/V block at the causal frontier: steps
+        # beyond it are compute-skipped (pl.when), and the repeated
+        # block index makes Pallas elide the now-useless DMA instead
+        # of streaming ~2x the needed K/V traffic
+        def kv_ix(b, i, j):
+            frontier = ((i + 1) * block_q + block_k - 1) // block_k - 1
+            return (b, jnp.minimum(j, frontier), 0)
+    else:
+        def kv_ix(b, i, j):
+            return (b, j, 0)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, causal=causal,
                           kv_len=kv_len, block_q=block_q,
@@ -137,9 +148,9 @@ def _fwd_pallas(q, k, v, causal, scale, kv_len, block_q, block_k):
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, block_k, d), kv_ix,
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, block_k, d), kv_ix,
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -353,7 +364,13 @@ def _bwd_pallas(q, k, v, out, lse, g, causal, scale, kv_len,
 
     # dq: (b, i=query block, j=key block)
     by_i = lambda b, i, j: (b, i, 0)   # noqa: E731
-    by_j = lambda b, i, j: (b, j, 0)   # noqa: E731
+    if causal:
+        # same causal DMA elision as the forward (see _fwd_pallas)
+        def by_j(b, i, j):
+            frontier = ((i + 1) * block_q + block_k - 1) // block_k - 1
+            return (b, jnp.minimum(j, frontier), 0)
+    else:
+        by_j = lambda b, i, j: (b, j, 0)   # noqa: E731
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           kv_len=kv_len, block_q=block_q,
@@ -367,14 +384,22 @@ def _bwd_pallas(q, k, v, out, lse, g, causal, scale, kv_len,
         interpret=interpret_flag(),
     )(q, k, v, g, lse3, delta3)
 
-    # dk/dv: (b, i=key block, j=query block)
+    # dk/dv: (b, i=key block, j=query block); for causal, query
+    # blocks before the key block are skipped -- clamp the fetch from
+    # below so the leading dead steps re-fetch (elide) the first
+    # contributing block
+    if causal:
+        def by_jq(b, i, j):
+            return (b, jnp.maximum(j, (i * block_k) // block_q), 0)
+    else:
+        by_jq = lambda b, i, j: (b, j, 0)  # noqa: E731
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           kv_len=kv_len, t_kv=t_kv, block_q=block_q,
                           block_k=block_k, t_q=t_q),
         grid=(bh, t_kv // block_k, t_q // block_q),
-        in_specs=[q_blk(by_j), kv_blk(by_i), kv_blk(by_i), q_blk(by_j),
-                  row_blk(by_j), row_blk(by_j)],
+        in_specs=[q_blk(by_jq), kv_blk(by_i), kv_blk(by_i),
+                  q_blk(by_jq), row_blk(by_jq), row_blk(by_jq)],
         out_specs=[kv_blk(by_i), kv_blk(by_i)],
         out_shape=[jax.ShapeDtypeStruct((bh, t_kv, d), k.dtype),
                    jax.ShapeDtypeStruct((bh, t_kv, d), v.dtype)],

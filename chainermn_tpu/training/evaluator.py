@@ -10,7 +10,6 @@ averaging parity (``multi_node_evaluator.py:31-38``).
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.communicators.mesh_utility import AXES

@@ -12,7 +12,6 @@ Python work beyond feeding the next batch.
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 

@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Dependency-free lint pass (reference parity: ``.travis.yml:51-54``
+runs flake8/autopep8; this image ships no linter, so CI enforces the
+core rules with the stdlib and ``setup.cfg`` keeps the real flake8
+config for environments that have it).
+
+Checks: syntax (ast), line length <= 79, trailing whitespace, tabs in
+indentation, unused ``import x`` / ``from x import y`` bindings at
+module scope (noqa-comment aware), missing newline at EOF.
+"""
+
+import ast
+import os
+import sys
+
+MAX_LEN = 79
+EXCLUDE = {'.git', '__pycache__', 'build', 'docs', '.jax_compile_cache',
+           'result', '.pytest_cache'}
+
+
+def iter_py(root):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in EXCLUDE
+                       and not d.startswith('result')]
+        for fn in filenames:
+            if fn.endswith('.py'):
+                yield os.path.join(dirpath, fn)
+
+
+def unused_imports(tree, src_lines):
+    names = {}  # alias -> (lineno, qualname)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split('.')[0]
+                names[alias] = (node.lineno, a.name)
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == '*':
+                    continue
+                alias = a.asname or a.name
+                names[alias] = (node.lineno, a.name)
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            pass  # the base Name is what binds
+    out = []
+    for alias, (lineno, qual) in sorted(names.items()):
+        line = src_lines[lineno - 1] if lineno - 1 < len(src_lines) else ''
+        if 'noqa' in line:
+            continue
+        if alias not in used:
+            out.append((lineno, 'F401 %r imported but unused' % qual))
+    return out
+
+
+def lint_file(path):
+    problems = []
+    with open(path, 'rb') as f:
+        raw = f.read()
+    if raw and not raw.endswith(b'\n'):
+        problems.append((len(raw.splitlines()), 'W292 no newline at EOF'))
+    try:
+        src = raw.decode('utf-8')
+    except UnicodeDecodeError as e:
+        return [(0, 'E902 %s' % e)]
+    lines = src.splitlines()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, 'E999 %s' % e.msg)]
+    for i, line in enumerate(lines, 1):
+        if 'noqa' in line:
+            continue
+        if len(line) > MAX_LEN:
+            problems.append((i, 'E501 line too long (%d > %d)'
+                             % (len(line), MAX_LEN)))
+        if line != line.rstrip():
+            problems.append((i, 'W291 trailing whitespace'))
+        stripped = line.lstrip(' ')
+        if stripped.startswith('\t') or line.startswith('\t'):
+            problems.append((i, 'W191 tab in indentation'))
+    problems.extend(unused_imports(tree, lines))
+    return sorted(problems)
+
+
+def main(root='.'):
+    total = 0
+    for path in sorted(iter_py(root)):
+        for lineno, msg in lint_file(path):
+            print('%s:%d: %s' % (os.path.relpath(path, root), lineno, msg))
+            total += 1
+    print('%d problem(s)' % total)
+    return 1 if total else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else '.'))

@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Lint pass (reference parity: .travis.yml:51-54).  Uses flake8 when
+# installed (config in setup.cfg); otherwise the stdlib fallback
+# enforcing the core rule set.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+if python -c 'import flake8' 2>/dev/null; then
+    python -m flake8 .
+else
+    python ci/lint_fallback.py .
+fi

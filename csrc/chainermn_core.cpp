@@ -356,7 +356,10 @@ CMN_API int cmn_comm_size(void* handle) {
 }
 
 enum CmnOp { CMN_SUM = 0, CMN_PROD = 1, CMN_MAX = 2, CMN_MIN = 3 };
-enum CmnDtype { CMN_F32 = 0, CMN_F64 = 1, CMN_I32 = 2, CMN_I64 = 3 };
+// CMN_BF16/CMN_F16 mirror the reference's NCCL_HALF surface
+// (nccl.pyx:87); bf16 is the TPU-native dtype.
+enum CmnDtype { CMN_F32 = 0, CMN_F64 = 1, CMN_I32 = 2, CMN_I64 = 3,
+                CMN_BF16 = 4, CMN_F16 = 5 };
 
 static size_t dtype_size(int dtype) {
   switch (dtype) {
@@ -364,7 +367,113 @@ static size_t dtype_size(int dtype) {
     case CMN_F64: return 8;
     case CMN_I32: return 4;
     case CMN_I64: return 8;
+    case CMN_BF16: return 2;
+    case CMN_F16: return 2;
     default: return 0;
+  }
+}
+
+// ---- 16-bit float conversions (scalar; host reduction payloads are
+// small).  bf16 uses round-to-nearest-even truncation; f16 is IEEE
+// binary16 with subnormal handling.
+static inline float bf16_to_f32(uint16_t v) {
+  uint32_t b = static_cast<uint32_t>(v) << 16;
+  float f;
+  memcpy(&f, &b, 4);
+  return f;
+}
+
+static inline uint16_t f32_to_bf16(float f) {
+  uint32_t b;
+  memcpy(&b, &f, 4);
+  if ((b & 0x7f800000u) == 0x7f800000u) {
+    // inf stays inf; NaN keeps a quiet bit even when the payload
+    // lives only in the truncated low 16 bits (else NaN -> inf)
+    uint16_t hi = static_cast<uint16_t>(b >> 16);
+    if ((b & 0x007fffffu) != 0) hi |= 0x0040u;
+    return hi;
+  }
+  uint32_t rounding = 0x7fffu + ((b >> 16) & 1u);
+  return static_cast<uint16_t>((b + rounding) >> 16);
+}
+
+static inline float f16_to_f32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1fu;
+  uint32_t mant = h & 0x3ffu;
+  uint32_t f;
+  if (exp == 0) {
+    if (mant == 0) {
+      f = sign;
+    } else {  // subnormal: renormalize
+      exp = 127 - 15 + 1;
+      while (!(mant & 0x400u)) {
+        mant <<= 1;
+        --exp;
+      }
+      mant &= 0x3ffu;
+      f = sign | (exp << 23) | (mant << 13);
+    }
+  } else if (exp == 31) {
+    f = sign | 0x7f800000u | (mant << 13);
+  } else {
+    f = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  memcpy(&out, &f, 4);
+  return out;
+}
+
+static inline uint16_t f32_to_f16(float x) {
+  uint32_t b;
+  memcpy(&b, &x, 4);
+  uint32_t sign = (b >> 16) & 0x8000u;
+  int32_t exp = static_cast<int32_t>((b >> 23) & 0xffu) - 127 + 15;
+  uint32_t mant = b & 0x7fffffu;
+  if (((b >> 23) & 0xffu) == 0xffu)  // inf/nan
+    return static_cast<uint16_t>(sign | 0x7c00u | (mant ? 0x200u : 0));
+  if (exp >= 31) return static_cast<uint16_t>(sign | 0x7c00u);  // overflow
+  if (exp <= 0) {  // subnormal or underflow
+    if (exp < -10) return static_cast<uint16_t>(sign);
+    mant |= 0x800000u;
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half = mant >> shift;
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t halfway = 1u << (shift - 1);
+    if (rem > halfway || (rem == halfway && (half & 1)))
+      ++half;  // round to nearest even
+    return static_cast<uint16_t>(sign | half);
+  }
+  uint32_t half = sign | (static_cast<uint32_t>(exp) << 10) | (mant >> 13);
+  uint32_t rem = mant & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) ++half;
+  return static_cast<uint16_t>(half);
+}
+
+struct Bf16Cvt {
+  static float to(uint16_t v) { return bf16_to_f32(v); }
+  static uint16_t from(float f) { return f32_to_bf16(f); }
+};
+struct F16Cvt {
+  static float to(uint16_t v) { return f16_to_f32(v); }
+  static uint16_t from(float f) { return f32_to_f16(f); }
+};
+
+template <typename Cvt>
+static void reduce_typed_16(uint16_t* acc, const uint16_t* src, int64_t n,
+                            int op) {
+  for (int64_t i = 0; i < n; ++i) {
+    float a = Cvt::to(acc[i]);
+    float s = Cvt::to(src[i]);
+    float r;
+    switch (op) {
+      case CMN_SUM: r = a + s; break;
+      case CMN_PROD: r = a * s; break;
+      case CMN_MAX: r = a > s ? a : s; break;
+      case CMN_MIN: r = a < s ? a : s; break;
+      default: r = a; break;
+    }
+    acc[i] = Cvt::from(r);
   }
 }
 
@@ -406,6 +515,16 @@ static void reduce_dispatch(void* acc, const void* src, int64_t count,
     case CMN_I64:
       reduce_typed(static_cast<int64_t*>(acc),
                    static_cast<const int64_t*>(src), count, op);
+      break;
+    case CMN_BF16:
+      reduce_typed_16<Bf16Cvt>(static_cast<uint16_t*>(acc),
+                               static_cast<const uint16_t*>(src), count,
+                               op);
+      break;
+    case CMN_F16:
+      reduce_typed_16<F16Cvt>(static_cast<uint16_t*>(acc),
+                              static_cast<const uint16_t*>(src), count,
+                              op);
       break;
   }
 }

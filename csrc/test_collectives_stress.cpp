@@ -41,6 +41,20 @@ static int failures = 0;
     }                                                      \
   } while (0)
 
+// local bf16 helpers (small exactly-representable integers only, so
+// sums compare exactly)
+static unsigned short to_bf16(float f) {
+  unsigned int b;
+  memcpy(&b, &f, 4);
+  return static_cast<unsigned short>(b >> 16);
+}
+static float from_bf16(unsigned short v) {
+  unsigned int b = static_cast<unsigned int>(v) << 16;
+  float f;
+  memcpy(&f, &b, 4);
+  return f;
+}
+
 static void rank_main(const std::string& name, int n, int rank,
                       int iters) {
   void* comm = cmn_comm_create(name.c_str(), n, rank, 1 << 16, 30.0);
@@ -67,6 +81,17 @@ static void rank_main(const std::string& name, int n, int rank,
             "bcast value");
     st = cmn_allgather(comm, send.data(), gather.data(), count, 0);
     CHECK(st == 0, cmn_error_string(st));
+    // bf16 allreduce (dtype 4): small ints stay exact in bf16 for
+    // n <= 8, it < ~100
+    std::vector<unsigned short> hsend(count), hrecv(count);
+    for (int i = 0; i < count; ++i)
+      hsend[i] = to_bf16(static_cast<float>(rank + i % 5));
+    st = cmn_allreduce(comm, hsend.data(), hrecv.data(), count, 4, 0);
+    CHECK(st == 0, cmn_error_string(st));
+    for (int i = 0; i < count; ++i) {
+      float expect = n * (i % 5) + n * (n - 1) / 2.0f;
+      CHECK(from_bf16(hrecv[i]) == expect, "bf16 allreduce value");
+    }
     st = cmn_barrier(comm);
     CHECK(st == 0, cmn_error_string(st));
   }

@@ -1,0 +1,114 @@
+"""Multi-controller worker for ``tests/test_multiprocess.py``.
+
+One REAL process per invocation (the TPU-native analogue of one
+``mpiexec`` rank, reference ``.travis.yml:55``): initializes
+``jax.distributed`` over CPU+gloo with 2 virtual devices per process,
+then exercises every per-process surface that single-process tests
+cannot -- topology accessors, ``scatter_dataset`` per-process shards,
+``allreduce_obj``, the eager object p2p channel, a cross-process
+device collective, and an orbax per-host sharded save/restore --
+writing a JSON result file the parent test asserts on.
+"""
+
+import json
+import os
+import sys
+
+LOCAL_DEVICES = 2
+
+
+def main():
+    rank = int(os.environ['CMN_MP_RANK'])
+    nprocs = int(os.environ['CMN_MP_NPROCS'])
+    port = os.environ['CMN_MP_PORT']
+    outdir = os.environ['CMN_MP_OUT']
+
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = (
+        '--xla_force_host_platform_device_count=%d' % LOCAL_DEVICES)
+    os.environ.setdefault('JAX_CPU_COLLECTIVES_IMPLEMENTATION', 'gloo')
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    jax.distributed.initialize(coordinator_address='localhost:' + port,
+                               num_processes=nprocs, process_id=rank)
+
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import chainermn_tpu
+    from chainermn_tpu import serializers
+
+    res = {
+        'process_index': int(jax.process_index()),
+        'process_count': int(jax.process_count()),
+        'device_count': int(jax.device_count()),
+        'local_device_count': int(jax.local_device_count()),
+    }
+
+    # mesh: inter axis = processes, intra axis = local devices
+    comm = chainermn_tpu.create_communicator(
+        'xla', mesh_shape=(nprocs, LOCAL_DEVICES))
+    res['comm_size'] = comm.size
+    res['comm_rank'] = comm.rank
+    res['comm_process_count'] = comm.process_count
+    res['comm_process_rank'] = comm.process_rank_in_mesh()
+
+    # scatter_dataset: per-process shard (union/coverage asserted by
+    # the parent across ranks)
+    ds = list(range(23))
+    sub = chainermn_tpu.scatter_dataset(ds, comm)
+    res['shard'] = [int(sub[i]) for i in range(len(sub))]
+
+    # eager cross-process object allreduce (evaluator parity)
+    mean = comm.allreduce_obj(float(rank + 1), op='mean')
+    res['allreduce_obj_mean'] = float(np.asarray(mean))
+    tot = comm.allreduce_obj({'metric': np.float32(rank)}, op='sum')
+    res['allreduce_obj_sum'] = float(np.asarray(tot['metric']))
+
+    # eager object p2p ring: arbitrary pickled payload crosses process
+    # boundaries (reference dataset.py:29-43 pickle channel parity)
+    payload = {'from': rank, 'data': list(range(rank + 1))}
+    comm.send_obj(payload, (rank + 1) % nprocs, tag=7)
+    got = comm.recv_obj((rank - 1) % nprocs, tag=7)
+    res['p2p_from'] = got['from']
+    res['p2p_len'] = len(got['data'])
+
+    # cross-process device collective: global batch sharded over ALL
+    # devices of the multi-process mesh, jitted shard_map psum
+    rows_per_proc = LOCAL_DEVICES
+    local = np.arange(rank * rows_per_proc * 4,
+                      (rank + 1) * rows_per_proc * 4,
+                      dtype=np.float32).reshape(rows_per_proc, 4)
+    sharding = NamedSharding(comm.mesh, comm.batch_spec())
+    garr = jax.make_array_from_process_local_data(
+        sharding, local, (nprocs * rows_per_proc, 4))
+
+    def f(x):
+        return jax.lax.psum(jnp.sum(x), ('inter', 'intra'))
+
+    total = jax.jit(jax.shard_map(
+        f, mesh=comm.mesh, in_specs=comm.batch_spec(),
+        out_specs=P(), check_vma=False))(garr)
+    res['global_psum'] = float(total)
+
+    # orbax per-host sharded save/restore
+    ckdir = os.path.join(outdir, 'ckpt')
+    serializers.save_checkpoint(ckdir, {'x': garr}, step=1)
+    restored = serializers.restore_checkpoint(ckdir, {'x': garr},
+                                              step=1)
+    err = jax.jit(jax.shard_map(
+        lambda a, b: jax.lax.psum(jnp.sum(jnp.abs(a - b)),
+                                  ('inter', 'intra')),
+        mesh=comm.mesh,
+        in_specs=(comm.batch_spec(), comm.batch_spec()),
+        out_specs=P(), check_vma=False))(garr, restored['x'])
+    res['ckpt_roundtrip_err'] = float(err)
+
+    with open(os.path.join(outdir, 'rank%d.json' % rank), 'w') as fh:
+        json.dump(res, fh)
+    print('worker %d OK' % rank, flush=True)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

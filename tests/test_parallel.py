@@ -7,7 +7,6 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-import chainermn_tpu
 from chainermn_tpu.parallel import (MoELayer, Pipeline, ring_attention,
                                     tp_mlp)
 from chainermn_tpu.parallel.pipeline import microbatch, stack_stage_params

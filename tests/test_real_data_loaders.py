@@ -1,0 +1,101 @@
+"""Real-data ingestion paths (VERDICT r1 item 9).
+
+The ``CHAINERMN_TPU_MNIST`` / ``CHAINERMN_TPU_IMAGENET`` loaders exist
+for deployments with data on disk; without coverage they are dead
+code.  Each test writes a tiny on-disk fixture in the exact documented
+format and asserts the loader produces it (not the synthetic
+stand-in).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def clean_env(monkeypatch):
+    monkeypatch.delenv('CHAINERMN_TPU_MNIST', raising=False)
+    monkeypatch.delenv('CHAINERMN_TPU_IMAGENET', raising=False)
+    return monkeypatch
+
+
+def test_mnist_real_file(tmp_path, clean_env):
+    """mnist.npz-style file: x_train/y_train/x_test/y_test keys,
+    uint8 images scaled to [0, 1] float32."""
+    from chainermn_tpu.datasets.mnist import get_mnist
+    rng = np.random.RandomState(0)
+    fix = {
+        'x_train': rng.randint(0, 256, (20, 28, 28)).astype(np.uint8),
+        'y_train': rng.randint(0, 10, 20).astype(np.int64),
+        'x_test': rng.randint(0, 256, (8, 28, 28)).astype(np.uint8),
+        'y_test': rng.randint(0, 10, 8).astype(np.int64),
+    }
+    path = tmp_path / 'mnist.npz'
+    np.savez(path, **fix)
+    clean_env.setenv('CHAINERMN_TPU_MNIST', str(path))
+
+    train, test = get_mnist()
+    assert len(train) == 20 and len(test) == 8
+    x0, y0 = train[0]
+    assert x0.shape == (784,) and x0.dtype == np.float32
+    np.testing.assert_allclose(
+        x0, fix['x_train'][0].reshape(-1) / 255.0, atol=1e-6)
+    assert y0 == np.int32(fix['y_train'][0])
+    # ndim=3 path reshapes to NCHW
+    train3, _ = get_mnist(ndim=3)
+    assert train3[0][0].shape == (1, 28, 28)
+    # withlabel=False path
+    train_x, _ = get_mnist(withlabel=False)
+    assert train_x[0].shape == (784,)
+
+
+def test_mnist_missing_file_falls_back(tmp_path, clean_env):
+    clean_env.setenv('CHAINERMN_TPU_MNIST',
+                     str(tmp_path / 'missing.npz'))
+    from chainermn_tpu.datasets.mnist import get_mnist
+    train, test = get_mnist()
+    assert len(train) > 0  # synthetic stand-in engaged, no crash
+
+
+def test_imagenet_real_dir(tmp_path, clean_env):
+    """Directory with train.txt/val.txt lists of (path label) pairs
+    pointing at .npy HWC arrays (``train_imagenet.py:141-151``
+    format)."""
+    from chainermn_tpu.datasets.imagenet import get_imagenet
+    rng = np.random.RandomState(1)
+    os.makedirs(tmp_path / 'imgs')
+    lines = {'train.txt': [], 'val.txt': []}
+    imgs = {}
+    for split, n in (('train.txt', 5), ('val.txt', 2)):
+        for i in range(n):
+            rel = 'imgs/%s_%d.npy' % (split.split('.')[0], i)
+            img = rng.randint(0, 256, (32, 32, 3)).astype(np.uint8)
+            np.save(tmp_path / rel, img)
+            imgs[rel] = img
+            lines[split].append('%s %d' % (rel, i % 3))
+    for split, ls in lines.items():
+        (tmp_path / split).write_text('\n'.join(ls) + '\n')
+    clean_env.setenv('CHAINERMN_TPU_IMAGENET', str(tmp_path))
+
+    train, val = get_imagenet()
+    assert len(train) == 5 and len(val) == 2
+    img, label = train[0]
+    np.testing.assert_array_equal(img, imgs['imgs/train_0.npy'])
+    assert label == 0
+
+    # the loader output feeds the preprocessing pipeline unchanged
+    from chainermn_tpu.datasets.imagenet import (
+        BatchAugmentPipeline, PreprocessedDataset, compute_mean)
+    mean = compute_mean(train)
+    assert mean.shape == (32, 32, 3)
+    pre = PreprocessedDataset(train, mean, crop_size=24, random=False)
+    x, y = pre[1]
+    assert x.shape == (24, 24, 3) and x.dtype == np.float32
+    pipe = BatchAugmentPipeline(train, crop_size=24, mean=mean,
+                                random=False)
+    assert pipe._store.dtype == np.uint8  # native dtype preserved
+    xb, yb = pipe.batch([0, 1, 2])
+    assert xb.shape == (3, 24, 24, 3)
+    # center-crop pipeline output matches the per-item path
+    np.testing.assert_allclose(xb[1], x, atol=1e-5)
