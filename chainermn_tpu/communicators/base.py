@@ -247,17 +247,32 @@ class CommunicatorBase:
         same-host like the shm engine.  FIFO per (src, dest, tag,
         channel).
         """
+        import atexit
         import base64
         import pickle
+        import time
         client = self._kv_client()
         channel = channel or self._p2p_channel()
         seqs = self.__dict__.setdefault('_send_seq', {})
-        seq = seqs.get((dest, tag, channel), 0)
+        stream = (dest, tag, channel)
+        seq = seqs.get(stream, 0)
         key = 'chainermn_tpu/p2p/%s/%d/%d/%d/%d' % (
             channel, jax.process_index(), dest, tag, seq)
         client.key_value_set(
             key, base64.b64encode(pickle.dumps(obj)).decode('ascii'))
-        seqs[(dest, tag, channel)] = seq + 1
+        seqs[stream] = seq + 1
+        # Hygiene (VERDICT r2 item 10): remember every key this process
+        # published so undelivered ones can be GC'd -- a dead receiver
+        # must not leak the coordinator's store.  recv_obj deletes on
+        # consume; p2p_gc() sweeps the rest at teardown.
+        sent = self.__dict__.setdefault('_p2p_sent_keys', {})
+        sent[key] = (stream, seq, time.monotonic())
+        if not self.__dict__.get('_p2p_atexit_registered'):
+            # registered once per communicator; sweep only keys that
+            # have sat undelivered for a while, so a receiver that is
+            # alive but slow does not lose an in-flight message
+            atexit.register(self.p2p_gc, grace=60.0)
+            self._p2p_atexit_registered = True
 
     def recv_obj(self, source, tag=0, timeout=120.0, channel=None):
         """Blocking receive of the next object from process
@@ -277,6 +292,71 @@ class CommunicatorBase:
         seqs[(source, tag, channel)] = seq + 1
         client.key_value_delete(key)
         return pickle.loads(base64.b64decode(payload))
+
+    def p2p_gc(self, grace=0.0):
+        """Delete object-p2p keys this process published that have not
+        (observably) been consumed, for streams whose outstanding keys
+        are ALL older than ``grace`` seconds, then roll each swept
+        stream's send cursor back so a re-send reuses the freed
+        sequence slots (the receiver's cursor never advanced past
+        them, so retry works end-to-end).  Streams with any younger
+        outstanding key are skipped whole -- never partially swept.
+
+        Registered once per communicator at interpreter exit with
+        ``grace=60``: keys younger than that are likely in flight to a
+        live-but-slow receiver and are left alone (they leak only if
+        the receiver is truly gone); older undelivered keys are the
+        dead-receiver garbage this sweep exists for.  ``grace=0``
+        sweeps everything immediately (tests, explicit teardown).
+        Deleting a key the receiver already consumed is a no-op.
+        Parity anchor: the reference's eager channel tears down with
+        the MPI communicator (``_base.py:23-74``); the KV store has no
+        such lifetime, so we give it one.
+        """
+        import time
+        sent = self.__dict__.get('_p2p_sent_keys')
+        if not sent:
+            return
+        now = time.monotonic()
+        # sweep whole streams atomically: if ANY key of a stream is
+        # younger than grace, leave the entire stream alone.  Sweeping
+        # an age prefix while newer keys survive would rewind the
+        # cursor underneath live messages (retries would collide with
+        # or be shadowed by the stale survivors).
+        young_streams = {v[0] for v in sent.values()
+                         if now - v[2] < grace}
+        old = {k: v for k, v in sent.items()
+               if v[0] not in young_streams}
+        if not old:
+            return
+        try:
+            client = self._kv_client()
+        except Exception:
+            return  # runtime already gone; nothing to clean
+        swept_min = {}
+        for key in sorted(old):
+            stream, seq, _ = old[key]
+            try:
+                # distinguish consumed (receiver deleted it: cursor must
+                # NOT rewind) from undelivered (still present: delete
+                # and free its sequence slot for a retry)
+                present = True
+                try:
+                    client.key_value_try_get(key)
+                except Exception:
+                    present = False
+                if present:
+                    client.key_value_delete(key)
+                    swept_min[stream] = min(
+                        swept_min.get(stream, seq), seq)
+                del sent[key]
+            except Exception:
+                continue  # best-effort: coordinator may be shutting down
+        # rewind send cursors so "re-send after sweep" lands where the
+        # receiver is still waiting
+        seqs = self.__dict__.get('_send_seq', {})
+        for stream, seq in swept_min.items():
+            seqs[stream] = min(seqs.get(stream, seq), seq)
 
     # ------------------------------------------------------------------
     def __repr__(self):

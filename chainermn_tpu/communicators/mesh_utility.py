@@ -4,14 +4,16 @@ TPU-native replacement for the reference's hostname-based rank discovery
 (``chainermn/communicators/_communication_utility.py:7-40`` groups MPI
 ranks by ``MPI.Get_processor_name()`` into (intra_rank, inter_rank)).
 
-On TPU the two-level topology is intrinsic: devices within one host /
-slice talk over ICI, hosts talk over DCN.  We therefore build a 2-D
-``jax.sharding.Mesh`` with axes ``('inter', 'intra')``:
+On TPU the two-level topology is intrinsic: chips within one SLICE talk
+over ICI (even when several host processes feed the slice), slices talk
+over DCN.  We therefore build a 2-D ``jax.sharding.Mesh`` with axes
+``('inter', 'intra')``:
 
-- ``intra`` -- devices that share a process (>= ICI locality), the
-  analogue of the reference's intra-node NCCL group,
-- ``inter`` -- across processes (DCN), the analogue of the reference's
-  inter-node MPI group.
+- ``intra`` -- one ICI domain: all chips of a slice when the runtime
+  exposes ``slice_index``, else the chips of one process (CPU
+  fallback); the analogue of the reference's intra-node NCCL group,
+- ``inter`` -- across ICI domains (DCN), the analogue of the
+  reference's inter-node MPI group.
 
 No launcher is involved: JAX's runtime enumerates global devices, so the
 all-gather/scatter handshake the reference performs at
@@ -25,36 +27,79 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-#: Mesh axis that maps to DCN (across hosts) -- reference "inter_rank".
+#: Mesh axis that maps to DCN (across slices) -- reference "inter_rank".
 AXIS_INTER = 'inter'
-#: Mesh axis that maps to ICI (within a host/slice) -- reference "intra_rank".
+#: Mesh axis that maps to ICI (within one slice, possibly spanning
+#: several host processes) -- reference "intra_rank".
 AXIS_INTRA = 'intra'
 #: Both axes, in majorness order; data parallelism spans the product.
 AXES = (AXIS_INTER, AXIS_INTRA)
 
 
+def _ici_domain(d):
+    """The device's ICI domain id, or ``None`` when the runtime does
+    not expose one.
+
+    On multi-slice TPU deployments every chip carries a
+    ``slice_index``: ICI spans ALL chips of a slice -- including chips
+    owned by different host processes -- and DCN only separates
+    slices.  The process boundary is therefore the WRONG locality
+    proxy there (a v5e-64 is 16 processes but ONE ICI domain).
+    """
+    return getattr(d, 'slice_index', None)
+
+
 def sorted_devices(devices=None):
-    """Global devices in deterministic (process_index, id) order."""
+    """Global devices in deterministic (slice, process, id) order, so
+    a ``reshape(inter, intra)`` groups each ICI domain contiguously.
+
+    The slice key participates only when EVERY device reports one --
+    the same all-or-nothing rule as :func:`detect_topology`, so the
+    ordering and the (inter, intra) factorization always agree on what
+    a row of the mesh means (partial metadata must not let one stray
+    ``slice_index`` interleave devices of different processes).
+    """
     if devices is None:
         devices = jax.devices()
-    return sorted(devices, key=lambda d: (d.process_index, d.id))
+    use_slice = bool(devices) and all(
+        _ici_domain(d) is not None for d in devices)
+
+    def key(d):
+        s = _ici_domain(d) if use_slice else 0
+        return (s, d.process_index, d.id)
+
+    return sorted(devices, key=key)
 
 
 def detect_topology(devices=None):
     """Return ``(inter_size, intra_size)`` discovered from the device set.
 
     Mirrors the information computed by ``init_ranks``
-    (``_communication_utility.py:7-40``) -- but from the JAX runtime's
-    process/device table instead of an MPI hostname gather.
+    (``_communication_utility.py:7-40``) -- but from hardware locality
+    metadata instead of an MPI hostname gather:
+
+    1. When every device reports a ``slice_index`` (TPU), the slice IS
+       the ICI domain: ``intra`` = chips per slice (across however many
+       host processes feed it), ``inter`` = number of slices (DCN).
+    2. Otherwise (CPU / backends without slice metadata) fall back to
+       the process boundary as the locality proxy.
+
+    Either way a ragged layout (domains of unequal size) collapses to a
+    1-D ``(1, n)`` mesh, since it cannot tile a rectangle.
     """
     devices = sorted_devices(devices)
-    per_process = collections.Counter(d.process_index for d in devices)
-    sizes = set(per_process.values())
+    if not devices:
+        return (1, 0)
+    slice_ids = [_ici_domain(d) for d in devices]
+    if all(s is not None for s in slice_ids):
+        groups = collections.Counter(slice_ids)
+    else:
+        groups = collections.Counter(d.process_index for d in devices)
+    sizes = set(groups.values())
     if len(sizes) != 1:
-        # Ragged hosts cannot form a rectangular mesh; collapse to 1-D.
+        # Ragged domains cannot form a rectangular mesh; collapse to 1-D.
         return (1, len(devices))
-    intra = sizes.pop()
-    return (len(per_process), intra)
+    return (len(groups), sizes.pop())
 
 
 def build_mesh(devices=None, mesh_shape=None):

@@ -13,6 +13,16 @@ TPU-native strategy set it points toward:
 - :mod:`sequence` -- sequence/context parallelism: ring attention with
   blockwise KV rotation (long-context first-class)
 - :mod:`moe` -- expert parallelism: all_to_all token dispatch
+
+AUTODIFF CAVEAT: differentiate OUTSIDE ``shard_map`` when the mapped
+computation's value crosses devices (pipeline ``ppermute``, ring
+attention rotation, MoE ``all_to_all``): with ``check_vma=False``,
+``jax.grad`` *inside* shard_map mis-transposes cross-device dataflow
+(the replication-tracking rewrite behind correct collective transposes
+is off) and the error is large, not roundoff.  Grad-of-the-mapped-
+function (as every test here does, and as
+:class:`chainermn_tpu.training.PipelineUpdater` does) is the supported
+pattern.  Purely local losses (data parallelism) are unaffected.
 """
 
 from chainermn_tpu.parallel.pipeline import Pipeline  # noqa

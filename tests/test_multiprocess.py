@@ -106,3 +106,19 @@ def test_multiprocess_end_to_end(tmp_path, nprocs):
     for res in results:
         assert abs(res['global_psum'] - expect_psum) < 1e-3
         assert res['ckpt_roundtrip_err'] == 0.0
+
+    # undelivered-key GC: rank 0 swept its orphan, rank 1 finds the
+    # slot empty (VERDICT r2 item 10)
+    assert results[0]['p2p_gc_cleared'] is True
+    assert results[1]['p2p_gc_orphan_gone'] is True
+
+    # full StandardUpdater step across controllers (VERDICT r2 item 9):
+    # every process observes the same loss trajectory (metrics are
+    # allreduced) and identical post-step parameters
+    losses = [res['train_losses'] for res in results]
+    for other in losses[1:]:
+        assert np.allclose(losses[0], other, atol=1e-5)
+    assert all(np.isfinite(losses[0]))
+    assert losses[0][-1] < losses[0][0]  # SGD makes progress
+    leafsums = [res['param_leafsum'] for res in results]
+    assert max(leafsums) - min(leafsums) < 1e-5

@@ -1,0 +1,114 @@
+"""Slice-aware topology discovery (VERDICT r2 item 3).
+
+The reference groups MPI ranks by hostname into (intra, inter)
+(``/root/reference/chainermn/communicators/_communication_utility.py:7-40``).
+On TPU the ICI domain is the *slice*, not the host process: a v5e-64 is
+16 processes feeding ONE slice, so intra must span all 64 chips and
+inter must be 1.  These tests pin that mapping with mocked device
+attribute tables for the deployment shapes that matter.
+"""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.communicators import mesh_utility
+
+
+class FakeDev:
+    """Stand-in for a jax Device exposing the locality attributes."""
+
+    def __init__(self, id, process_index, slice_index=None):
+        self.id = id
+        self.process_index = process_index
+        if slice_index is not None:
+            self.slice_index = slice_index
+
+    def __repr__(self):
+        return 'FakeDev(id=%d, proc=%d, slice=%r)' % (
+            self.id, self.process_index, getattr(self, 'slice_index', None))
+
+
+def make_devices(n_slices, hosts_per_slice, chips_per_host,
+                 with_slice=True):
+    devs = []
+    i = 0
+    for s in range(n_slices):
+        for h in range(hosts_per_slice):
+            for _ in range(chips_per_host):
+                devs.append(FakeDev(
+                    id=i, process_index=s * hosts_per_slice + h,
+                    slice_index=s if with_slice else None))
+                i += 1
+    return devs
+
+
+def test_single_slice_multi_host_is_one_ici_domain():
+    # v5e-64: 16 processes x 4 chips, ONE slice -> inter=1, intra=64.
+    # The old process heuristic returned (16, 4), putting ICI traffic
+    # on the "DCN" axis and defeating hierarchical staging.
+    devs = make_devices(1, 16, 4)
+    assert mesh_utility.detect_topology(devs) == (1, 64)
+
+
+def test_two_slices_map_to_inter_axis():
+    # 2 slices x (4 hosts x 4 chips): DCN separates the slices.
+    devs = make_devices(2, 4, 4)
+    assert mesh_utility.detect_topology(devs) == (2, 16)
+
+
+def test_no_slice_metadata_falls_back_to_process():
+    # CPU / older runtimes: process boundary is the locality proxy.
+    devs = make_devices(1, 2, 4, with_slice=False)
+    assert mesh_utility.detect_topology(devs) == (2, 4)
+
+
+def test_partial_slice_metadata_falls_back_to_process():
+    devs = make_devices(1, 2, 4, with_slice=False)
+    devs[0].slice_index = 0  # only one device reports a slice
+    assert mesh_utility.detect_topology(devs) == (2, 4)
+
+
+def test_partial_slice_metadata_keeps_rows_process_pure():
+    # sorted_devices must apply the SAME all-or-nothing slice rule as
+    # detect_topology: one stray slice_index must not interleave
+    # devices of different processes within an intra row.
+    devs = make_devices(1, 2, 4, with_slice=False)
+    devs[0].slice_index = 1  # would sort LAST if the key used slices
+    ordered = mesh_utility.sorted_devices(devs)
+    assert [d.id for d in ordered] == list(range(8))
+    rows = np.asarray(ordered, dtype=object).reshape(2, 4)
+    for row in rows:
+        assert len({d.process_index for d in row}) == 1
+
+
+def test_ragged_slices_collapse_to_1d():
+    devs = make_devices(2, 2, 2)
+    devs.append(FakeDev(id=8, process_index=4, slice_index=1))
+    assert mesh_utility.detect_topology(devs) == (1, 9)
+
+
+def test_sorted_devices_groups_slices_contiguously():
+    # Interleave construction order; sorting must make each slice a
+    # contiguous run so reshape(inter, intra) rows are ICI domains.
+    devs = make_devices(2, 2, 2)
+    rng = np.random.RandomState(0)
+    shuffled = [devs[i] for i in rng.permutation(len(devs))]
+    ordered = mesh_utility.sorted_devices(shuffled)
+    slices = [d.slice_index for d in ordered]
+    assert slices == sorted(slices)
+    # within a slice, (process, id) order is deterministic
+    assert [d.id for d in ordered] == list(range(8))
+
+
+def test_single_node_communicator_accepts_multi_host_single_slice():
+    # The reference's single_node asserts one *node*; our analogue
+    # asserts one ICI domain -- which a multi-host slice is.
+    inter, intra = mesh_utility.detect_topology(make_devices(1, 16, 4))
+    assert inter == 1  # SingleNodeCommunicator's guard now passes
+
+
+def test_build_mesh_uses_slice_topology():
+    import jax
+    devs = mesh_utility.sorted_devices(jax.devices())
+    mesh = mesh_utility.build_mesh(devs)
+    assert mesh.devices.size == len(devs)
