@@ -1,42 +1,68 @@
 #!/usr/bin/env python
-"""Benchmark: ResNet-50 training throughput, images/sec/chip.
+"""Benchmark harness: honest per-chip training throughput.
 
-Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+Prints ONE JSON line
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``.
+Default workload is the headline ResNet-50 config; ``--model`` selects
+any BASELINE.md workload:
+
+  resnet50 | vgg16 | googlenetbn | seq2seq | transformer | mlp
 
 Baseline: the reference points at PFN's published 128-GPU ChainerMN
 ResNet-50 run (``/root/reference/README.md:19``; 100 epochs of
-ImageNet-1k in 4.4 hours on 128 P100s) which works out to ~8100
-images/sec total, i.e. **~63 images/sec/chip** -- that per-chip number
-is the bar ``vs_baseline`` is computed against.
+ImageNet-1k in 4.4 hours on 128 P100s) = ~8100 images/sec total,
+i.e. **~63 images/sec/chip**.  For non-ResNet models ``vs_baseline``
+scales that bar by the analytic FLOPs ratio (same hardware-time budget
+per item; documented per line as ``baseline_derivation``).
 
-Runs the full training step (forward+backward+allreduce+SGD step +
-cross-replica BN sync) on all locally visible devices via the same
-StandardUpdater-jitted program users run, bfloat16 NHWC, global batch
-sized per device count.
+MEASUREMENT METHOD (round 3; VERDICT r2 item 1).  Round 2 recorded a
+physically impossible number (170% of bf16 peak) because on this
+tunneled backend ``block_until_ready`` returns without waiting for an
+async-dispatched chain.  The harness now trusts nothing it has not
+verified:
 
-Robustness (VERDICT r1 item 2): the parent process never imports jax.
-It first probes the backend in a subprocess with a hard timeout and
-bounded retries -- a hung or unavailable TPU yields a machine-readable
-``{"error": "backend_unavailable", ...}`` line instead of a traceback
-or a silent hang.  The measurement itself runs in a watchdogged child
-(``--child``) with a persistent XLA compilation cache so repeat runs
-skip the multi-minute ResNet-50 compile, and stage progress goes to
-stderr.
+1. **Sync**: the ONLY sync primitive used for timing is
+   ``jax.device_get`` of the program's outputs -- bytes on the host
+   cannot lie.  ``block_until_ready`` is probed once and its
+   trustworthiness recorded (``block_until_ready_trustworthy``).
+2. **Dispatch amortization**: the tunnel adds ~70ms per round trip, so
+   per-step Python loops measure RTT, not compute.  K train steps run
+   inside ONE compiled program (``lax.scan`` carrying params), and the
+   per-step time is the MARGINAL cost between two scan lengths:
+   ``(t(K2) - t(K1)) / (K2 - K1)``, min over repeats; the RTT+fixed
+   overhead estimate is reported separately (``overhead_ms``).
+3. **Roofline self-calibration**: the same scan+marginal method times
+   a big bf16 matmul chain on the same chip
+   (``measured_matmul_tflops``); no table peak is trusted blind.
+4. **FLOP cross-check**: XLA's cost analysis AND an analytic estimate
+   are both reported; ``achieved_tflops_per_chip`` uses XLA's count
+   (analytic as fallback).
+5. **Suspect gating**: a result claiming more than the self-calibrated
+   matmul roofline (or >100% of the device's table peak, or wildly
+   unstable step times) is emitted with ``"suspect": true`` and a
+   reason -- never published raw as a win.
 
-Flags: ``--quick`` (5 timed steps, 2 warmups), ``--cpu`` (8-device
-virtual CPU mesh, plumbing check only), ``--no-cost`` (skip the MFU
-cost-analysis fields).
+Robustness: the parent process never imports jax; a subprocess probe
+with a hard timeout turns a hung backend into machine-readable
+``{"error": "backend_unavailable"}``; the measurement runs in a
+watchdogged ``--child`` with a persistent XLA compile cache.
+
+Flags: ``--model NAME``, ``--quick`` (shorter scans), ``--cpu``
+(8-device virtual CPU mesh, plumbing check), ``--no-cost`` (skip cost
+analysis), ``--check`` (transformer only: pin Pallas kernels against
+the jnp oracle on-device and record ``numerics_vs_oracle_ok``).
 """
 
 import json
+import math
 import os
 import subprocess
 import sys
 import time
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 63.0
-# dense bf16 TFLOP/s per chip, by device_kind substring
+# dense bf16 TFLOP/s per chip, by device_kind substring (table peak;
+# the harness also self-calibrates, see measured_matmul_tflops)
 BF16_PEAK_TFLOPS = {
     'v4': 275.0,
     'v5e': 197.0,
@@ -45,17 +71,15 @@ BF16_PEAK_TFLOPS = {
     'v6e': 918.0,
     'v6 lite': 918.0,
 }
-METRIC = {
-    'metric': 'resnet50_train_images_per_sec_per_chip',
-    'unit': 'images/sec/chip',
-}
+MODELS = ('resnet50', 'vgg16', 'googlenetbn', 'seq2seq', 'transformer',
+          'mlp')
 
 PROBE_SRC = """
 import jax, jax.numpy as jnp
 d = jax.devices()
 assert d, 'no devices'
-jax.jit(lambda a: a @ a)(jnp.ones((512, 512), jnp.bfloat16)
-                         ).block_until_ready()
+y = jax.jit(lambda a: a @ a)(jnp.ones((512, 512), jnp.bfloat16))
+v = jax.device_get(y[:1, :1])  # real sync: bytes must arrive
 print('PROBE_OK', jax.default_backend(), len(d))
 """
 
@@ -68,14 +92,22 @@ def _log(msg):
 _log.t0 = time.monotonic()
 
 
+def metric_stub(model):
+    unit = {'seq2seq': 'tokens/sec/chip',
+            'transformer': 'tokens/sec/chip',
+            'mlp': 'images/sec/chip'}.get(model, 'images/sec/chip')
+    return {'metric': '%s_train_%s' % (model, unit.replace('/', '_per_')),
+            'unit': unit}
+
+
 def emit(result, rc=0):
     print(json.dumps(result), flush=True)
     sys.exit(rc)
 
 
 def probe_backend(attempts=2, timeout=150, interval=10):
-    """True if a subprocess can init the backend and run a tiny jit;
-    otherwise returns the failure detail of the last attempt."""
+    """True if a subprocess can init the backend and run a tiny jit
+    with a REAL device_get sync; otherwise the failure detail."""
     detail = ''
     for i in range(attempts):
         _log('backend probe attempt %d/%d (timeout %ds)'
@@ -98,18 +130,18 @@ def probe_backend(attempts=2, timeout=150, interval=10):
     return detail
 
 
-def run_child(argv):
+def run_child(argv, model):
     """Watchdog wrapper: run the measurement in a child process,
     relaying stderr; on timeout/crash emit diagnostic JSON."""
     quick = '--quick' in argv
-    timeout = 720 if quick else 1500
+    timeout = 900 if quick else 2400
     cmd = [sys.executable, os.path.abspath(__file__), '--child'] + argv
     _log('starting measurement child (timeout %ds)' % timeout)
     try:
         p = subprocess.run(cmd, timeout=timeout, stdout=subprocess.PIPE,
                            text=True)  # stderr inherited -> live progress
     except subprocess.TimeoutExpired:
-        emit(dict(METRIC, value=0.0, vs_baseline=0.0,
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
                   error='bench_timeout',
                   detail='child exceeded %ds' % timeout), rc=1)
     lines = [ln for ln in (p.stdout or '').splitlines() if ln.strip()]
@@ -117,19 +149,392 @@ def run_child(argv):
         try:
             result = json.loads(lines[-1])
         except ValueError:
-            emit(dict(METRIC, value=0.0, vs_baseline=0.0,
+            emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
                       error='bad_child_output',
                       detail=lines[-1][-2000:]), rc=1)
-        emit(result)
-    emit(dict(METRIC, value=0.0, vs_baseline=0.0, error='bench_failed',
+        emit(result, rc=1 if result.get('error') else 0)
+    emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+              error='bench_failed',
               detail='child rc=%d, stdout tail: %s'
               % (p.returncode, '\n'.join(lines)[-2000:])), rc=1)
+
+
+# ======================================================================
+# measurement primitives (child side)
+
+def devget_sync(x):
+    """The only trustworthy sync on this backend: fetch real bytes."""
+    import jax
+    leaves = jax.tree_util.tree_leaves(x)
+    return jax.device_get(leaves[-1])
+
+
+def probe_block_until_ready():
+    """Is block_until_ready a real sync here?  Times a dependent chain
+    of matmuls under both sync methods; records the verdict instead of
+    assuming (VERDICT r2 weak #1)."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(a, b):
+        return a @ b * 0.5
+
+    a = jnp.ones((2048, 2048), jnp.bfloat16)
+    warm = step(a, a)
+    devget_sync(warm)
+
+    def chain(sync):
+        t0 = time.perf_counter()
+        x = a
+        for _ in range(8):
+            x = step(x, a)
+        sync(x)
+        return time.perf_counter() - t0
+
+    t_block = min(chain(lambda v: v.block_until_ready())
+                  for _ in range(2))
+    t_get = min(chain(devget_sync) for _ in range(2))
+    trustworthy = t_block > 0.5 * t_get
+    _log('block_until_ready probe: block=%.4fs devget=%.4fs -> %s'
+         % (t_block, t_get,
+            'trustworthy' if trustworthy else 'NOT a real sync'))
+    return trustworthy
+
+
+def marginal_time(make_fn, k1, k2, reps):
+    """Compile fn(k1), fn(k2); time each (devget sync, min over reps);
+    return (per_item, overhead, times_dict)."""
+    fns = {}
+    for k in (k1, k2):
+        _log('compiling scan length %d' % k)
+        fns[k] = make_fn(k)
+        devget_sync(fns[k]())  # compile + warm
+    times = {}
+    for k in (k1, k2):
+        best = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            devget_sync(fns[k]())
+            best.append(time.perf_counter() - t0)
+        times[k] = best
+    t1, t2 = min(times[k1]), min(times[k2])
+    per_item = max((t2 - t1) / (k2 - k1), 1e-9)
+    overhead = max(t1 - k1 * per_item, 0.0)
+    return per_item, overhead, times
+
+
+def calibrate_matmul_roofline(quick):
+    """Self-calibrated compute roofline: marginal time of one big bf16
+    matmul inside a scanned chain on this very chip."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = 4096 if quick else 8192
+    flop = 2.0 * n ** 3
+
+    def make(k):
+        @jax.jit
+        def run():
+            a = jnp.ones((n, n), jnp.bfloat16)
+
+            def body(c, _):
+                return c @ a * 0.5, ()
+
+            out, _ = lax.scan(body, a, None, length=k)
+            return out[:1, :1]
+
+        return run
+
+    k1, k2 = (4, 12) if quick else (8, 24)
+    per, ov, _ = marginal_time(make, k1, k2, reps=3)
+    tflops = flop / per / 1e12
+    _log('matmul roofline: %d^3 bf16 %.2fms/matmul -> %.1f TFLOP/s'
+         % (n, per * 1e3, tflops))
+    return tflops
+
+
+# ======================================================================
+# per-model builders: return dict(updater-free scan maker, items/step,
+# analytic train flops/step, extras)
+
+def _classifier_setup(model, insize, batch, seed=0):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import StatefulClassifier
+
+    comm = chainermn_tpu.create_communicator('xla')
+    x0 = jnp.zeros((1, insize, insize, 3), jnp.float32)
+    variables = model.init({'params': jax.random.PRNGKey(seed)}, x0,
+                           train=False)
+    params = variables['params']
+    model_state = {k: v for k, v in variables.items() if k != 'params'}
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, insize, insize, 3).astype(np.float32)
+    y = rng.randint(0, 1000, batch).astype(np.int32)
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    # StatefulClassifier handles BN state AND dropout rngs; models
+    # with neither just see an empty mutable set
+    clf = StatefulClassifier(model)
+    upd = training.StandardUpdater(
+        iter([]), optimizer, clf.loss, params, comm,
+        model_state=model_state, donate=False)
+    arrays = upd.shard_batch([(x[i], y[i]) for i in range(batch)])
+    return upd, arrays
+
+
+def _scan_maker(upd, arrays):
+    """One compiled program running k train steps back to back; sync
+    value is the stack of per-step losses."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    step = upd._build_step(donate=False)
+    has_state = upd._has_state
+    rng0 = upd._rng
+    p0, ms0, os0 = upd.params, upd.model_state, upd.opt_state
+
+    def make(k):
+        @jax.jit
+        def run():
+            def body(carry, i):
+                p, ms, os_ = carry
+                r = (jax.random.fold_in(rng0, i) if has_state else rng0)
+                p, ms, os_, metrics = step(p, ms, os_, r, *arrays)
+                return (p, ms, os_), metrics['loss']
+
+            (_, _, _), losses = lax.scan(
+                body, (p0, ms0, os0), jnp.arange(k))
+            return losses
+
+        return run
+
+    return make
+
+
+# (model-class name, fwd GFLOPs/image at 224px, per-device batch on
+# TPU / on CPU): the three BASELINE conv workloads share one builder
+_CONV_MODELS = {
+    'resnet50': ('ResNet50', 4.1, 32, 8),
+    'vgg16': ('VGG16', 15.5, 32, 4),
+    'googlenetbn': ('GoogLeNetBN', 2.0, 32, 8),
+}
+
+
+def _build_conv(name, quick, on_cpu):
+    import jax
+
+    import chainermn_tpu.models as zoo
+
+    cls_name, fwd_gf, per_dev_tpu, per_dev_cpu = _CONV_MODELS[name]
+    insize = 64 if on_cpu else 224
+    per_dev = per_dev_cpu if on_cpu else per_dev_tpu
+    batch = per_dev * jax.device_count()
+    model = getattr(zoo, cls_name)(num_classes=1000)
+    upd, arrays = _classifier_setup(model, insize, batch)
+    fwd = fwd_gf * 1e9 * (insize / 224.0) ** 2
+    base = BASELINE_IMG_PER_SEC_PER_CHIP * (4.1 / fwd_gf) \
+        * (224.0 / insize) ** 2
+    deriv = ('PFN 128xP100 resnet50 published throughput, per chip, '
+             'flops-normalized to insize' if name == 'resnet50' else
+             'resnet50 baseline scaled by analytic flops ratio '
+             '4.1/%s (same hardware-time budget per image)' % fwd_gf)
+    return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
+                items=batch, insize=insize,
+                analytic_flops=3.0 * fwd * batch, baseline=base,
+                baseline_derivation=deriv)
+
+
+def build_seq2seq(quick, on_cpu):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import Seq2seq, seq2seq_loss
+
+    layers, units, vocab = (2, 256, 4000) if on_cpu else (2, 512, 8000)
+    seq_len = 32 if on_cpu else 64
+    per_dev = 8 if on_cpu else 64
+    batch = per_dev * jax.device_count()
+    model = Seq2seq(n_layers=layers, n_source_vocab=vocab,
+                    n_target_vocab=vocab, n_units=units)
+    comm = chainermn_tpu.create_communicator('xla')
+    rng = np.random.RandomState(0)
+    xs = rng.randint(1, vocab, (batch, seq_len)).astype(np.int32)
+    ys_in = rng.randint(1, vocab, (batch, seq_len)).astype(np.int32)
+    ys_out = rng.randint(1, vocab, (batch, seq_len)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, seq_len), jnp.int32),
+                        jnp.zeros((1, seq_len), jnp.int32))['params']
+    loss = seq2seq_loss(
+        lambda p, a, b: model.apply({'params': p}, a, b))
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    upd = training.StandardUpdater(
+        iter([]), optimizer, loss, params, comm, has_aux=True,
+        donate=False)
+    arrays = upd.shard_batch([(xs[i], ys_in[i], ys_out[i])
+                              for i in range(batch)])
+    # LSTM train flops/token/layer ~ 3 * 16u^2 (fwd 8u^2 MACs x2);
+    # + decoder softmax 3 * 2uV per target token; enc+dec tokens
+    tokens = batch * seq_len  # target tokens (the reported unit)
+    flops = (3.0 * 16.0 * units ** 2 * layers * (2 * tokens)
+             + 3.0 * 2.0 * units * vocab * tokens)
+    base = BASELINE_IMG_PER_SEC_PER_CHIP * 4.1e9 * 3.0 / (
+        flops / tokens)
+    return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
+                items=tokens, analytic_flops=flops, baseline=base,
+                baseline_derivation='resnet50 baseline converted to '
+                'tokens/sec via analytic flops per item')
+
+
+def build_transformer(quick, on_cpu):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import TransformerLM, lm_loss
+
+    if on_cpu:
+        d_model, n_heads, n_layers, seq, vocab, per_dev = \
+            128, 4, 2, 128, 1000, 2
+    else:
+        d_model, n_heads, n_layers, seq, vocab, per_dev = \
+            512, 8, 6, 1024, 32000, 8
+    batch = per_dev * jax.device_count()
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_heads=n_heads, n_layers=n_layers,
+                          d_ff=4 * d_model, max_len=seq)
+    comm = chainermn_tpu.create_communicator('xla')
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    tgts = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, seq), jnp.int32))['params']
+    loss = lm_loss(lambda p, t: model.apply({'params': p}, t))
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    upd = training.StandardUpdater(
+        iter([]), optimizer, loss, params, comm, has_aux=True,
+        donate=False)
+    arrays = upd.shard_batch([(toks[i], tgts[i]) for i in range(batch)])
+    tokens = batch * seq
+    # per token fwd: 12 d^2 per layer (qkvo + 2-layer 4d MLP) +
+    # 4*seq*d attention matmuls per layer (causal halves it) + lm head
+    ff = 4 * d_model
+    per_tok_fwd = n_layers * (
+        8.0 * d_model ** 2 + 2.0 * 2.0 * d_model * ff
+        + 2.0 * 2.0 * seq * d_model / 2.0) + 2.0 * d_model * vocab
+    flops = 3.0 * per_tok_fwd * tokens
+    base = BASELINE_IMG_PER_SEC_PER_CHIP * 4.1e9 * 3.0 / (
+        flops / tokens)
+    return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
+                items=tokens, analytic_flops=flops, baseline=base,
+                baseline_derivation='resnet50 baseline converted to '
+                'tokens/sec via analytic flops per item',
+                check_fn=lambda: _transformer_numerics_check(
+                    model, params, toks, tgts))
+
+
+def _transformer_numerics_check(model, params, toks, tgts):
+    """Pin the Pallas-kernel model against the jnp oracle ON-DEVICE:
+    same params, same batch, loss+grad-norm agreement (VERDICT r2
+    item 2)."""
+    import jax
+    import numpy as np
+
+    from chainermn_tpu.models.transformer import lm_loss
+
+    def loss_and_gnorm():
+        loss_fn = lm_loss(lambda p, t: model.apply({'params': p}, t))
+        val, grads = jax.jit(jax.value_and_grad(
+            lambda p: loss_fn(p, toks[:2], tgts[:2])[0]))(params)
+        gn = sum(float(np.asarray(jax.device_get(
+            (g.astype('float32') ** 2).sum())))
+            for g in jax.tree_util.tree_leaves(grads))
+        return float(np.asarray(jax.device_get(val))), math.sqrt(gn)
+
+    l_pallas, g_pallas = loss_and_gnorm()
+    # pallas_mode() reads the env at trace time and each
+    # loss_and_gnorm call jits a fresh lambda, so flipping the env is
+    # sufficient to switch implementations
+    os.environ['CHAINERMN_TPU_PALLAS'] = '0'
+    try:
+        l_oracle, g_oracle = loss_and_gnorm()
+    finally:
+        os.environ.pop('CHAINERMN_TPU_PALLAS', None)
+    rel_l = abs(l_pallas - l_oracle) / max(abs(l_oracle), 1e-6)
+    rel_g = abs(g_pallas - g_oracle) / max(abs(g_oracle), 1e-6)
+    _log('numerics: loss pallas=%.6f oracle=%.6f (rel %.2e); '
+         'gnorm rel %.2e' % (l_pallas, l_oracle, rel_l, rel_g))
+    return {'numerics_vs_oracle_ok': bool(rel_l < 2e-2 and rel_g < 5e-2),
+            'numerics_loss_rel_err': round(rel_l, 6),
+            'numerics_gnorm_rel_err': round(rel_g, 6)}
+
+
+def build_mlp(quick, on_cpu):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    per_dev = 128
+    batch = per_dev * jax.device_count()
+    model = MLP(n_units=1000, n_out=10)
+    comm = chainermn_tpu.create_communicator('xla')
+    rng = np.random.RandomState(0)
+    x = rng.rand(batch, 784).astype(np.float32)
+    y = rng.randint(0, 10, batch).astype(np.int32)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))['params']
+    loss = classifier_loss(lambda p, xx: model.apply({'params': p}, xx))
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    upd = training.StandardUpdater(
+        iter([]), optimizer, loss, params, comm, has_aux=True,
+        donate=False)
+    arrays = upd.shard_batch([(x[i], y[i]) for i in range(batch)])
+    fwd = 2.0 * (784 * 1000 + 1000 * 1000 + 1000 * 10)
+    base = BASELINE_IMG_PER_SEC_PER_CHIP * 4.1e9 * 3.0 / (3.0 * fwd)
+    return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
+                items=batch, analytic_flops=3.0 * fwd * batch,
+                baseline=base,
+                baseline_derivation='resnet50 baseline converted via '
+                'analytic flops per image')
+
+
+BUILDERS = dict(
+    {name: (lambda q, c, n=name: _build_conv(n, q, c))
+     for name in _CONV_MODELS},
+    seq2seq=build_seq2seq, transformer=build_transformer,
+    mlp=build_mlp)
+assert set(BUILDERS) == set(MODELS)
 
 
 def measure(argv):
     """The actual benchmark (runs inside the watchdogged child)."""
     quick = '--quick' in argv
     want_cost = '--no-cost' not in argv
+    want_check = '--check' in argv
+    model_name = parse_model(argv)
 
     import jax
 
@@ -143,114 +548,136 @@ def measure(argv):
         from chainermn_tpu.utils import force_host_devices
         force_host_devices(8)
 
-    import jax.numpy as jnp
-    import numpy as np
-    import optax
-
-    import chainermn_tpu
-    from chainermn_tpu import training
-    from chainermn_tpu.models import ResNet50, StatefulClassifier
-
     n_dev = jax.device_count()
     on_cpu = jax.default_backend() == 'cpu'
-    insize = 64 if on_cpu else (128 if quick else 224)
-    per_device_batch = 8 if on_cpu else 32
-    batch = per_device_batch * n_dev
-    _log('backend=%s n_dev=%d insize=%d batch=%d'
-         % (jax.default_backend(), n_dev, insize, batch))
+    _log('backend=%s n_dev=%d model=%s'
+         % (jax.default_backend(), n_dev, model_name))
 
-    comm = chainermn_tpu.create_communicator('xla')
-    model = ResNet50(num_classes=1000)
-    x0 = jnp.zeros((1, insize, insize, 3), jnp.float32)
-    variables = model.init({'params': jax.random.PRNGKey(0)}, x0,
-                           train=False)
-    params = variables['params']
-    model_state = {k: v for k, v in variables.items() if k != 'params'}
-    clf = StatefulClassifier(model)
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(0.1, momentum=0.9), comm)
+    bur_trustworthy = None
+    matmul_tflops = None
+    if not on_cpu:
+        bur_trustworthy = probe_block_until_ready()
+        matmul_tflops = calibrate_matmul_roofline(quick)
 
-    rng = np.random.RandomState(0)
-    x = rng.rand(batch, insize, insize, 3).astype(np.float32)
-    y = rng.randint(0, 1000, batch).astype(np.int32)
+    _log('building %s' % model_name)
+    cfg = BUILDERS[model_name](quick, on_cpu)
+    make = cfg['make']
 
-    updater = training.StandardUpdater(
-        iter([]), optimizer, clf.loss, params, comm,
-        model_state=model_state)
+    if on_cpu:
+        k1, k2, reps = 1, 3, 2
+    elif quick:
+        k1, k2, reps = 2, 6, 3
+    else:
+        k1, k2, reps = 4, 12, 4
+    _log('timing: scan lengths %d/%d x%d reps (first compile of a big '
+         'model is minutes uncached)' % (k1, k2, reps))
+    per_step, overhead, times = marginal_time(make, k1, k2, reps)
+    _log('per-step %.2fms, overhead %.1fms' % (per_step * 1e3,
+                                               overhead * 1e3))
 
-    # collate + shard ONCE; the timed loop measures the device program,
-    # not host-side re-collation of an identical batch
-    arrays = updater.shard_batch([(x[i], y[i]) for i in range(batch)])
-
-    _log('compiling + warming up (first ResNet-50 TPU compile ~4-6 min '
-         'uncached; cached runs are seconds)')
-    n_warmup = 2 if quick else 3
-    for i in range(n_warmup):
-        updater.update_core(arrays)
-        jax.block_until_ready(updater.params)
-        _log('warmup step %d/%d done' % (i + 1, n_warmup))
-
-    n_steps = 5 if quick else 20
-    _log('timing %d steps' % n_steps)
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        updater.update_core(arrays)
-    jax.block_until_ready(updater.params)
-    dt = time.perf_counter() - t0
-    _log('timed %d steps in %.2fs' % (n_steps, dt))
-
-    imgs_per_sec = batch * n_steps / dt
-    per_chip = imgs_per_sec / n_dev
-    # the 63 img/s/chip baseline is a 224px number; a conv net's
-    # per-image flops scale ~(insize/224)^2, so normalize the bar when
-    # --quick runs at 128px rather than inflating the ratio
-    baseline = BASELINE_IMG_PER_SEC_PER_CHIP * (224.0 / insize) ** 2
+    items_per_sec = cfg['items'] / per_step
+    per_chip = items_per_sec / n_dev
+    baseline = cfg['baseline']
+    spread = (max(times[k2]) - min(times[k2])) / max(min(times[k2]),
+                                                     1e-9)
     result = dict(
-        METRIC,
+        metric_stub(model_name),
         value=round(per_chip, 2),
         vs_baseline=round(per_chip / baseline, 3),
         n_devices=n_dev,
         backend=jax.default_backend(),
-        insize=insize,
-        per_device_batch=per_device_batch,
+        step_time_ms=round(per_step * 1e3, 3),
+        overhead_ms=round(overhead * 1e3, 1),
+        scan_lengths=[k1, k2],
+        rep_times_s={str(k): [round(t, 4) for t in v]
+                     for k, v in times.items()},
+        rep_spread=round(spread, 3),
+        sync_method='device_get',
+        baseline_derivation=cfg['baseline_derivation'],
+        global_batch_items=cfg['items'],
     )
+    if 'insize' in cfg:
+        result['insize'] = cfg['insize']
+    if bur_trustworthy is not None:
+        result['block_until_ready_trustworthy'] = bool(bur_trustworthy)
+    if matmul_tflops is not None:
+        result['measured_matmul_tflops'] = round(matmul_tflops, 1)
+
+    suspect_reasons = []
     if want_cost:
-        # XLA's own FLOP count: lets the recorded number be
-        # sanity-checked against hardware peak.  AOT-compiles a second
-        # copy of the step -- a disk-cache hit after the jit compile
-        # above, so cheap.
-        _log('cost analysis (compile-cache hit)')
+        _log('cost analysis')
+        xla_flops = 0.0
         try:
-            cost = updater.compiled_cost_analysis(arrays)
-            flops = float(cost.get('flops', 0.0))
+            cost = cfg['upd'].compiled_cost_analysis(cfg['arrays'])
+            # XLA cost analysis reports the LOCAL executable's flops,
+            # i.e. per participating device of the SPMD program
+            xla_flops = float(cost.get('flops', 0.0)) * n_dev
         except Exception as e:
             _log('cost analysis failed: %r' % e)
-            flops = 0.0
-        if flops > 0:
-            achieved = flops * n_steps / dt / 1e12
-            result['step_gflops_per_chip'] = round(flops / 1e9, 1)
-            result['achieved_tflops_per_chip'] = round(achieved, 3)
-            kind = jax.devices()[0].device_kind
-            peak = next((v for k, v in BF16_PEAK_TFLOPS.items()
-                         if k in kind.lower()), None)
-            if not on_cpu and peak:
-                result['device_kind'] = kind
-                result['pct_of_bf16_peak'] = round(
-                    100.0 * achieved / peak, 1)
+        analytic = float(cfg['analytic_flops'])
+        flops = xla_flops if xla_flops > 0 else analytic
+        achieved = flops / per_step / 1e12
+        result['xla_flops_per_step'] = round(xla_flops / 1e9, 2)
+        result['analytic_flops_per_step'] = round(analytic / 1e9, 2)
+        result['flop_count_ratio_xla_over_analytic'] = round(
+            xla_flops / analytic, 3) if xla_flops else None
+        result['achieved_tflops_per_chip'] = round(achieved / n_dev, 3)
+        kind = jax.devices()[0].device_kind
+        peak = next((v for k, v in BF16_PEAK_TFLOPS.items()
+                     if k in kind.lower()), None)
+        if not on_cpu and peak:
+            result['device_kind'] = kind
+            result['table_peak_bf16_tflops'] = peak
+            pct = 100.0 * achieved / n_dev / peak
+            result['pct_of_bf16_peak'] = round(pct, 1)
+            if pct > 100.0:
+                suspect_reasons.append(
+                    'achieved %.1f%% of table bf16 peak' % pct)
+        if matmul_tflops and achieved / n_dev > matmul_tflops:
+            suspect_reasons.append(
+                'achieved %.1f TF/s exceeds self-calibrated matmul '
+                'roofline %.1f TF/s' % (achieved / n_dev,
+                                        matmul_tflops))
+    if spread > 0.5:
+        suspect_reasons.append(
+            'step-time spread %.0f%% across reps' % (spread * 100))
+    if suspect_reasons:
+        result['suspect'] = True
+        result['suspect_reason'] = '; '.join(suspect_reasons)
+
+    if want_check and 'check_fn' in cfg:
+        result.update(cfg['check_fn']())
+
     print(json.dumps(result), flush=True)
+
+
+def parse_model(argv):
+    """Extract and validate --model; emits the standard error line on
+    a missing/unknown value (never a raw traceback)."""
+    if '--model' not in argv:
+        return 'resnet50'
+    i = argv.index('--model')
+    model = argv[i + 1] if i + 1 < len(argv) else None
+    if model not in BUILDERS:
+        emit(dict(metric_stub('resnet50'), value=0.0, vs_baseline=0.0,
+                  error='unknown_model',
+                  detail='--model %r; choose from %s'
+                  % (model, '/'.join(MODELS))), rc=1)
+    return model
 
 
 def main():
     argv = [a for a in sys.argv[1:]]
+    model = parse_model(argv)
     if '--child' in argv:
         measure([a for a in argv if a != '--child'])
         return
     if '--cpu' not in argv:
         ok = probe_backend()
         if ok is not True:
-            emit(dict(METRIC, value=0.0, vs_baseline=0.0,
+            emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
                       error='backend_unavailable', detail=ok), rc=1)
-    run_child(argv)
+    run_child(argv, model)
 
 
 if __name__ == '__main__':

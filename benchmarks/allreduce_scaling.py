@@ -86,12 +86,15 @@ def main():
             fn = jax.jit(jax.shard_map(
                 red, mesh=comm.mesh, in_specs=P(),
                 out_specs=P(), check_vma=False))
+            # sync via device_get of a real output byte:
+            # block_until_ready is NOT a reliable sync on the tunneled
+            # TPU backend (see bench.py measurement method)
             out = fn(grads)
-            jax.block_until_ready(out)
+            jax.device_get(out['tail'][:1])
             t0 = time.perf_counter()
             for _ in range(args.steps):
                 out = fn(out)
-            jax.block_until_ready(out)
+            jax.device_get(out['tail'][:1])
             dt = (time.perf_counter() - t0) / args.steps
             key = name
             baseline.setdefault(key, dt)

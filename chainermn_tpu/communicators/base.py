@@ -273,6 +273,19 @@ class CommunicatorBase:
             # alive but slow does not lose an in-flight message
             atexit.register(self.p2p_gc, grace=60.0)
             self._p2p_atexit_registered = True
+        # keep the record bounded for long-running trainers: entries
+        # for messages the receiver consumed long ago (key gone from
+        # the store) are dropped opportunistically, a few per send
+        if len(sent) > 128:
+            now = time.monotonic()
+            stale = sorted((k for k, v in sent.items()
+                            if now - v[2] > 60.0),
+                           key=lambda k: sent[k][2])[:16]
+            for k in stale:
+                try:
+                    client.key_value_try_get(k)
+                except Exception:
+                    del sent[k]  # consumed: nothing left to GC
 
     def recv_obj(self, source, tag=0, timeout=120.0, channel=None):
         """Blocking receive of the next object from process
@@ -288,9 +301,12 @@ class CommunicatorBase:
         key = 'chainermn_tpu/p2p/%s/%d/%d/%d/%d' % (
             channel, source, jax.process_index(), tag, seq)
         payload = client.blocking_key_value_get(key, int(timeout * 1000))
-        # only a successful get consumes the slot
-        seqs[(source, tag, channel)] = seq + 1
+        # delete BEFORE advancing the cursor: shrinks (does not close --
+        # the store has no atomic get+delete) the window in which the
+        # sender's p2p_gc could see a consumed key as still-undelivered
+        # and rewind its cursor under us; see p2p_gc's docstring.
         client.key_value_delete(key)
+        seqs[(source, tag, channel)] = seq + 1
         return pickle.loads(base64.b64decode(payload))
 
     def p2p_gc(self, grace=0.0):
@@ -307,8 +323,14 @@ class CommunicatorBase:
         live-but-slow receiver and are left alone (they leak only if
         the receiver is truly gone); older undelivered keys are the
         dead-receiver garbage this sweep exists for.  ``grace=0``
-        sweeps everything immediately (tests, explicit teardown).
-        Deleting a key the receiver already consumed is a no-op.
+        sweeps everything immediately -- use it ONLY at explicit
+        teardown when no receiver can be mid-``recv_obj``: the store
+        has no atomic get+delete, so a key fetched but not yet deleted
+        by the receiver would be classified undelivered and its
+        sequence slot incorrectly rewound (with grace=60 a consume
+        outstanding for a full minute is the failure the sweep exists
+        for anyway).  Deleting a key the receiver already consumed is
+        a no-op.
         Parity anchor: the reference's eager channel tears down with
         the MPI communicator (``_base.py:23-74``); the KV store has no
         such lifetime, so we give it one.

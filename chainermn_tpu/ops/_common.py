@@ -15,7 +15,14 @@ def pallas_mode():
       'interpret' -- Pallas interpreter (correct but slow; opt-in on
                      CPU via CHAINERMN_TPU_PALLAS_INTERPRET=1)
       'fallback'  -- do not use Pallas; callers take the jnp path
+
+    ``CHAINERMN_TPU_PALLAS=0`` forces 'fallback' everywhere -- the
+    knob bench.py uses to run the jnp oracle of a kernel-backed model
+    ON THE TPU for numerics pinning (consulted at trace time: re-jit
+    after flipping it).
     """
+    if os.environ.get('CHAINERMN_TPU_PALLAS') == '0':
+        return 'fallback'
     if jax.default_backend() == 'tpu':
         return 'native'
     if os.environ.get('CHAINERMN_TPU_PALLAS_INTERPRET'):

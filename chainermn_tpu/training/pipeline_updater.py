@@ -132,14 +132,18 @@ class PipelineUpdater:
             outs = pipe(p_local, microbatch(x, n_micro_))
             loss, metrics = loss_on_last(outs, microbatch(y, n_micro_))
             stage = lax.axis_index(AXIS_STAGE)
-            onlast = (stage == n_stages - 1).astype(loss.dtype)
-            # garbage on non-last stages is masked out; psum then
-            # broadcasts the real value everywhere
-            loss = lax.pmean(lax.psum(loss * onlast, AXIS_STAGE),
-                             AXIS_DATA)
+            onlast = stage == n_stages - 1
+            # garbage on non-last stages is masked with where, NOT
+            # multiplication: the garbage loss can be inf/NaN (loss_fn
+            # on raw activations) and inf * 0 = NaN would poison the
+            # psum on every stage.  psum then broadcasts the real value.
+            loss = lax.pmean(
+                lax.psum(jnp.where(onlast, loss, 0.0), AXIS_STAGE),
+                AXIS_DATA)
             metrics = jax.tree_util.tree_map(
                 lambda m: lax.pmean(
-                    lax.psum(m * onlast.astype(m.dtype), AXIS_STAGE),
+                    lax.psum(jnp.where(onlast, m,
+                                       jnp.zeros_like(m)), AXIS_STAGE),
                     AXIS_DATA), metrics)
             return loss, metrics
 

@@ -9,7 +9,6 @@ attribute tables for the deployment shapes that matter.
 """
 
 import numpy as np
-import pytest
 
 from chainermn_tpu.communicators import mesh_utility
 
