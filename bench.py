@@ -468,15 +468,22 @@ def _transformer_numerics_check(model, params, toks, tgts):
             for g in jax.tree_util.tree_leaves(grads))
         return float(np.asarray(jax.device_get(val))), math.sqrt(gn)
 
-    l_pallas, g_pallas = loss_and_gnorm()
     # pallas_mode() reads the env at trace time and each
-    # loss_and_gnorm call jits a fresh lambda, so flipping the env is
-    # sufficient to switch implementations
-    os.environ['CHAINERMN_TPU_PALLAS'] = '0'
+    # loss_and_gnorm call jits a fresh lambda, so flipping the env
+    # switches implementations.  Save/restore any ambient setting and
+    # force it OFF for the kernel arm -- otherwise an inherited
+    # CHAINERMN_TPU_PALLAS=0 would compare oracle to oracle and
+    # "pass" without touching a kernel.
+    prior = os.environ.pop('CHAINERMN_TPU_PALLAS', None)
     try:
+        l_pallas, g_pallas = loss_and_gnorm()
+        os.environ['CHAINERMN_TPU_PALLAS'] = '0'
         l_oracle, g_oracle = loss_and_gnorm()
     finally:
-        os.environ.pop('CHAINERMN_TPU_PALLAS', None)
+        if prior is None:
+            os.environ.pop('CHAINERMN_TPU_PALLAS', None)
+        else:
+            os.environ['CHAINERMN_TPU_PALLAS'] = prior
     rel_l = abs(l_pallas - l_oracle) / max(abs(l_oracle), 1e-6)
     rel_g = abs(g_pallas - g_oracle) / max(abs(g_oracle), 1e-6)
     _log('numerics: loss pallas=%.6f oracle=%.6f (rel %.2e); '

@@ -275,17 +275,24 @@ class CommunicatorBase:
             self._p2p_atexit_registered = True
         # keep the record bounded for long-running trainers: entries
         # for messages the receiver consumed long ago (key gone from
-        # the store) are dropped opportunistically, a few per send
+        # the store) are dropped opportunistically.  Probes are
+        # expensive (try_get returns the full payload), so at most a
+        # couple per send, and a still-present key is not re-probed
+        # for another minute (_p2p_probe_at tracks per-key cooldown).
         if len(sent) > 128:
             now = time.monotonic()
-            stale = sorted((k for k, v in sent.items()
-                            if now - v[2] > 60.0),
-                           key=lambda k: sent[k][2])[:16]
+            probed = self.__dict__.setdefault('_p2p_probe_at', {})
+            stale = sorted(
+                (k for k, v in sent.items()
+                 if now - v[2] > 60.0 and now - probed.get(k, 0) > 60.0),
+                key=lambda k: sent[k][2])[:2]
             for k in stale:
                 try:
                     client.key_value_try_get(k)
+                    probed[k] = now  # still undelivered; back off
                 except Exception:
                     del sent[k]  # consumed: nothing left to GC
+                    probed.pop(k, None)
 
     def recv_obj(self, source, tag=0, timeout=120.0, channel=None):
         """Blocking receive of the next object from process

@@ -14,6 +14,13 @@ class Alex(nn.Module):
 
     @nn.compact
     def __call__(self, x, train=True):
+        if x.shape[1] < 68 or x.shape[2] < 68:
+            # VALID 11x11/4 conv + three 3x3/2 pools: below ~68px the
+            # final pool window exceeds its input and the flatten feeds
+            # an empty tensor -- fail at trace time instead
+            raise ValueError(
+                'Alex needs input >= 68x68 (canonical %d), got %r'
+                % (self.insize, x.shape[1:3]))
         x = x.astype(self.dtype)
         x = nn.relu(nn.Conv(96, (11, 11), strides=(4, 4), padding='VALID',
                             dtype=self.dtype)(x))

@@ -21,6 +21,13 @@ class NIN(nn.Module):
 
     @nn.compact
     def __call__(self, x, train=True):
+        if x.shape[1] < 68 or x.shape[2] < 68:
+            # VALID 11x11/4 conv + three 3x3/2 pools: below ~68px the
+            # spatial dims collapse to zero and the global-average head
+            # silently yields NaN -- fail at trace time instead
+            raise ValueError(
+                'NIN needs input >= 68x68 (canonical %d), got %r'
+                % (self.insize, x.shape[1:3]))
         x = x.astype(self.dtype)
         x = self._mlpconv(x, 96, (11, 11), (4, 4), 'VALID')
         x = nn.max_pool(x, (3, 3), strides=(2, 2))

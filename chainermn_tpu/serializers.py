@@ -115,16 +115,46 @@ def resume_updater(path, updater, comm):
     return state
 
 
-def save_checkpoint(directory, tree, step=0):
-    """Sharded checkpoint via orbax (each host writes its shards)."""
+_async_ckptr = None
+
+
+def save_checkpoint(directory, tree, step=0, async_=False):
+    """Sharded checkpoint via orbax (each host writes its shards).
+
+    ``async_=True`` returns as soon as the device arrays are snapshot
+    to host memory and writes to disk on a background thread --
+    training resumes immediately instead of stalling on filesystem
+    I/O.  A subsequent async save (or :func:`wait_checkpoints`) joins
+    the previous write first, so at most one write is in flight and
+    ordering is preserved.
+    """
     import orbax.checkpoint as ocp
     directory = os.path.abspath(directory)
+    path = os.path.join(directory, str(step))
+    if async_:
+        global _async_ckptr
+        if _async_ckptr is None:
+            import atexit
+            _async_ckptr = ocp.AsyncCheckpointer(
+                ocp.PyTreeCheckpointHandler())
+            atexit.register(wait_checkpoints)
+        _async_ckptr.save(path, tree, force=True)
+        return directory
     ckptr = ocp.PyTreeCheckpointer()
-    ckptr.save(os.path.join(directory, str(step)), tree, force=True)
+    ckptr.save(path, tree, force=True)
     return directory
 
 
+def wait_checkpoints():
+    """Block until any in-flight async checkpoint write has committed
+    (call before reading a just-saved step or at shutdown; the atexit
+    hook does the latter automatically)."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+
+
 def restore_checkpoint(directory, template, step=0):
+    wait_checkpoints()  # never read a step whose write is in flight
     import orbax.checkpoint as ocp
     ckptr = ocp.PyTreeCheckpointer()
     return ckptr.restore(os.path.join(os.path.abspath(directory),

@@ -13,6 +13,21 @@ import sys
 import time
 
 
+def _as_float(v):
+    """Host float from a metric value: plain numbers pass through,
+    0-d device arrays are fetched (ONLY call where the value is
+    actually consumed -- this is the sync point async metrics defer).
+    Returns None for non-numeric values."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    if hasattr(v, 'item') and getattr(v, 'ndim', None) == 0:
+        try:
+            return float(v)
+        except TypeError:
+            return None
+    return None
+
+
 class LogReport:
     """Accumulate observations every iteration and emit interval means
     to ``out/log`` on the emit trigger (the constructor's ``trigger``
@@ -44,9 +59,13 @@ class LogReport:
 
     def accumulate(self, observation):
         # per-key counts: sparse keys (e.g. validation metrics reported
-        # once per epoch) must not be diluted by the iteration count
+        # once per epoch) must not be diluted by the iteration count.
+        # Device-resident metrics (async mode) accumulate ON DEVICE --
+        # the sum below dispatches a tiny add, no host sync -- and are
+        # only fetched at emit time.
         for k, v in observation.items():
-            if isinstance(v, (int, float)):
+            if (isinstance(v, (int, float))
+                    or getattr(v, 'ndim', None) == 0):
                 self._accum[k] = self._accum.get(k, 0.0) + v
                 self._counts[k] = self._counts.get(k, 0) + 1
 
@@ -54,7 +73,8 @@ class LogReport:
         self.accumulate(trainer.observation)
         if not self._emit_trigger(trainer):
             return
-        entry = {k: v / self._counts[k] for k, v in self._accum.items()}
+        entry = {k: _as_float(v) / self._counts[k]
+                 for k, v in self._accum.items()}
         entry.update(epoch=trainer.updater.epoch,
                      iteration=trainer.updater.iteration,
                      elapsed_time=trainer.elapsed_time)
@@ -97,8 +117,8 @@ class PrintReport:
         row = []
         for e in self.entries:
             v = obs.get(e, '')
-            row.append('%-16s' % (('%.6g' % v) if isinstance(
-                v, (int, float)) else v))
+            f = _as_float(v)
+            row.append('%-16s' % (('%.6g' % f) if f is not None else v))
         self._out.write(''.join(row) + '\n')
         self._out.flush()
 

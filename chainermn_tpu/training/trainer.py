@@ -22,8 +22,16 @@ class _ExtensionEntry:
 
 
 class Trainer:
+    """``async_metrics=True`` keeps per-iteration metrics on the
+    device: the updater is called with ``sync=False`` so the loop
+    dispatches step n+1 while step n still runs, instead of blocking a
+    full host-device round trip every iteration (material on a
+    tunneled/remote TPU).  Extensions convert to floats lazily (see
+    ``extensions._as_float``); a lightweight sync every
+    ``sync_interval`` iterations bounds the in-flight queue."""
 
-    def __init__(self, updater, stop_trigger=(1, 'epoch'), out='result'):
+    def __init__(self, updater, stop_trigger=(1, 'epoch'), out='result',
+                 async_metrics=False, sync_interval=16):
         self.updater = updater
         self.stop_trigger = triggers_mod.get_trigger(stop_trigger)
         self.out = out
@@ -31,6 +39,8 @@ class Trainer:
         self._extensions = []
         self._done = False
         self.elapsed_time = 0.0
+        self._async = bool(async_metrics)
+        self._sync_interval = max(1, int(sync_interval))
 
     def extend(self, extension, trigger=None, name=None, priority=None):
         if trigger is None:
@@ -50,7 +60,17 @@ class Trainer:
         start = time.time()
         stop = self.stop_trigger
         while not stop(self):
-            self.observation = self.updater.update()
+            if self._async:
+                self.observation = self.updater.update(sync=False)
+                if self.updater.iteration % self._sync_interval == 0:
+                    # fetch ONE scalar: completes everything queued up
+                    # to this step (params chain), bounding run-ahead
+                    import jax
+                    for v in self.observation.values():
+                        jax.device_get(v)
+                        break
+            else:
+                self.observation = self.updater.update()
             self.elapsed_time = time.time() - start
             for entry in sorted(self._extensions,
                                 key=lambda e: -e.priority):

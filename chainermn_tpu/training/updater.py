@@ -285,8 +285,17 @@ class StandardUpdater:
         self.iteration += 1
         return metrics
 
-    def update(self):
+    def update(self, sync=True):
+        """Advance one iteration.  ``sync=True`` (default) returns host
+        floats -- which BLOCKS on the device step and costs a full
+        host-device round trip per iteration.  ``sync=False`` returns
+        the device-resident metric arrays so the Python loop can run
+        ahead and the device never idles between steps; convert with
+        ``float()`` only where a value is actually consumed (see
+        ``Trainer(async_metrics=True)``)."""
         metrics = self.update_core(self.shard_batch(next(self.iterator)))
+        if not sync:
+            return dict(metrics)
         return {k: float(v) for k, v in metrics.items()}
 
     def compiled_cost_analysis(self, arrays):

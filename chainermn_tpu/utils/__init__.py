@@ -1,7 +1,10 @@
-"""Runtime utilities: platform setup, profiling, failure detection."""
+"""Runtime utilities: platform setup, profiling, failure detection,
+distributed LR recipes."""
 
 from chainermn_tpu.utils.platform import force_host_devices  # noqa
 from chainermn_tpu.utils import profiling  # noqa
 from chainermn_tpu.utils.failure import (  # noqa
     NanGuard, DivergenceError, Heartbeat, check_finite, detect_stall,
     heartbeat_extension)
+from chainermn_tpu.utils.schedules import (  # noqa
+    linear_scaled_lr, gradual_warmup, distributed_sgd_schedule)

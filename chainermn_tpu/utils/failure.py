@@ -59,9 +59,20 @@ class NanGuard:
         obs = trainer.observation
         bad = [k for k, v in obs.items()
                if isinstance(v, float) and not np.isfinite(v)]
-        if not bad and self.param_interval and \
-                trainer.updater.iteration % self.param_interval == 0:
-            bad = check_finite(trainer.updater.params, 'params/')
+        audit = (self.param_interval and
+                 trainer.updater.iteration % self.param_interval == 0)
+        if not bad and audit:
+            # device-resident metrics (Trainer async_metrics=True) are
+            # deliberately NOT fetched per iteration -- that would
+            # reintroduce the per-step host sync async mode removes --
+            # but the periodic audit is a sync point anyway, so check
+            # them here alongside the parameters
+            for k, v in obs.items():
+                if getattr(v, 'ndim', None) == 0 and not np.isfinite(
+                        np.asarray(v)):
+                    bad.append(k)
+            if not bad:
+                bad = check_finite(trainer.updater.params, 'params/')
         if bad:
             msg = ('non-finite values at iteration %d: %s'
                    % (trainer.updater.iteration, ', '.join(bad)))

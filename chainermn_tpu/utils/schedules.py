@@ -1,0 +1,71 @@
+"""Distributed learning-rate recipes.
+
+The reference's headline result (the 128-GPU ResNet-50 run pointed at
+by ``/root/reference/README.md:19``) depends on the large-batch
+training recipe popularized alongside it: scale the learning rate
+linearly with the global batch and ramp it up over the first epochs so
+the early large-batch updates do not diverge.  The reference leaves
+the recipe to its example flags; here it is a first-class utility so
+every example and user script applies the same math when the mesh
+grows.
+
+All helpers return plain ``optax`` schedules (step -> lr) and compose
+with any optimizer; ``steps`` means optimizer steps (one per global
+batch).
+"""
+
+import optax
+
+__all__ = ['linear_scaled_lr', 'gradual_warmup',
+           'distributed_sgd_schedule']
+
+
+def linear_scaled_lr(base_lr, global_batch, base_batch=256):
+    """Linear scaling rule: ``lr = base_lr * global_batch/base_batch``.
+
+    ``base_lr`` is the single-device recipe's rate at ``base_batch``;
+    growing the mesh grows the global batch and the rate with it.
+    """
+    if global_batch <= 0 or base_batch <= 0:
+        raise ValueError('batch sizes must be positive')
+    return base_lr * (global_batch / float(base_batch))
+
+
+def gradual_warmup(target_lr, warmup_steps, after=None, init_factor=0.1):
+    """Ramp from ``init_factor * target_lr`` to ``target_lr`` over
+    ``warmup_steps``, then follow ``after`` (an optax schedule taking
+    post-warmup steps; default: constant ``target_lr``).
+
+    The gradual-warmup trick that makes the linear scaling rule stable
+    for large meshes; with ``warmup_steps=0`` it is just ``after``.
+    """
+    if after is None:
+        after = optax.constant_schedule(target_lr)
+    if warmup_steps <= 0:
+        return after
+    ramp = optax.linear_schedule(
+        init_value=init_factor * target_lr, end_value=target_lr,
+        transition_steps=warmup_steps)
+    return optax.join_schedules([ramp, after], [warmup_steps])
+
+
+def distributed_sgd_schedule(global_batch, steps_per_epoch,
+                             base_lr=0.1, base_batch=256,
+                             warmup_epochs=5, total_epochs=90,
+                             decay='cosine'):
+    """The full large-batch recipe in one call: linear-scaled peak rate,
+    ``warmup_epochs`` of gradual warmup, then cosine decay to 0 (or
+    ``decay='step'`` for the classic /10 at 30/60/80 epochs).
+    """
+    peak = linear_scaled_lr(base_lr, global_batch, base_batch)
+    warmup_steps = warmup_epochs * steps_per_epoch
+    rest = max(1, (total_epochs - warmup_epochs) * steps_per_epoch)
+    if decay == 'cosine':
+        after = optax.cosine_decay_schedule(peak, decay_steps=rest)
+    elif decay == 'step':
+        after = optax.piecewise_constant_schedule(
+            peak, {(e - warmup_epochs) * steps_per_epoch: 0.1
+                   for e in (30, 60, 80) if e > warmup_epochs})
+    else:
+        raise ValueError("decay must be 'cosine' or 'step'")
+    return gradual_warmup(peak, warmup_steps, after)

@@ -1,0 +1,87 @@
+"""Distributed LR recipe math (utils.schedules)."""
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.utils import (distributed_sgd_schedule,
+                                 gradual_warmup, linear_scaled_lr)
+
+
+def test_linear_scaling_rule():
+    assert linear_scaled_lr(0.1, 256) == pytest.approx(0.1)
+    assert linear_scaled_lr(0.1, 2048) == pytest.approx(0.8)
+    assert linear_scaled_lr(0.05, 512, base_batch=128) == pytest.approx(
+        0.2)
+    with pytest.raises(ValueError):
+        linear_scaled_lr(0.1, 0)
+
+
+def test_gradual_warmup_ramps_then_holds():
+    sched = gradual_warmup(0.8, warmup_steps=10)
+    vals = [float(sched(i)) for i in range(15)]
+    assert vals[0] == pytest.approx(0.08)          # init_factor * peak
+    assert vals[10] == pytest.approx(0.8)
+    assert vals[14] == pytest.approx(0.8)          # constant after
+    assert all(b >= a - 1e-9 for a, b in zip(vals, vals[1:]))
+
+
+def test_gradual_warmup_zero_steps_passthrough():
+    import optax
+    after = optax.constant_schedule(0.3)
+    assert gradual_warmup(0.3, 0, after) is after
+
+
+def test_distributed_sgd_schedule_cosine():
+    spe = 100
+    sched = distributed_sgd_schedule(
+        global_batch=1024, steps_per_epoch=spe, base_lr=0.1,
+        base_batch=256, warmup_epochs=2, total_epochs=10)
+    peak = 0.4  # 0.1 * 1024/256
+    warm_end = 2 * spe
+    assert float(sched(warm_end)) == pytest.approx(peak, rel=1e-3)
+    # cosine decays monotonically to ~0 by the end
+    end = 10 * spe
+    assert float(sched(end)) < 0.01 * peak
+    mids = [float(sched(warm_end + i * spe)) for i in range(8)]
+    assert all(b <= a + 1e-9 for a, b in zip(mids, mids[1:]))
+
+
+def test_distributed_sgd_schedule_step_decay():
+    spe = 10
+    sched = distributed_sgd_schedule(
+        global_batch=256, steps_per_epoch=spe, base_lr=0.1,
+        warmup_epochs=5, total_epochs=90, decay='step')
+    # epochs 30/60/80 drop the rate by 10x each
+    assert float(sched(29 * spe)) == pytest.approx(0.1, rel=1e-3)
+    assert float(sched(31 * spe)) == pytest.approx(0.01, rel=1e-3)
+    assert float(sched(61 * spe)) == pytest.approx(0.001, rel=1e-3)
+    assert float(sched(81 * spe)) == pytest.approx(0.0001, rel=1e-3)
+
+
+def test_schedule_drives_optimizer():
+    """The schedule plugs into the multi-node optimizer end to end."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(1, 8))
+    model = MLP(n_units=8, n_out=3)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.float32))['params']
+    loss = classifier_loss(lambda p, x: model.apply({'params': p}, x))
+    sched = gradual_warmup(0.1, warmup_steps=3)
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(sched), comm)
+    upd = training.StandardUpdater(iter([]), opt, loss, params, comm,
+                                   has_aux=True)
+    rng = np.random.RandomState(0)
+    x = rng.randn(16, 4).astype(np.float32)
+    y = rng.randint(0, 3, 16).astype(np.int32)
+    arrays = upd.shard_batch([(x[i], y[i]) for i in range(16)])
+    losses = [float(upd.update_core(arrays)['loss']) for _ in range(5)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]

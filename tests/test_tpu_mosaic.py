@@ -139,11 +139,17 @@ def test_transformer_step_mosaic():
             for g in jax.tree_util.tree_leaves(grads))
         return float(np.asarray(jax.device_get(val))), gn ** 0.5
 
-    l_pallas, g_pallas = run()
-    os.environ['CHAINERMN_TPU_PALLAS'] = '0'
+    # force the kernel arm ON even if the ambient env disabled Pallas
+    # (oracle-vs-oracle would pass vacuously); restore afterwards
+    prior = os.environ.pop('CHAINERMN_TPU_PALLAS', None)
     try:
+        l_pallas, g_pallas = run()
+        os.environ['CHAINERMN_TPU_PALLAS'] = '0'
         l_oracle, g_oracle = run()
     finally:
-        os.environ.pop('CHAINERMN_TPU_PALLAS', None)
+        if prior is None:
+            os.environ.pop('CHAINERMN_TPU_PALLAS', None)
+        else:
+            os.environ['CHAINERMN_TPU_PALLAS'] = prior
     assert abs(l_pallas - l_oracle) / max(abs(l_oracle), 1e-6) < 2e-2
     assert abs(g_pallas - g_oracle) / max(abs(g_oracle), 1e-6) < 5e-2

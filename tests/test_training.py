@@ -144,6 +144,34 @@ def test_log_report_averages(tmp_path):
         sum(per_iter) / len(per_iter), rel=1e-6)
 
 
+def test_async_metrics_trainer_matches_sync(tmp_path):
+    """Trainer(async_metrics=True) must produce the SAME logged means
+    as the blocking path -- metrics stay device-resident between
+    LogReport emits, accumulate on device, and are fetched lazily."""
+    tr, upd = _small_trainer(tmp_path, n_epoch=2)
+    log = extensions.LogReport()
+    tr.extend(log)
+    tr.run()
+
+    tr2, upd2 = _small_trainer(tmp_path, n_epoch=2)
+    tr2._async = True  # what Trainer(async_metrics=True) sets
+    tr2._sync_interval = 2
+    log2 = extensions.LogReport()
+    tr2.extend(log2)
+    seen_kinds = []
+    tr2.extend(lambda t: seen_kinds.append(
+        getattr(t.observation.get('loss'), 'ndim', None)),
+        trigger=(1, 'iteration'), name='probe', priority=500)
+    tr2.run()
+
+    # during the run the loss is a device array (ndim 0), not a float
+    assert all(k == 0 for k in seen_kinds) and seen_kinds
+    assert len(log.log) == len(log2.log) == 2
+    for a, b in zip(log.log, log2.log):
+        assert a['loss'] == pytest.approx(b['loss'], rel=1e-6)
+        assert a['accuracy'] == pytest.approx(b['accuracy'], rel=1e-6)
+
+
 def test_multiprocess_iterator_reset_reuse():
     it = training.iterators.MultiprocessIterator(
         list(range(10)), 4, repeat=False, shuffle=False)
@@ -202,6 +230,26 @@ def test_orbax_sharded_checkpoint(tmp_path):
                                               tree, step=3)
     np.testing.assert_allclose(back['a'], tree['a'])
     assert back['b']['c'].dtype == jnp.bfloat16
+
+
+def test_orbax_async_checkpoint(tmp_path):
+    """async_=True returns before the write commits; restore joins the
+    in-flight write (wait_checkpoints) and reads back the same tree."""
+    import warnings
+    import jax.numpy as jnp
+    from chainermn_tpu import serializers
+    tree = {'w': jnp.arange(16.0).reshape(4, 4),
+            's': jnp.float32(7.0)}
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')
+        serializers.save_checkpoint(str(tmp_path / 'ck'), tree, step=1,
+                                    async_=True)
+        # immediate restore must see the committed write, not a
+        # partial directory
+        back = serializers.restore_checkpoint(str(tmp_path / 'ck'),
+                                              tree, step=1)
+    np.testing.assert_allclose(back['w'], tree['w'])
+    assert float(back['s']) == 7.0
 
 
 def test_gradient_accumulation_matches_full_batch():
