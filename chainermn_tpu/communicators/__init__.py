@@ -20,10 +20,15 @@ naive          2-D        per-parameter pmean; CPU testing
 single_node    1 host     ICI-only; asserts inter_size == 1
 non_cuda_aware 2-D        hierarchical with f32-staged DCN leg
 dummy          any        no communication; fusion-overhead probe
+bucketed       2-D        ~25MB fused chunks in backward order: lets
+                          XLA overlap collectives with the backward
+                          pass (no reference equivalent)
 ============== ========== ===========================================
 """
 
 from chainermn_tpu.communicators.base import CommunicatorBase  # noqa
+from chainermn_tpu.communicators.bucketed_communicator import (
+    BucketedCommunicator)
 from chainermn_tpu.communicators.dummy_communicator import DummyCommunicator
 from chainermn_tpu.communicators.flat_communicator import FlatCommunicator
 from chainermn_tpu.communicators.hierarchical_communicator import (
@@ -46,6 +51,7 @@ _COMMUNICATORS = {
     'non_cuda_aware': NonCudaAwareCommunicator,
     'dummy': DummyCommunicator,
     'xla': XlaCommunicator,
+    'bucketed': BucketedCommunicator,
 }
 
 

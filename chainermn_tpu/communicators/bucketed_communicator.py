@@ -1,0 +1,80 @@
+"""Bucketed allreduce: fused chunks sized for compute/comm overlap.
+
+TPU-native extension beyond the reference's strategy set (its closest
+relatives are ``flat`` -- one giant buffer, reference
+``flat_communicator.py:19-39`` -- and ``naive`` -- one collective per
+leaf).  Both extremes lose overlap: a single flat buffer cannot start
+reducing until EVERY gradient of the backward pass exists, while
+per-leaf collectives drown small tensors in per-collective latency.
+
+The modern middle ground (the bucketing every DDP-style framework
+converged on): pack leaves in backward-completion order -- the model's
+reversed leaf order, since backprop produces last-layer gradients
+first -- into ~``bucket_mb`` fused buffers, one ``pmean`` per bucket.
+Inside the single jitted train step XLA sees each bucket's psum depend
+only on that bucket's gradients, so its latency-hiding scheduler can
+launch the first buckets' collectives while the backward pass is still
+computing earlier layers' gradients, and overlap buckets with one
+another on the ICI.
+
+Buckets group by dtype first (mixed-precision models must not share a
+buffer across dtypes), then split at the size threshold.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from chainermn_tpu.communicators import memory_utility
+from chainermn_tpu.communicators.base import CommunicatorBase
+from chainermn_tpu.communicators.mesh_utility import AXES
+
+
+class BucketedCommunicator(CommunicatorBase):
+
+    def __init__(self, mesh=None, mesh_shape=None, devices=None,
+                 bucket_mb=25.0):
+        super().__init__(mesh, mesh_shape, devices)
+        if bucket_mb <= 0:
+            raise ValueError('bucket_mb must be positive')
+        self.bucket_bytes = int(bucket_mb * 1e6)
+
+    def plan_buckets(self, leaves):
+        """Partition leaf indices into fused buckets: backward-
+        completion order (reversed leaf order approximates "last layer
+        first", letting early buckets close early), one OPEN bucket
+        per dtype -- interleaved mixed-precision leaf orders (bf16
+        weights alternating with f32 norm scales) must still fuse into
+        big buckets, not flush on every dtype flip -- split at
+        ``bucket_bytes``."""
+        buckets = []       # list of lists of leaf indices
+        open_buckets = {}  # dtype -> (indices, bytes)
+        for i in reversed(range(len(leaves))):
+            leaf = leaves[i]
+            dt = jnp.dtype(leaf.dtype)
+            nbytes = leaf.size * dt.itemsize
+            cur, cur_bytes = open_buckets.get(dt, ([], 0))
+            if cur and cur_bytes + nbytes > self.bucket_bytes:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            open_buckets[dt] = (cur, cur_bytes + nbytes)
+        for cur, _ in open_buckets.values():
+            if cur:
+                buckets.append(cur)
+        return buckets
+
+    def _allreduce_impl(self, grads):
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if not leaves:
+            return grads
+        buckets = self.plan_buckets(leaves)
+        out = [None] * len(leaves)
+        for idxs in buckets:
+            buf, schema = memory_utility.pack_params(
+                [leaves[i] for i in idxs])
+            buf = lax.pmean(buf, AXES)
+            for i, leaf in zip(idxs, memory_utility.unpack_params(
+                    buf, schema)):
+                out[i] = leaf
+        return jax.tree_util.tree_unflatten(treedef, out)

@@ -31,7 +31,8 @@ class MultiNodeOptimizerState(NamedTuple):
 
 
 def create_multi_node_optimizer(actual_optimizer, communicator,
-                                broadcast_first=True):
+                                broadcast_first=True,
+                                allreduce_dtype=None):
     """Wrap an optax optimizer with mesh-wide gradient averaging.
 
     Parity with ``chainermn.create_multi_node_optimizer(opt, comm)``
@@ -39,7 +40,18 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
     an ``optax.GradientTransformation``; its ``update`` must run inside
     ``shard_map`` over ``communicator.mesh`` (the standard updater does
     this for you).
+
+    ``allreduce_dtype`` (e.g. ``'bfloat16'``): cast gradients to a
+    narrower dtype for the reduction and back afterwards -- halves the
+    bytes every collective moves over ICI/DCN at the cost of reduced
+    summation precision (the mean is computed in the narrow dtype).
+    The TPU-native form of ChainerMN's fp16-allreduce option; leave
+    ``None`` (full precision) unless gradient traffic is the
+    bottleneck.  Applies to the gradient allreduce only -- the
+    first-call weight broadcast stays full-precision.
     """
+    if allreduce_dtype is not None:
+        allreduce_dtype = jnp.dtype(allreduce_dtype)
 
     def init(params):
         return MultiNodeOptimizerState(
@@ -65,7 +77,15 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
         def later_call(_):
             # The predicate is replica-uniform, so collectives inside
             # the branch are issued (or not) in lockstep on all devices.
-            reduced = communicator.allreduce_grad(grads)
+            g = grads
+            if allreduce_dtype is not None:
+                g = jax.tree_util.tree_map(
+                    lambda x: x.astype(allreduce_dtype), g)
+            reduced = communicator.allreduce_grad(g)
+            if allreduce_dtype is not None:
+                reduced = jax.tree_util.tree_map(
+                    lambda r, orig: r.astype(orig.dtype), reduced,
+                    grads)
             return actual_optimizer.update(reduced, state.actual_state,
                                            params)
 

@@ -20,7 +20,7 @@ SHAPES = [(3, 2), (4, 5), (6, 7)]  # 3-param model fixture, like the
 
 MESH_SHAPES = [(1, 8), (2, 4), (8, 1)]
 NAMES = ['naive', 'flat', 'hierarchical', 'two_dimensional',
-         'non_cuda_aware', 'xla']
+         'non_cuda_aware', 'xla', 'bucketed']
 
 
 def _shard_map(comm, f, out_specs=P()):
@@ -65,6 +65,59 @@ def test_single_node_communicator():
                                np.full(SHAPES[0], 3.5), rtol=1e-5)
     with pytest.raises(ValueError):
         chainermn_tpu.create_communicator('single_node', mesh_shape=(2, 4))
+
+
+def test_bucketed_splits_and_preserves_dtypes():
+    """Bucketing must group by dtype, split at the size threshold, and
+    produce exactly the per-leaf mean with original dtypes -- a tiny
+    bucket_mb forces many buckets, exercising the split path."""
+    from chainermn_tpu.communicators.bucketed_communicator import (
+        BucketedCommunicator)
+    comm = BucketedCommunicator(mesh_shape=(2, 4), bucket_mb=0.001)
+
+    def f():
+        r = comm.axis_rank().astype(jnp.float32)
+        grads = {
+            'a': jnp.full((64,), r, jnp.float32),
+            'b': jnp.full((128,), r + 1.0, jnp.bfloat16),
+            'c': jnp.full((300,), r + 2.0, jnp.float32),
+            'd': jnp.full((8,), r + 3.0, jnp.bfloat16),
+        }
+        return comm.allreduce_grad(grads)
+
+    out = jax.jit(_shard_map(comm, f))()
+    mean = (comm.size - 1) / 2.0
+    np.testing.assert_allclose(np.asarray(out['a']),
+                               np.full(64, mean), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out['c'], np.float32),
+                               np.full(300, mean + 2.0), rtol=1e-5)
+    assert out['b'].dtype == jnp.bfloat16
+    assert out['d'].dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out['b'], np.float32),
+                               np.full(128, mean + 1.0), rtol=2e-2)
+    with pytest.raises(ValueError):
+        BucketedCommunicator(mesh_shape=(2, 4), bucket_mb=0)
+
+
+def test_bucketed_interleaved_dtypes_still_fuse():
+    """Alternating bf16/f32 leaves (weights + norm scales per layer)
+    must NOT flush a bucket on every dtype flip: one open bucket per
+    dtype keeps the collective count at O(total_bytes / bucket_size),
+    not O(leaves)."""
+    from chainermn_tpu.communicators.bucketed_communicator import (
+        BucketedCommunicator)
+    comm = BucketedCommunicator(mesh_shape=(2, 4), bucket_mb=25.0)
+    leaves = []
+    for _ in range(20):  # 20 "layers", dtype alternating per leaf
+        leaves.append(jnp.zeros((256,), jnp.bfloat16))
+        leaves.append(jnp.zeros((16,), jnp.float32))
+    buckets = comm.plan_buckets(leaves)
+    assert len(buckets) == 2  # one per dtype, everything fused
+    covered = sorted(i for b in buckets for i in b)
+    assert covered == list(range(len(leaves)))
+    for b in buckets:
+        dts = {jnp.dtype(leaves[i].dtype) for i in b}
+        assert len(dts) == 1
 
 
 def test_dummy_communicator_is_identity():

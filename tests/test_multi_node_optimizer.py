@@ -61,3 +61,37 @@ def test_params_required():
     state = opt.init({'w': jnp.zeros((2,))})
     with pytest.raises(ValueError, match='requires params'):
         opt.update({'w': jnp.ones((2,))}, state)
+
+
+@pytest.mark.parametrize('dtype', ['bfloat16', 'float16'])
+def test_allreduce_dtype_close_to_full_precision(dtype):
+    """allreduce_dtype halves collective bytes; the reduced-precision
+    mean must track the f32 mean within the narrow dtype's tolerance,
+    and updates must come back in the PARAM dtype."""
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+
+    def run(allreduce_dtype):
+        opt = chainermn_tpu.create_multi_node_optimizer(
+            optax.sgd(0.5), comm, allreduce_dtype=allreduce_dtype)
+
+        def steps():
+            r = comm.axis_rank().astype(jnp.float32)
+            params = {'w': jnp.zeros((4,), jnp.float32)}
+            state = opt.init(params)
+            for i in range(3):
+                grads = {'w': jnp.full((4,), (r + 1.0) * 0.125
+                                       * (i + 1))}
+                updates, state = opt.update(grads, state, params)
+                params = optax.apply_updates(params, updates)
+            return params['w']
+
+        fn = jax.jit(jax.shard_map(steps, mesh=comm.mesh, in_specs=(),
+                                   out_specs=P(AXES), check_vma=False))
+        return np.asarray(fn(), np.float32)
+
+    full = run(None)
+    narrow = run(dtype)
+    # identical across devices either way, and close across precisions
+    assert np.ptp(narrow) == 0.0
+    np.testing.assert_allclose(narrow, full, rtol=2e-2, atol=1e-3)
+    assert not np.allclose(narrow, 0.0)

@@ -1,0 +1,134 @@
+"""Best-value and early-stopping trigger semantics."""
+
+import pytest
+
+from chainermn_tpu.training import triggers
+
+
+class _FakeUpdater:
+    def __init__(self):
+        self.iteration = 0
+        self.epoch = 0
+        self.is_new_epoch = False
+
+
+class _FakeTrainer:
+    def __init__(self):
+        self.updater = _FakeUpdater()
+        self.observation = {}
+
+    def step(self, **obs):
+        self.updater.iteration += 1
+        self.observation = obs
+
+
+def test_max_value_trigger_fires_on_improvement():
+    tr = _FakeTrainer()
+    trig = triggers.MaxValueTrigger('acc', check_trigger=(1, 'iteration'))
+    fired = []
+    for acc in (0.5, 0.6, 0.55, 0.7, 0.7):
+        tr.step(acc=acc)
+        fired.append(trig(tr))
+    assert fired == [True, True, False, True, False]
+    assert trig.best == 0.7
+
+
+def test_min_value_trigger():
+    tr = _FakeTrainer()
+    trig = triggers.MinValueTrigger('loss',
+                                    check_trigger=(1, 'iteration'))
+    fired = []
+    for loss in (2.0, 1.5, 1.8, 1.1):
+        tr.step(loss=loss)
+        fired.append(trig(tr))
+    assert fired == [True, True, False, True]
+
+
+def test_best_value_skips_missing_key():
+    tr = _FakeTrainer()
+    trig = triggers.MaxValueTrigger('acc', check_trigger=(1, 'iteration'))
+    tr.step(other=1.0)
+    assert trig(tr) is False
+
+
+def test_best_value_handles_device_scalars():
+    import jax.numpy as jnp
+    tr = _FakeTrainer()
+    trig = triggers.MaxValueTrigger('acc', check_trigger=(1, 'iteration'))
+    tr.step(acc=jnp.float32(0.9))
+    assert trig(tr) is True
+    assert trig.best == pytest.approx(0.9)
+
+
+def test_early_stopping_patience():
+    tr = _FakeTrainer()
+    stop = triggers.EarlyStoppingTrigger(
+        'acc', patience=2, mode='max', check_trigger=(1, 'iteration'),
+        max_trigger=(1000, 'iteration'))
+    seq = [0.5, 0.6, 0.58, 0.59, 0.7, 0.65, 0.6]
+    out = []
+    for acc in seq:
+        tr.step(acc=acc)
+        out.append(stop(tr))
+    # improves at 0.6 (reset), stale 0.58/0.59 -> fires at the 2nd
+    # stale check; later values are irrelevant once the run would stop
+    assert out[:4] == [False, False, False, True]
+
+
+def test_early_stopping_max_trigger_backstop():
+    tr = _FakeTrainer()
+    stop = triggers.EarlyStoppingTrigger(
+        'acc', patience=99, mode='max', check_trigger=(1, 'iteration'),
+        max_trigger=(3, 'iteration'))
+    out = []
+    for acc in (0.1, 0.2, 0.3):
+        tr.step(acc=acc)
+        out.append(stop(tr))
+    # edge-triggered: fires once at the backstop; the Trainer exits
+    # its loop on the first True so later calls never happen
+    assert out == [False, False, True]
+
+
+def test_trigger_state_roundtrip():
+    """state_dict/load_state_dict keep the high-water mark and
+    patience across a simulated crash+resume."""
+    tr = _FakeTrainer()
+    trig = triggers.MaxValueTrigger('acc', check_trigger=(1, 'iteration'))
+    tr.step(acc=0.9)
+    assert trig(tr) is True
+    saved = trig.state_dict()
+
+    fresh = triggers.MaxValueTrigger('acc',
+                                     check_trigger=(1, 'iteration'))
+    fresh.load_state_dict(saved)
+    tr2 = _FakeTrainer()
+    tr2.step(acc=0.7)  # worse than the restored 0.9: must NOT fire
+    assert fresh(tr2) is False
+    tr2.step(acc=0.95)
+    assert fresh(tr2) is True
+
+    stop = triggers.EarlyStoppingTrigger(
+        'acc', patience=2, mode='max', check_trigger=(1, 'iteration'),
+        max_trigger=(1000, 'iteration'))
+    tr3 = _FakeTrainer()
+    for acc in (0.6, 0.5):  # one stale check accumulated
+        tr3.step(acc=acc)
+        stop(tr3)
+    resumed = triggers.EarlyStoppingTrigger(
+        'acc', patience=2, mode='max', check_trigger=(1, 'iteration'),
+        max_trigger=(1000, 'iteration'))
+    resumed.load_state_dict(stop.state_dict())
+    tr4 = _FakeTrainer()
+    tr4.step(acc=0.55)  # second consecutive stale check -> stop
+    assert resumed(tr4) is True
+
+
+def test_early_stopping_min_mode():
+    tr = _FakeTrainer()
+    stop = triggers.EarlyStoppingTrigger(
+        'loss', patience=1, mode='min', check_trigger=(1, 'iteration'),
+        max_trigger=(1000, 'iteration'))
+    tr.step(loss=1.0)
+    assert stop(tr) is False
+    tr.step(loss=1.2)
+    assert stop(tr) is True
