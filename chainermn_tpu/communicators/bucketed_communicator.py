@@ -65,16 +65,8 @@ class BucketedCommunicator(CommunicatorBase):
         return buckets
 
     def _allreduce_impl(self, grads):
-        leaves, treedef = jax.tree_util.tree_flatten(grads)
-        if not leaves:
+        if not jax.tree_util.tree_leaves(grads):
             return grads
-        buckets = self.plan_buckets(leaves)
-        out = [None] * len(leaves)
-        for idxs in buckets:
-            buf, schema = memory_utility.pack_params(
-                [leaves[i] for i in idxs])
-            buf = lax.pmean(buf, AXES)
-            for i, leaf in zip(idxs, memory_utility.unpack_params(
-                    buf, schema)):
-                out[i] = leaf
-        return jax.tree_util.tree_unflatten(treedef, out)
+        return memory_utility.fused_reduce(
+            grads, lambda buf: lax.pmean(buf, AXES),
+            plan=self.plan_buckets)

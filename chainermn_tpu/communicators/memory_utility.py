@@ -71,21 +71,30 @@ def pad_to_multiple(buf, multiple):
     return buf, n
 
 
-def fused_reduce(tree, reduce_buf):
-    """Apply ``reduce_buf(flat_buffer) -> flat_buffer`` to a pytree,
-    fused per dtype.
-
-    Leaves are grouped by dtype (mixed-precision models must not be
-    flattened into one buffer -- casting bf16/f32 together corrupts
-    gradients) and each group rides one fused buffer, so the collective
-    count is O(#dtypes), not O(#params).
-    """
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
+def plan_by_dtype(leaves):
+    """Default fusion plan: one group per dtype (mixed-precision models
+    must not be flattened into one buffer -- casting bf16/f32 together
+    corrupts gradients)."""
     by_dtype = {}
     for i, leaf in enumerate(leaves):
         by_dtype.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    return [idxs for _, idxs in sorted(by_dtype.items(),
+                                       key=lambda kv: kv[0].name)]
+
+
+def fused_reduce(tree, reduce_buf, plan=plan_by_dtype):
+    """Apply ``reduce_buf(flat_buffer) -> flat_buffer`` to a pytree,
+    one fused buffer per group of ``plan(leaves) -> [[leaf_idx, ...]]``.
+
+    The default plan groups per dtype, so the collective count is
+    O(#dtypes), not O(#params); strategies with other fusion policies
+    (e.g. the bucketed communicator's size-capped backward-order
+    groups) pass their own plan and share this pack/reduce/unpack
+    path.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
     out = [None] * len(leaves)
-    for dt, idxs in sorted(by_dtype.items(), key=lambda kv: kv[0].name):
+    for idxs in plan(leaves):
         buf, schema = pack_params([leaves[i] for i in idxs])
         buf = reduce_buf(buf)
         for i, leaf in zip(idxs, unpack_params(buf, schema)):

@@ -16,6 +16,12 @@ class IntervalTrigger:
         self.unit = unit
         self._previous = 0
 
+    def state_dict(self):
+        return {'previous': self._previous}
+
+    def load_state_dict(self, state):
+        self._previous = int(state.get('previous', 0))
+
     def __call__(self, trainer):
         u = trainer.updater
         if self.unit == 'iteration':
@@ -52,10 +58,18 @@ class BestValueTrigger:
         self.best = None
 
     def state_dict(self):
-        return {'best': self.best}
+        # the check trigger's interval counter rides along: without it
+        # a resumed trigger would fire at the first mid-interval
+        # iteration instead of the next true check point
+        s = {'best': self.best}
+        if hasattr(self.check, 'state_dict'):
+            s['check'] = self.check.state_dict()
+        return s
 
     def load_state_dict(self, state):
         self.best = state.get('best')
+        if 'check' in state and hasattr(self.check, 'load_state_dict'):
+            self.check.load_state_dict(state['check'])
 
     def __call__(self, trainer):
         if not self.check(trainer):
@@ -106,11 +120,20 @@ class EarlyStoppingTrigger:
         self._bad_checks = 0
 
     def state_dict(self):
-        return {'best': self.best, 'bad_checks': self._bad_checks}
+        s = {'best': self.best, 'bad_checks': self._bad_checks}
+        for name, trig in (('check', self.check),
+                           ('max_trigger', self.max_trigger)):
+            if hasattr(trig, 'state_dict'):
+                s[name] = trig.state_dict()
+        return s
 
     def load_state_dict(self, state):
         self.best = state.get('best')
         self._bad_checks = int(state.get('bad_checks', 0))
+        for name, trig in (('check', self.check),
+                           ('max_trigger', self.max_trigger)):
+            if name in state and hasattr(trig, 'load_state_dict'):
+                trig.load_state_dict(state[name])
 
     def __call__(self, trainer):
         if self.max_trigger(trainer):

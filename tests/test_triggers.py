@@ -102,6 +102,9 @@ def test_trigger_state_roundtrip():
                                      check_trigger=(1, 'iteration'))
     fresh.load_state_dict(saved)
     tr2 = _FakeTrainer()
+    # real resume restores the iteration counter too (serializers
+    # restore updater.iteration); mirror that here
+    tr2.updater.iteration = tr.updater.iteration
     tr2.step(acc=0.7)  # worse than the restored 0.9: must NOT fire
     assert fresh(tr2) is False
     tr2.step(acc=0.95)
@@ -119,6 +122,7 @@ def test_trigger_state_roundtrip():
         max_trigger=(1000, 'iteration'))
     resumed.load_state_dict(stop.state_dict())
     tr4 = _FakeTrainer()
+    tr4.updater.iteration = tr3.updater.iteration
     tr4.step(acc=0.55)  # second consecutive stale check -> stop
     assert resumed(tr4) is True
 
