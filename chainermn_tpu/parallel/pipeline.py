@@ -85,6 +85,122 @@ class Pipeline:
         return outputs
 
 
+def pipeline_1f1b_grads(stage_fn, per_micro_loss, params_local,
+                        x_microbatches, y_microbatches, n_stages,
+                        axis='stage'):
+    """One-forward-one-backward pipeline pass: returns
+    ``(loss, metrics, grads_local)`` -- loss/metrics are MEANS over
+    the ``n_micro`` micro-batches (no further division needed), valid
+    on the LAST stage only (callers psum over ``axis``); grads are the
+    stage-local parameter gradients of that mean loss, valid on every
+    stage.
+
+    TRUE 1F1B memory profile, not autodiff-through-the-schedule: the
+    scheduling ``lax.scan`` is never differentiated.  Each stage keeps
+    only a ``2 * n_stages``-slot ring buffer of its in-flight
+    micro-batch INPUTS; at a micro-batch's backward tick the stage
+    recomputes its forward under ``jax.vjp`` (same recompute cost as
+    ``remat=True``) and hand-propagates the cotangent with a reverse
+    ``ppermute`` -- the Send/Recv backward pairing of the reference
+    (``point_to_point_communication.py:23-33``) written out explicitly.
+    In-flight activations per stage are bounded by ``2*n_stages``
+    regardless of ``n_micro``, which is the 1F1B property GPipe's
+    differentiated scan lacks (its carry count grows with
+    ``n_micro + n_stages``).
+
+    Schedule (tick ``t``, stage ``s``, ``S=n_stages``, ``M=n_micro``):
+    forward of micro ``m`` runs at ``t = m + s`` (as GPipe); backward
+    of micro ``m`` runs at ``t = m + 2S - 1 - s`` -- the last stage
+    turns a micro-batch around one tick after finishing its forward,
+    and cotangents ride the reverse permutation one stage per tick.
+    Total ticks: ``M + 2S - 1``.
+
+    Constraints: ``stage_fn`` must be collective-free (its vjp is taken
+    per device), and ``per_micro_loss(y, y_micro) -> (loss, metrics)``
+    must decompose as a mean over micro-batches (standard mean losses
+    do; the total is averaged over ``M`` here).
+    """
+    S = n_stages
+    M = x_microbatches.shape[0]
+    B = 2 * S  # ring slots; max in-flight gap is 2S-1
+    stage = lax.axis_index(axis)
+    perm_fwd = [(i, (i + 1) % S) for i in range(S)]
+    perm_bwd = [((i + 1) % S, i) for i in range(S)]
+    total_ticks = M + 2 * S - 1
+
+    act_shape = x_microbatches[0]
+    zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params_local)
+
+    def tick(carry, t):
+        state_f, state_b, ring, grads, loss_sum, metrics_sum = carry
+
+        # ---- forward slot (identical to the GPipe schedule)
+        m_f = t - stage
+        fwd_valid = jnp.logical_and(m_f >= 0, m_f < M)
+        feed = x_microbatches[jnp.clip(m_f, 0, M - 1)]
+        x_in = jnp.where(stage == 0, feed, state_f)
+        y = stage_fn(params_local, x_in)
+        # stash this micro's INPUT for the recompute at its bwd tick
+        slot_f = jnp.mod(jnp.clip(m_f, 0, None), B)
+        ring = lax.cond(
+            fwd_valid,
+            lambda r: lax.dynamic_update_index_in_dim(
+                r, x_in.astype(r.dtype), slot_f, 0),
+            lambda r: r, ring)
+
+        # ---- backward slot
+        m_b = t - (2 * S - 1) + stage
+        bwd_valid = jnp.logical_and(m_b >= 0, m_b < M)
+        slot_b = jnp.mod(jnp.clip(m_b, 0, None), B)
+        x_saved = ring[slot_b]
+        y_re, vjp = jax.vjp(stage_fn, params_local, x_saved)
+        is_last = stage == S - 1
+        # cotangent seed: last stage differentiates its own micro loss;
+        # earlier stages consume the cotangent received LAST tick
+        ym = y_microbatches[jnp.clip(m_b, 0, M - 1)]
+        gfun = jax.grad(lambda yy: per_micro_loss(yy, ym)[0] / M)
+        g_loss = gfun(y_re)
+        g_in = jnp.where(is_last, g_loss.astype(state_b.dtype), state_b)
+        dp, dx = vjp(g_in.astype(y_re.dtype))
+        grads = jax.tree_util.tree_map(
+            lambda acc, d: acc + jnp.where(bwd_valid, d, 0.0), grads, dp)
+        # metrics only meaningful on the last stage's valid bwd ticks
+        loss_m, metrics_m = per_micro_loss(y_re, ym)
+        emit = jnp.logical_and(bwd_valid, is_last)
+        loss_sum = loss_sum + jnp.where(emit, loss_m, 0.0)
+        metrics_sum = jax.tree_util.tree_map(
+            lambda acc, v: acc + jnp.where(emit, v, jnp.zeros_like(v)),
+            metrics_sum, metrics_m)
+
+        # ---- rotate: activations forward, cotangents backward
+        state_f = lax.ppermute(y, axis, perm_fwd)
+        state_b = lax.ppermute(
+            jnp.where(bwd_valid, dx, jnp.zeros_like(dx)), axis,
+            perm_bwd)
+        return (state_f, state_b, ring, grads, loss_sum,
+                metrics_sum), None
+
+    # shape/zero templates (homogeneous pipelines: y shape == x shape)
+    y0 = jax.eval_shape(lambda: stage_fn(params_local, act_shape))
+    state_f0 = jnp.zeros(y0.shape, act_shape.dtype)
+    state_b0 = jnp.zeros(act_shape.shape, act_shape.dtype)
+    ring0 = jnp.zeros((B,) + act_shape.shape, act_shape.dtype)
+    l0, m0 = jax.eval_shape(
+        lambda: per_micro_loss(state_f0, y_microbatches[0]))
+    loss0 = jnp.zeros(l0.shape, l0.dtype)
+    metrics0 = jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype), m0)
+
+    (state_f, state_b, ring, grads, loss_sum, metrics_sum), _ = \
+        lax.scan(tick,
+                 (state_f0, state_b0, ring0, zero_grads, loss0,
+                  metrics0),
+                 jnp.arange(total_ticks))
+    loss = loss_sum / M
+    metrics = jax.tree_util.tree_map(lambda v: v / M, metrics_sum)
+    return loss, metrics, grads
+
+
 def stack_stage_params(params_per_stage):
     """Stack per-stage parameter pytrees along a new leading dim for
     sharding over the stage axis (``in_specs=P('stage', ...)``)."""

@@ -39,7 +39,8 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from chainermn_tpu.parallel.pipeline import Pipeline, microbatch
+from chainermn_tpu.parallel.pipeline import (
+    Pipeline, microbatch, pipeline_1f1b_grads)
 from chainermn_tpu.training.convert import concat_examples
 
 AXIS_DATA = 'data'
@@ -82,12 +83,23 @@ class PipelineUpdater:
       mesh: a ``(data, stage)`` mesh (``pipeline_mesh``).
       n_micro: number of micro-batches per step.
       remat: rematerialize the stage body in the backward pass
-        (1F1B-class peak memory; see module docstring).
+        (gpipe schedule only; see module docstring).
+      schedule: ``'gpipe'`` (default; differentiated scan) or
+        ``'1f1b'`` (:func:`~chainermn_tpu.parallel.pipeline.
+        pipeline_1f1b_grads`): one-forward-one-backward with
+        hand-propagated cotangents -- in-flight activations bounded by
+        ``2 * n_stages`` regardless of ``n_micro``, recompute built in.
+        1f1b requires a collective-free ``stage_fn`` and a
+        ``loss_on_last`` that decomposes as a mean over micro-batches
+        (standard mean losses do); both schedules produce identical
+        gradients (``tests/test_pipeline_training.py``).
     """
 
     def __init__(self, iterator, optimizer, stage_fn, loss_on_last,
                  params_stacked, mesh, n_micro, remat=False,
-                 donate=True):
+                 donate=True, schedule='gpipe'):
+        if schedule not in ('gpipe', '1f1b'):
+            raise ValueError("schedule must be 'gpipe' or '1f1b'")
         self.iterator = iterator
         self.optimizer = optimizer
         self.mesh = mesh
@@ -101,14 +113,19 @@ class PipelineUpdater:
         # (elementwise transformations update stacked leaves exactly as
         # they would per stage); scalar leaves (step counts) replicate
         opt_state0 = optimizer.init(params_stacked)
+        # per-leaf specs: stage-stacked leaves (mu/nu mirroring params)
+        # shard over the stage axis, scalar leaves (step counts)
+        # replicate -- shared by placement AND the 1f1b shard_map specs
+        opt_specs = jax.tree_util.tree_map(
+            lambda leaf: (P(AXIS_STAGE)
+                          if getattr(leaf, 'ndim', 0) >= 1
+                          and leaf.shape[0] == self.n_stages
+                          else P()),
+            opt_state0)
         self.opt_state = jax.device_put(
             opt_state0,
             jax.tree_util.tree_map(
-                lambda leaf: (stage_sharding
-                              if getattr(leaf, 'ndim', 0) >= 1
-                              and leaf.shape[0] == self.n_stages
-                              else NamedSharding(mesh, P())),
-                opt_state0))
+                lambda spec: NamedSharding(mesh, spec), opt_specs))
 
         body = stage_fn if not remat else jax.checkpoint(stage_fn)
         pipe = Pipeline(body, self.n_stages, axis=AXIS_STAGE)
@@ -161,8 +178,57 @@ class PipelineUpdater:
             params = optax.apply_updates(params, updates)
             return params, opt_state, dict(metrics, loss=loss)
 
+        # 1F1B: gradients are hand-propagated per stage inside the
+        # shard_map (no autodiff through collectives, so the
+        # grad-inside caveat above does not apply), and the optimizer
+        # runs on each stage's complete local tree in the same program.
+        stage_spec = P(AXIS_STAGE)
+
+        def device_step_1f1b(params, opt_state, x, y):
+            p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+            # squeeze only the stage-stacked optimizer leaves; scalar
+            # leaves (replicated, spec P()) pass through untouched
+            s_local = jax.tree_util.tree_map(
+                lambda a, sp: a[0] if sp == stage_spec else a,
+                opt_state, opt_specs)
+
+            def per_micro_loss(yy, ym):
+                return loss_on_last(yy[None], ym[None])
+
+            loss, metrics, grads = pipeline_1f1b_grads(
+                stage_fn, per_micro_loss, p_local,
+                microbatch(x, n_micro_), microbatch(y, n_micro_),
+                n_stages, axis=AXIS_STAGE)
+            grads = lax.pmean(grads, AXIS_DATA)
+            updates, s_local = optimizer.update(grads, s_local,
+                                                p_local)
+            p_local = optax.apply_updates(p_local, updates)
+            onlast = lax.axis_index(AXIS_STAGE) == n_stages - 1
+            loss = lax.pmean(
+                lax.psum(jnp.where(onlast, loss, 0.0), AXIS_STAGE),
+                AXIS_DATA)
+            metrics = jax.tree_util.tree_map(
+                lambda m: lax.pmean(
+                    lax.psum(jnp.where(onlast, m, jnp.zeros_like(m)),
+                             AXIS_STAGE), AXIS_DATA), metrics)
+            p_out = jax.tree_util.tree_map(lambda a: a[None], p_local)
+            s_out = jax.tree_util.tree_map(
+                lambda a, sp: a[None] if sp == stage_spec else a,
+                s_local, opt_specs)
+            return p_out, s_out, dict(metrics, loss=loss)
+
+        def train_step_1f1b(params, opt_state, x, y):
+            return jax.shard_map(
+                device_step_1f1b, mesh=mesh,
+                in_specs=(P(AXIS_STAGE), opt_specs,
+                          P(AXIS_DATA), P(AXIS_DATA)),
+                out_specs=(P(AXIS_STAGE), opt_specs, P()),
+                check_vma=False)(params, opt_state, x, y)
+
         kw = {'donate_argnums': (0, 1)} if donate else {}
-        self._step = jax.jit(train_step, **kw)
+        self._step = jax.jit(
+            train_step if schedule == 'gpipe' else train_step_1f1b,
+            **kw)
         # forward-only path for evaluation: same pipeline schedule and
         # loss, NO gradient/optimizer (params not donated)
         self._eval = jax.jit(

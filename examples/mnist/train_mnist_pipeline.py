@@ -36,6 +36,10 @@ def main():
                    help='homogeneous activation width')
     p.add_argument('--remat', action='store_true',
                    help='rematerialize stages in backward (less memory)')
+    p.add_argument('--schedule', choices=['gpipe', '1f1b'],
+                   default='gpipe',
+                   help='1f1b bounds in-flight activations at '
+                        '2*stages regardless of --micro')
     p.add_argument('--cpu', action='store_true',
                    help='force 8 virtual CPU devices')
     args = p.parse_args()
@@ -88,7 +92,7 @@ def main():
     updater = PipelineUpdater(
         train_iter, optax.adam(1e-3), stage_fn, loss_on_last,
         stack_stage_params(params), mesh, n_micro=args.micro,
-        remat=args.remat)
+        remat=args.remat, schedule=args.schedule)
 
     steps_per_epoch = max(1, len(train) // args.batchsize)
     for epoch in range(args.epoch):

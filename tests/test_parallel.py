@@ -160,6 +160,62 @@ def test_pipeline_backward():
             rtol=1e-4, atol=1e-5)
 
 
+def test_pipeline_1f1b_grads_match_sequential():
+    """The hand-rolled 1F1B backward (explicit reverse ppermute of
+    cotangents + per-stage vjp recompute) reproduces autodiff's
+    gradients and loss exactly."""
+    import optax
+    from chainermn_tpu.parallel.pipeline import (
+        microbatch, pipeline_1f1b_grads, stack_stage_params)
+    S, d, batch, M = 4, 16, 32, 8
+    rng = np.random.RandomState(0)
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p['w'] + p['b'])
+
+    params_list = [
+        {'w': jnp.asarray(rng.randn(d, d) * 0.5, jnp.float32),
+         'b': jnp.asarray(rng.randn(d) * 0.1, jnp.float32)}
+        for _ in range(S)]
+    stacked = stack_stage_params(params_list)
+    x = jnp.asarray(rng.randn(batch, d), jnp.float32)
+    y = jnp.asarray(rng.randint(0, d, batch), jnp.int32)
+
+    def per_micro_loss(out, ym):
+        ce = optax.softmax_cross_entropy_with_integer_labels(out, ym)
+        return ce.mean(), {}
+
+    mesh = _mesh((S,), ('stage',))
+
+    def dev(params, xm, ym):
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        loss, _, grads = pipeline_1f1b_grads(
+            stage_fn, per_micro_loss, p_local, xm, ym, S, axis='stage')
+        onlast = jax.lax.axis_index('stage') == S - 1
+        loss = jax.lax.psum(jnp.where(onlast, loss, 0.0), 'stage')
+        return loss, jax.tree_util.tree_map(lambda g: g[None], grads)
+
+    loss, grads = jax.jit(jax.shard_map(
+        dev, mesh=mesh, in_specs=(P('stage'), P(), P()),
+        out_specs=(P(), P('stage')), check_vma=False))(
+            stacked, microbatch(x, M), microbatch(y, M))
+
+    def seq_loss(params_list):
+        h = x
+        for p in params_list:
+            h = stage_fn(p, h)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            h, y).mean()
+
+    l_ref, g_ref = jax.value_and_grad(seq_loss)(params_list)
+    assert abs(float(loss) - float(l_ref)) < 1e-6
+    for s in range(S):
+        for k in ('w', 'b'):
+            np.testing.assert_allclose(
+                np.asarray(grads[k][s]), np.asarray(g_ref[s][k]),
+                rtol=1e-5, atol=1e-6)
+
+
 # -------------------------------------------------------------- tensor
 def test_tp_mlp_matches_dense():
     tp = 8

@@ -57,11 +57,13 @@ def _data(n=32, seed=3):
     return x, y
 
 
-@pytest.mark.parametrize('remat', [False, True])
-def test_pipeline_train_step_matches_sequential(remat):
+@pytest.mark.parametrize('remat,schedule', [
+    (False, 'gpipe'), (True, 'gpipe'), (False, '1f1b')])
+def test_pipeline_train_step_matches_sequential(remat, schedule):
     """One pipelined train step == one step of the unpipelined model:
     same loss, same updated parameters (per stage), for 8 devices as
-    (data=2, stage=4)."""
+    (data=2, stage=4) -- for BOTH schedules (1F1B's hand-propagated
+    cotangents must reproduce autodiff exactly)."""
     mesh = pipeline_mesh(N_STAGES)
     assert mesh.shape['data'] == 2
     params_list = make_params()
@@ -70,7 +72,8 @@ def test_pipeline_train_step_matches_sequential(remat):
     opt = optax.sgd(0.1, momentum=0.9)
     upd = PipelineUpdater(iter([]), opt, stage_fn, loss_on_last,
                           stack_stage_params(params_list), mesh,
-                          n_micro=4, remat=remat, donate=False)
+                          n_micro=4, remat=remat, donate=False,
+                          schedule=schedule)
     metrics = upd.update_core(upd.shard_batch(
         [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
     loss_pipe = float(metrics['loss'])
@@ -94,22 +97,27 @@ def test_pipeline_train_step_matches_sequential(remat):
 
 
 def test_remat_matches():
-    """remat=True is a memory knob, not a numerics knob: identical
-    params after 3 steps."""
+    """remat=True and schedule='1f1b' are memory/schedule knobs, not
+    numerics knobs: identical params after 3 adam steps."""
     mesh = pipeline_mesh(N_STAGES)
     x, y = _data()
     batch = [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]
     results = []
-    for remat in (False, True):
+    for remat, schedule in ((False, 'gpipe'), (True, 'gpipe'),
+                            (False, '1f1b')):
         upd = PipelineUpdater(
             iter([]), optax.adam(1e-2), stage_fn, loss_on_last,
             stack_stage_params(make_params()), mesh, n_micro=4,
-            remat=remat, donate=False)
+            remat=remat, donate=False, schedule=schedule)
         for _ in range(3):
             upd.update_core(upd.shard_batch(batch))
         results.append(jax.device_get(upd.params))
     np.testing.assert_allclose(results[0]['w'], results[1]['w'],
                                rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(results[0]['w'], results[2]['w'],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(results[0]['b'], results[2]['b'],
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_pipeline_training_converges():
