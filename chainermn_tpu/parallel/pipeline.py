@@ -156,16 +156,22 @@ def pipeline_1f1b_grads(stage_fn, per_micro_loss, params_local,
         y_re, vjp = jax.vjp(stage_fn, params_local, x_saved)
         is_last = stage == S - 1
         # cotangent seed: last stage differentiates its own micro loss;
-        # earlier stages consume the cotangent received LAST tick
+        # earlier stages consume the cotangent received LAST tick.
+        # value_and_grad+has_aux gives loss, metrics AND the seed from
+        # one loss evaluation (no reliance on CSE to dedupe).
         ym = y_microbatches[jnp.clip(m_b, 0, M - 1)]
-        gfun = jax.grad(lambda yy: per_micro_loss(yy, ym)[0] / M)
-        g_loss = gfun(y_re)
+
+        def scaled_loss(yy):
+            loss_m, metrics_m = per_micro_loss(yy, ym)
+            return loss_m / M, (loss_m, metrics_m)
+
+        (_, (loss_m, metrics_m)), g_loss = jax.value_and_grad(
+            scaled_loss, has_aux=True)(y_re)
         g_in = jnp.where(is_last, g_loss.astype(state_b.dtype), state_b)
         dp, dx = vjp(g_in.astype(y_re.dtype))
         grads = jax.tree_util.tree_map(
             lambda acc, d: acc + jnp.where(bwd_valid, d, 0.0), grads, dp)
         # metrics only meaningful on the last stage's valid bwd ticks
-        loss_m, metrics_m = per_micro_loss(y_re, ym)
         emit = jnp.logical_and(bwd_valid, is_last)
         loss_sum = loss_sum + jnp.where(emit, loss_m, 0.0)
         metrics_sum = jax.tree_util.tree_map(

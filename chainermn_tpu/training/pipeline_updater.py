@@ -91,15 +91,44 @@ class PipelineUpdater:
         ``2 * n_stages`` regardless of ``n_micro``, recompute built in.
         1f1b requires a collective-free ``stage_fn`` and a
         ``loss_on_last`` that decomposes as a mean over micro-batches
-        (standard mean losses do); both schedules produce identical
-        gradients (``tests/test_pipeline_training.py``).
+        (standard mean losses do).  GRADIENTS are identical between
+        schedules (``tests/test_pipeline_training.py``); identical
+        PARAMETER trajectories additionally require an ELEMENTWISE
+        optimizer -- under 1f1b the optimizer sees each stage's local
+        tree, so cross-element transformations (clip_by_global_norm,
+        LARS/LAMB trust ratios) would compute per-stage statistics
+        instead of the stacked-tree statistics gpipe uses.  This is
+        ENFORCED by a behavioral probe
+        (:func:`chainermn_tpu.parallel.zero.check_elementwise`);
+        ``schedule_check=False`` bypasses it.
+      schedule_check: verify the optimizer is elementwise when
+        ``schedule='1f1b'`` (see above).
     """
 
     def __init__(self, iterator, optimizer, stage_fn, loss_on_last,
                  params_stacked, mesh, n_micro, remat=False,
-                 donate=True, schedule='gpipe'):
+                 donate=True, schedule='gpipe', schedule_check=True):
         if schedule not in ('gpipe', '1f1b'):
             raise ValueError("schedule must be 'gpipe' or '1f1b'")
+        if schedule == '1f1b':
+            if remat:
+                raise ValueError(
+                    "remat=True has no effect under schedule='1f1b' "
+                    '(its backward recomputes by construction); drop '
+                    'the flag')
+            if schedule_check:
+                from chainermn_tpu.parallel import zero as zero_mod
+                try:
+                    zero_mod.check_elementwise(optimizer)
+                except ValueError as e:
+                    raise ValueError(
+                        "schedule='1f1b' requires an elementwise "
+                        'optimizer: under 1f1b the optimizer sees '
+                        "each stage's local tree, so cross-element "
+                        'transforms compute per-stage statistics and '
+                        "silently diverge from gpipe's stacked-tree "
+                        'trajectory.  Probe result: %s  Pass '
+                        'schedule_check=False to bypass.' % e) from e
         self.iterator = iterator
         self.optimizer = optimizer
         self.mesh = mesh

@@ -120,6 +120,55 @@ def test_remat_matches():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_1f1b_rejects_remat_and_nonelementwise():
+    mesh = pipeline_mesh(N_STAGES)
+    stacked = stack_stage_params(make_params())
+    with pytest.raises(ValueError, match='remat'):
+        PipelineUpdater(iter([]), optax.sgd(0.1), stage_fn,
+                        loss_on_last, stacked, mesh, n_micro=4,
+                        remat=True, schedule='1f1b')
+    with pytest.raises(ValueError, match='elementwise'):
+        PipelineUpdater(
+            iter([]),
+            optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1)),
+            stage_fn, loss_on_last, stacked, mesh, n_micro=4,
+            schedule='1f1b')
+    # bypass works, and gpipe accepts the same optimizer freely
+    PipelineUpdater(
+        iter([]),
+        optax.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1)),
+        stage_fn, loss_on_last, stacked, mesh, n_micro=4,
+        schedule='1f1b', schedule_check=False, donate=False)
+
+
+def test_pipeline_updater_drives_trainer(tmp_path):
+    """PipelineUpdater plugs into the full Trainer/extensions loop
+    (the way the reference's pipelined example trains,
+    ``train_mnist_model_parallel.py:66``): epochs advance, LogReport
+    averages, observations flow."""
+    from chainermn_tpu import training
+    from chainermn_tpu.datasets.mnist import TupleDataset
+    from chainermn_tpu.training import extensions
+
+    mesh = pipeline_mesh(N_STAGES)
+    rng = np.random.RandomState(0)
+    n = 128
+    xs = rng.randn(n, DIM).astype(np.float32)
+    ys = rng.randint(0, N_CLASSES, n).astype(np.int32)
+    it = training.SerialIterator(TupleDataset(xs, ys), 32)
+    upd = PipelineUpdater(it, optax.adam(1e-2), stage_fn, loss_on_last,
+                          stack_stage_params(make_params(2)), mesh,
+                          n_micro=4)
+    tr = training.Trainer(upd, (2, 'epoch'), out=str(tmp_path))
+    log = extensions.LogReport()
+    tr.extend(log)
+    tr.run()
+    assert upd.epoch == 2
+    assert len(log.log) == 2
+    assert np.isfinite(log.log[-1]['loss'])
+    assert log.log[-1]['loss'] < log.log[0]['loss'] * 1.2
+
+
 def test_pipeline_training_converges():
     """Short pipelined training run drives the loss down on a
     learnable task (linearly separable clusters)."""
