@@ -42,6 +42,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from chainermn_tpu.parallel.pipeline import (
     Pipeline, microbatch, pipeline_1f1b_grads)
 from chainermn_tpu.training.convert import concat_examples
+from chainermn_tpu.training.placement import owned_device_put
 
 AXIS_DATA = 'data'
 AXIS_STAGE = 'stage'
@@ -137,24 +138,50 @@ class PipelineUpdater:
         self.iteration = 0
 
         stage_sharding = NamedSharding(mesh, P(AXIS_STAGE))
-        self.params = jax.device_put(params_stacked, stage_sharding)
+        self.params = owned_device_put(params_stacked, stage_sharding,
+                                       donate)
         # optimizer state mirrors the stage-stacked params leafwise
         # (elementwise transformations update stacked leaves exactly as
         # they would per stage); scalar leaves (step counts) replicate
         opt_state0 = optimizer.init(params_stacked)
-        # per-leaf specs: stage-stacked leaves (mu/nu mirroring params)
-        # shard over the stage axis, scalar leaves (step counts)
-        # replicate -- shared by placement AND the 1f1b shard_map specs
-        opt_specs = jax.tree_util.tree_map(
-            lambda leaf: (P(AXIS_STAGE)
-                          if getattr(leaf, 'ndim', 0) >= 1
-                          and leaf.shape[0] == self.n_stages
-                          else P()),
-            opt_state0)
-        self.opt_state = jax.device_put(
+        # per-leaf specs: a state leaf is stage-stacked iff it is
+        # >=2-D with leading dim n_stages (params-shaped state --
+        # momentum/EMA under any key name -- AND per-stage factored
+        # state like adafactor row/col moments; every params leaf is
+        # >=2-D stacked except per-stage scalars) or it is a 1-D leaf
+        # that mirrors a (n_stages,) params leaf (stacked per-stage
+        # scalar) by keypath suffix.  Other 1-D length-n_stages
+        # vectors REPLICATE: a schedule/coefficient buffer sharded
+        # over stages would silently hand each stage a different
+        # scalar.  Shared by placement AND the 1f1b shard_map specs.
+        _p_sigs = [
+            (jax.tree_util.keystr(kp), getattr(v, 'shape', None))
+            for kp, v in jax.tree_util.tree_flatten_with_path(
+                params_stacked)[0]]
+
+        def _leaf_spec(kp, leaf):
+            shape = getattr(leaf, 'shape', None)
+            if shape is None:
+                return P()
+            if len(shape) >= 2 and shape[0] == self.n_stages:
+                return P(AXIS_STAGE)
+            if len(shape) == 1:
+                ks = jax.tree_util.keystr(kp)
+                if any(s == shape and ks.endswith(pk)
+                       for pk, s in _p_sigs):
+                    return P(AXIS_STAGE)
+            return P()
+
+        opt_specs = jax.tree_util.tree_map_with_path(
+            _leaf_spec, opt_state0)
+        # protect=params_stacked: opt_state0 is internal (aliasing
+        # within it is harmless), but state that embeds the caller's
+        # params (lookahead slow weights) must not be donated aliased
+        self.opt_state = owned_device_put(
             opt_state0,
             jax.tree_util.tree_map(
-                lambda spec: NamedSharding(mesh, spec), opt_specs))
+                lambda spec: NamedSharding(mesh, spec), opt_specs),
+            donate, protect=params_stacked)
 
         body = stage_fn if not remat else jax.checkpoint(stage_fn)
         pipe = Pipeline(body, self.n_stages, axis=AXIS_STAGE)
@@ -176,9 +203,21 @@ class PipelineUpdater:
         def device_loss(params, x, y):
             p_local = jax.tree_util.tree_map(lambda a: a[0], params)
             outs = pipe(p_local, microbatch(x, n_micro_))
-            loss, metrics = loss_on_last(outs, microbatch(y, n_micro_))
             stage = lax.axis_index(AXIS_STAGE)
             onlast = stage == n_stages - 1
+            # mask the ACTIVATIONS fed to the loss, not just the loss
+            # value: loss_fn on a non-last stage's raw activations can
+            # overflow to inf/NaN, and while the where on the loss
+            # below protects the forward psum, the where TRANSPOSE
+            # delivers a zero cotangent that still multiplies the
+            # loss_fn jacobian in the backward pass -- 0 * inf = NaN
+            # in the non-last stage's parameter gradients.  Evaluating
+            # the loss at zeros keeps both directions finite.
+            outs_safe = jax.tree_util.tree_map(
+                lambda o: jnp.where(onlast, o, jnp.zeros_like(o)),
+                outs)
+            loss, metrics = loss_on_last(outs_safe,
+                                         microbatch(y, n_micro_))
             # garbage on non-last stages is masked with where, NOT
             # multiplication: the garbage loss can be inf/NaN (loss_fn
             # on raw activations) and inf * 0 = NaN would poison the
@@ -231,7 +270,23 @@ class PipelineUpdater:
             grads = lax.pmean(grads, AXIS_DATA)
             updates, s_local = optimizer.update(grads, s_local,
                                                 p_local)
-            p_local = optax.apply_updates(p_local, updates)
+            new_p = optax.apply_updates(p_local, updates)
+            # trace-time guard: a mis-sharded optimizer-state leaf
+            # (e.g. a replicated vector broadcasting against
+            # stage-local scalars) corrupts param shapes silently --
+            # fail loudly instead
+            bad = [
+                (a.shape, b.shape) for a, b in zip(
+                    jax.tree_util.tree_leaves(p_local),
+                    jax.tree_util.tree_leaves(new_p))
+                if a.shape != b.shape]
+            if bad:
+                raise ValueError(
+                    'optimizer update changed param shapes %s -- an '
+                    'optimizer-state leaf is sharded inconsistently '
+                    'with the stage axis (see the opt_specs rule in '
+                    'PipelineUpdater.__init__)' % (bad,))
+            p_local = new_p
             onlast = lax.axis_index(AXIS_STAGE) == n_stages - 1
             loss = lax.pmean(
                 lax.psum(jnp.where(onlast, loss, 0.0), AXIS_STAGE),
@@ -276,8 +331,14 @@ class PipelineUpdater:
         self.iteration += 1
         return metrics
 
-    def update(self):
+    def update(self, sync=True):
+        """Advance one iteration.  Same protocol as
+        ``StandardUpdater.update``: ``sync=False`` returns the
+        device-resident metric arrays (no host round trip) for
+        ``Trainer(async_metrics=True)``."""
         metrics = self.update_core(self.shard_batch(next(self.iterator)))
+        if not sync:
+            return dict(metrics)
         return {k: float(v) for k, v in metrics.items()}
 
     def evaluate(self, arrays):

@@ -1,0 +1,69 @@
+"""Placement helper shared by the updaters.
+
+One donation hazard, one fix, one place: ``jax.device_put`` can alias
+the caller's buffers -- not only when the sharding already matches
+(where it returns the input object itself) but also on sharding
+CHANGES that reuse an input shard (e.g. single-device -> replicated
+keeps the source buffer as one replica; measured on this backend, and
+``may_alias=False`` does NOT force a copy there).  An updater that
+later donates its state into the jitted train step
+(``donate_argnums``) would then delete buffers the caller still
+references.
+
+The guard compares actual shard buffer pointers against the
+OUTSIDE-REFERENCED tree (``protect``) and copies exactly the leaves
+that alias it -- never freshly materialized ones, so init does not
+transiently double HBM.  For internally built trees (a fresh
+``optimizer.init`` result) pass the caller-visible tree as
+``protect``: aliasing within the internal tree itself is harmless
+(nobody else holds it), but optimizers that embed the params in their
+state (e.g. lookahead slow weights) still get caught.
+"""
+
+import jax
+
+
+def _buffer_keys(a):
+    """Set of (device, buffer pointer) for an array's local shards;
+    None when the backend cannot tell (treated as possibly-aliased)."""
+    try:
+        return {(sh.device, sh.data.unsafe_buffer_pointer())
+                for sh in a.addressable_shards}
+    except Exception:
+        return None
+
+
+def owned_device_put(tree, shardings, donate, protect=None):
+    """Place ``tree`` with ``shardings``; when ``donate`` the result
+    is guaranteed not to alias ``protect`` (default: ``tree`` itself,
+    i.e. the caller's own buffers) so it is safe to donate into a
+    jitted step."""
+    out = jax.device_put(tree, shardings)
+    if not donate:
+        return out
+
+    keys = set()
+    opaque = []  # protect leaves whose pointers are unreadable
+    for leaf in jax.tree_util.tree_leaves(
+            tree if protect is None else protect):
+        if isinstance(leaf, jax.Array):
+            k = _buffer_keys(leaf)
+            if k is None:
+                opaque.append(leaf)
+            else:
+                keys |= k
+
+    def guard(o):
+        if not isinstance(o, jax.Array):
+            return o
+        ok = _buffer_keys(o)
+        if ok is None:
+            # unreadable output: identity vs opaque protect leaves is
+            # the only signal left; alias risk otherwise unknowable,
+            # so copy (conservative, but scoped to this leaf only)
+            return o.copy()
+        if ok & keys or any(o is p for p in opaque):
+            return o.copy()
+        return o
+
+    return jax.tree_util.tree_map(guard, out)

@@ -79,21 +79,15 @@ class StandardUpdater:
         if accum_steps < 1:
             raise ValueError('accum_steps must be >= 1')
         self._accum_steps = accum_steps
-        def _owned(tree):
-            # device_put may alias caller buffers when the sharding
-            # already matches; with donation enabled the first step
-            # would then delete the caller's arrays.  Copy once.
-            if not donate:
-                return tree
-            return jax.tree_util.tree_map(
-                lambda x: x.copy() if isinstance(x, jax.Array) else x,
-                tree)
+        from chainermn_tpu.training.placement import owned_device_put
 
-        self.params = _owned(comm.replicate(params))
-        self.model_state = (_owned(comm.replicate(model_state))
+        # replicate + donation-aliasing guard in one placement: copies
+        # exactly the would-alias leaves (see placement.py)
+        _repl = NamedSharding(comm.mesh, P())
+        self.params = owned_device_put(params, _repl, donate)
+        self.model_state = (owned_device_put(model_state, _repl, donate)
                             if self._has_state else None)
         if zero:
-            from jax.sharding import NamedSharding
             from chainermn_tpu.multi_node_optimizer import (
                 MultiNodeOptimizerState)
             from chainermn_tpu.parallel import zero as zero_mod
@@ -111,9 +105,15 @@ class StandardUpdater:
             shardings = jax.tree_util.tree_map(
                 lambda spec: NamedSharding(comm.mesh, spec),
                 self._zero_specs)
-            self.opt_state = jax.device_put(stacked, shardings)
+            # protect=params: the state tree is internal, but state
+            # embedding the caller's params (lookahead) must not be
+            # donated aliased (see placement.py)
+            self.opt_state = owned_device_put(stacked, shardings,
+                                              donate, protect=params)
         else:
-            self.opt_state = comm.replicate(optimizer.init(params))
+            self.opt_state = owned_device_put(optimizer.init(params),
+                                              _repl, donate,
+                                              protect=params)
         self.iteration = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._step = self._build_step(donate)

@@ -169,6 +169,215 @@ def test_pipeline_updater_drives_trainer(tmp_path):
     assert log.log[-1]['loss'] < log.log[0]['loss'] * 1.2
 
 
+def test_gpipe_grads_finite_when_garbage_loss_overflows():
+    """Non-last stages evaluate the loss on raw intermediate
+    activations; when that overflows to inf the forward psum mask used
+    to be enough but the where TRANSPOSE still multiplied a zero
+    cotangent into an inf jacobian (0 * inf = NaN) and poisoned the
+    non-last stages' parameter gradients.  Regression: activations fed
+    to the loss are now masked too, so both directions stay finite."""
+    mesh = pipeline_mesh(N_STAGES)
+    x, _ = _data()
+    x = jnp.abs(x)  # positive inputs so early-stage outputs blow up
+    y = jnp.zeros((x.shape[0],), jnp.int32)
+
+    def lin_stage(p, xx):
+        return xx @ p['w']
+
+    # stages 0..2 amplify (exp(out) overflows to inf on their garbage
+    # loss); the LAST stage flips sign so the real loss is finite
+    eye = jnp.eye(DIM, dtype=jnp.float32)
+    params_list = [{'w': 8.0 * eye}, {'w': 8.0 * eye},
+                   {'w': 8.0 * eye}, {'w': -eye}]
+
+    def exp_loss(outs, y_micro):
+        return jnp.mean(jnp.exp(outs)), {}
+
+    upd = PipelineUpdater(iter([]), optax.sgd(0.1), lin_stage,
+                          exp_loss, stack_stage_params(params_list),
+                          mesh, n_micro=4, donate=False)
+    # sanity: the garbage really does overflow pre-mask
+    mid = x @ (8.0 * eye) @ (8.0 * eye)
+    assert not np.all(np.isfinite(np.asarray(jnp.exp(mid))))
+    metrics = upd.update_core(upd.shard_batch(
+        [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
+    assert np.isfinite(float(metrics['loss']))
+    new_stacked = jax.device_get(upd.params)
+    assert np.all(np.isfinite(new_stacked['w']))
+
+    def seq_loss(plist, xx):
+        h = xx
+        for p in plist:
+            h = lin_stage(p, h)
+        return jnp.mean(jnp.exp(h))
+
+    loss_seq, grads_seq = jax.value_and_grad(seq_loss)(params_list, x)
+    assert abs(float(metrics['loss']) - float(loss_seq)) < 1e-6
+    for s in range(N_STAGES):
+        np.testing.assert_allclose(
+            new_stacked['w'][s],
+            np.asarray(params_list[s]['w'] - 0.1 * grads_seq[s]['w']),
+            rtol=1e-5, atol=1e-7)
+
+
+def test_pipeline_updater_async_metrics(tmp_path):
+    """Trainer(async_metrics=True) calls update(sync=False);
+    PipelineUpdater must honor the same protocol as StandardUpdater
+    (regression: it used to take no ``sync`` parameter)."""
+    from chainermn_tpu import training
+    from chainermn_tpu.datasets.mnist import TupleDataset
+    from chainermn_tpu.training import extensions
+
+    mesh = pipeline_mesh(N_STAGES)
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, DIM).astype(np.float32)
+    ys = rng.randint(0, N_CLASSES, 64).astype(np.int32)
+    it = training.SerialIterator(TupleDataset(xs, ys), 32)
+    upd = PipelineUpdater(it, optax.adam(1e-2), stage_fn, loss_on_last,
+                          stack_stage_params(make_params(2)), mesh,
+                          n_micro=4)
+    # direct protocol check: device-resident metrics, no host floats
+    m = upd.update(sync=False)
+    assert all(isinstance(v, jax.Array) for v in m.values())
+    tr = training.Trainer(upd, (2, 'epoch'), out=str(tmp_path),
+                          async_metrics=True, sync_interval=2)
+    log = extensions.LogReport()
+    tr.extend(log)
+    tr.run()
+    assert np.isfinite(log.log[-1]['loss'])
+
+
+def test_1f1b_opt_state_vector_leaf_replicated():
+    """An optimizer-state leaf of shape (n_stages,) that does NOT
+    mirror the params must be REPLICATED, not sharded over the stage
+    axis (regression: a bare shape[0]==n_stages test sharded it, and
+    under 1f1b each stage then saw a different scalar half)."""
+    mesh = pipeline_mesh(N_STAGES)
+    params_list = make_params()
+    x, y = _data()
+    coeffs = jnp.linspace(0.5, 1.0, N_STAGES)  # (n_stages,) non-mirror
+
+    def scaled_sgd(lr):
+        def init(params):
+            return coeffs
+
+        def update(g, state, params=None):
+            # uses ONLY state[0]: correct (replicated) behavior scales
+            # every stage by coeffs[0]; the stage-sharded bug would
+            # scale stage s by coeffs[s]
+            return jax.tree_util.tree_map(
+                lambda gg: -lr * state[0] * gg, g), state
+
+        return optax.GradientTransformation(init, update)
+
+    upd = PipelineUpdater(iter([]), scaled_sgd(0.1), stage_fn,
+                          loss_on_last, stack_stage_params(params_list),
+                          mesh, n_micro=4, donate=False,
+                          schedule='1f1b')
+    upd.update_core(upd.shard_batch(
+        [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
+    _, grads_seq = jax.value_and_grad(sequential_loss)(
+        params_list, x, y)
+    new_stacked = jax.device_get(upd.params)
+    for s in range(N_STAGES):
+        np.testing.assert_allclose(
+            new_stacked['w'][s],
+            np.asarray(params_list[s]['w']
+                       - 0.1 * float(coeffs[0]) * grads_seq[s]['w']),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_1f1b_renamed_momentum_state_stage_sharded():
+    """Params-shaped optimizer state stored under RENAMED keys (not
+    optax's mirror-path mu/nu layout) must still be stage-sharded:
+    the spec rule matches full leaf shapes, not key paths."""
+    mesh = pipeline_mesh(N_STAGES)
+    params_list = make_params()
+    x, y = _data()
+
+    def renamed_momentum_sgd(lr, beta):
+        def init(params):
+            return {'mom_' + k: jnp.zeros_like(v)
+                    for k, v in params.items()}
+
+        def update(g, state, params=None):
+            new_state = {'mom_' + k: beta * state['mom_' + k] + g[k]
+                         for k in g}
+            u = {k: -lr * new_state['mom_' + k] for k in g}
+            return u, new_state
+
+        return optax.GradientTransformation(init, update)
+
+    upd = PipelineUpdater(iter([]), renamed_momentum_sgd(0.1, 0.9),
+                          stage_fn, loss_on_last,
+                          stack_stage_params(params_list), mesh,
+                          n_micro=4, donate=False, schedule='1f1b')
+    upd.update_core(upd.shard_batch(
+        [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
+    new_stacked = jax.device_get(upd.params)
+    # first momentum step == plain sgd step; params keep their shapes
+    assert new_stacked['w'].shape == (N_STAGES, DIM, DIM)
+    _, grads_seq = jax.value_and_grad(sequential_loss)(
+        params_list, x, y)
+    for s in range(N_STAGES):
+        np.testing.assert_allclose(
+            new_stacked['w'][s],
+            np.asarray(params_list[s]['w'] - 0.1 * grads_seq[s]['w']),
+            rtol=1e-5, atol=1e-6)
+
+
+def test_gpipe_factored_state_stage_sharded():
+    """Factored optimizer state (adafactor row/col moments) mirrors no
+    params leaf but IS per-stage: >=2-D leaves with leading dim
+    n_stages must be sharded over the stage axis, not replicated
+    n_stages-fold on every device."""
+    mesh = pipeline_mesh(N_STAGES)
+    upd = PipelineUpdater(iter([]), optax.adafactor(1e-3), stage_fn,
+                          loss_on_last,
+                          stack_stage_params(make_params()), mesh,
+                          n_micro=4, donate=False)
+    for leaf in jax.tree_util.tree_leaves(upd.opt_state):
+        if leaf.ndim >= 2 and leaf.shape[0] == N_STAGES:
+            assert leaf.sharding.spec[0] == 'stage', (
+                'factored per-stage state replicated: %s %s'
+                % (leaf.shape, leaf.sharding))
+    # and it still trains
+    x, y = _data()
+    m = upd.update_core(upd.shard_batch(
+        [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
+    assert np.isfinite(float(m['loss']))
+
+
+def test_donate_does_not_delete_caller_arrays():
+    """donate=True (the default) must not delete the CALLER's arrays
+    when params_stacked is already placed with the target sharding
+    (device_put aliases in that case; regression for the missing
+    _owned copy)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = pipeline_mesh(N_STAGES)
+    x, y = _data()
+    stacked = jax.device_put(
+        stack_stage_params(make_params()),
+        NamedSharding(mesh, P('stage')))
+    upd = PipelineUpdater(iter([]), optax.sgd(0.1), stage_fn,
+                          loss_on_last, stacked, mesh, n_micro=4)
+    batch = [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]
+    upd.update_core(upd.shard_batch(batch))
+    # the caller's tree is still alive and fetchable
+    got = jax.device_get(stacked)
+    assert np.all(np.isfinite(got['w']))
+    # uncommitted single-device tree: the sharding CHANGE can still
+    # reuse the source buffer as one shard (may_alias=False does not
+    # prevent this); the caller's tree must survive donation too
+    stacked2 = stack_stage_params(make_params(1))
+    upd2 = PipelineUpdater(iter([]), optax.sgd(0.1), stage_fn,
+                           loss_on_last, stacked2, mesh, n_micro=4)
+    upd2.update_core(upd2.shard_batch(batch))
+    got2 = jax.device_get(stacked2)
+    assert np.all(np.isfinite(got2['w']))
+
+
 def test_pipeline_training_converges():
     """Short pipelined training run drives the loss down on a
     learnable task (linearly separable clusters)."""
