@@ -105,7 +105,7 @@ def emit(result, rc=0):
     sys.exit(rc)
 
 
-def probe_backend(attempts=2, timeout=150, interval=10):
+def probe_backend(attempts=4, timeout=150, interval=60):
     """True if a subprocess can init the backend and run a tiny jit
     with a REAL device_get sync; otherwise the failure detail."""
     detail = ''

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Flash-attention microbench: Pallas kernel vs plain-XLA attention.
+
+Times the repo's fused blockwise attention (``chainermn_tpu.ops``)
+against the unfused jnp oracle (``mha_reference``: materializes the
+(T, T) score matrix and lets XLA fuse what it can) on the SAME chip,
+fwd and fwd+bwd, across sequence lengths -- and sweeps kernel block
+sizes at one config to pick the best.  This quantifies the custom
+hot-path the reference delegates to hand-written native code
+(``/root/reference/chainermn/nccl/nccl.pyx:153-199``); here the
+native analogue is the Mosaic-compiled kernel.
+
+Measurement follows ``bench.py``: the tunneled backend adds ~70ms
+RTT per dispatch and ``block_until_ready`` cannot be trusted, so each
+sample is a ``lax.scan`` chain of attention calls compiled into ONE
+program, synced by ``jax.device_get`` of a scalar slice, and the
+per-call time is the marginal cost between two chain lengths.
+
+Usage::
+
+    python benchmarks/flash_attention_bench.py            # real TPU
+    python benchmarks/flash_attention_bench.py --cpu      # plumbing
+    python benchmarks/flash_attention_bench.py --sweep    # + block sweep
+
+Writes JSONL to ``benchmarks/results/flash_attention_<platform>.jsonl``
+(one line per measurement) and prints a summary table.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def marginal_time(make_fn, k1, k2, reps=3):
+    import jax
+    fns = {k: make_fn(k) for k in (k1, k2)}
+    for k in (k1, k2):
+        jax.device_get(fns[k]())  # compile + warm
+    times = {}
+    for k in (k1, k2):
+        best = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.device_get(fns[k]())
+            best.append(time.perf_counter() - t0)
+        times[k] = min(best)
+    return max((times[k2] - times[k1]) / (k2 - k1), 1e-9)
+
+
+def attn_flops(b, t, h, d, causal, bwd):
+    # QK^T + PV: 2 * 2 * b*h*t*t*d MACs -> 4*b*h*t^2*d mul-adds
+    f = 4.0 * b * h * t * t * d * 2.0
+    if causal:
+        f *= 0.5
+    if bwd:
+        f *= 3.5  # fwd + recompute + dq/dk/dv passes
+    return f
+
+
+def bench_config(b, t, h, d, causal, dtype, use_pallas, bwd,
+                 block_q=128, block_k=128, quick=False):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from chainermn_tpu import ops
+    from chainermn_tpu.ops.flash_attention import mha_reference
+
+    key = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = (jax.random.normal(kq, (b, t, h, d), jnp.float32) * 0.5
+         ).astype(dtype)
+    k = (jax.random.normal(kk, (b, t, h, d), jnp.float32) * 0.5
+         ).astype(dtype)
+    v = (jax.random.normal(kv, (b, t, h, d), jnp.float32) * 0.5
+         ).astype(dtype)
+
+    if use_pallas:
+        def attn(qq):
+            return ops.flash_attention(qq, k, v, causal=causal,
+                                       block_q=block_q,
+                                       block_k=block_k)
+    else:
+        def attn(qq):
+            return mha_reference(qq, k, v, causal=causal)
+
+    if bwd:
+        def one(qq):
+            return jax.grad(
+                lambda z: (attn(z).astype(jnp.float32) ** 2).sum()
+            )(qq).astype(qq.dtype)
+    else:
+        def one(qq):
+            return attn(qq).astype(qq.dtype)
+
+    def make(n):
+        @jax.jit
+        def run():
+            def body(c, _):
+                # fold the output back into the carry so the chain is
+                # data-dependent (XLA cannot elide steps)
+                return one(c), ()
+            out, _ = lax.scan(body, q, None, length=n)
+            return out[0, 0, 0, :1].astype(jnp.float32)
+        return run
+
+    k1, k2 = (1, 3) if quick else (2, 6)
+    per = marginal_time(make, k1, k2)
+    return per
+
+
+def main():
+    argv = sys.argv[1:]
+    cpu = '--cpu' in argv
+    sweep = '--sweep' in argv
+    quick = '--quick' in argv or cpu
+    if cpu:
+        os.environ.setdefault(
+            'XLA_FLAGS', '--xla_force_host_platform_device_count=1')
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.default_backend()
+    here = os.path.dirname(os.path.abspath(__file__))
+    out_path = os.path.join(
+        here, 'results', 'flash_attention_%s.jsonl' % platform)
+    results = []
+
+    # CPU: tiny plumbing shapes (interpret-mode Pallas is slow);
+    # TPU: the real long-context sweep
+    if cpu:
+        configs = [(1, 256, 2, 64)]
+        seqs_note = 'cpu plumbing check'
+    else:
+        configs = [(4, 1024, 8, 64), (4, 2048, 8, 64),
+                   (2, 4096, 8, 64), (1, 8192, 8, 64)]
+        seqs_note = 'tpu'
+    dtype = jnp.float32 if cpu else jnp.bfloat16
+
+    for b, t, h, d in configs:
+        for causal in (False, True):
+            for bwd in (False, True):
+                row = {'b': b, 't': t, 'h': h, 'd': d,
+                       'causal': causal, 'bwd': bwd,
+                       'dtype': str(dtype.__name__),
+                       'platform': platform, 'note': seqs_note}
+                for name, use_pallas in (('pallas', True),
+                                         ('xla', False)):
+                    per = bench_config(b, t, h, d, causal, dtype,
+                                       use_pallas, bwd, quick=quick)
+                    row[name + '_ms'] = per * 1e3
+                    row[name + '_tflops'] = attn_flops(
+                        b, t, h, d, causal, bwd) / per / 1e12
+                row['speedup'] = row['xla_ms'] / row['pallas_ms']
+                results.append(row)
+                print(json.dumps(row), flush=True)
+
+    if sweep and not cpu:
+        b, t, h, d = 4, 2048, 8, 64
+        for bq in (128, 256, 512):
+            for bk in (128, 256, 512):
+                try:
+                    per = bench_config(b, t, h, d, True, dtype, True,
+                                       True, block_q=bq, block_k=bk,
+                                       quick=quick)
+                    row = {'sweep': True, 'block_q': bq, 'block_k': bk,
+                           'b': b, 't': t, 'h': h, 'd': d,
+                           'causal': True, 'bwd': True,
+                           'pallas_ms': per * 1e3,
+                           'platform': platform}
+                except Exception as e:  # Mosaic lowering limits
+                    row = {'sweep': True, 'block_q': bq, 'block_k': bk,
+                           'error': str(e)[-300:], 'platform': platform}
+                results.append(row)
+                print(json.dumps(row), flush=True)
+
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, 'w') as f:
+        for row in results:
+            f.write(json.dumps(row) + '\n')
+    print('wrote %s (%d rows)' % (out_path, len(results)))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,54 @@
+#!/bin/bash
+# One-shot TPU measurement series for an end-of-round artifact drop.
+#
+# Runs every chip-dependent benchmark exactly once, SERIALIZED (the
+# axon tunnel starves concurrent clients -- see
+# .claude/skills/verify/SKILL.md), with per-step timeouts so a hung
+# backend cannot wedge the whole series.  Results land in
+# benchmarks/results/ for commit; bench JSON lines are echoed.
+#
+# Usage: bash ci/run_tpu_round.sh [round_tag]    (default r3)
+set -u
+cd "$(dirname "$0")/.."
+TAG=${1:-r3}
+RES=benchmarks/results
+mkdir -p "$RES"
+
+run() {  # run <name> <timeout_s> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  echo "=== [$name] $*" >&2
+  timeout "$tmo" "$@" > "$RES/${name}_${TAG}.out" 2> "$RES/${name}_${TAG}.err"
+  local rc=$?
+  echo "=== [$name] rc=$rc" >&2
+  tail -2 "$RES/${name}_${TAG}.out" >&2 || true
+  return $rc
+}
+
+# 1. headline ResNet-50 (full measurement)
+run bench_resnet50 3000 python bench.py
+
+# 2. the other BASELINE workloads (quick scans: still marginal-timed
+#    on-chip, shorter chains)
+for m in vgg16 googlenetbn seq2seq transformer mlp; do
+  run "bench_${m}" 2400 python bench.py --model "$m" --quick
+done
+
+# 3. transformer numerics gate: Pallas kernels vs jnp oracle on-device
+run bench_transformer_check 2400 python bench.py --model transformer --quick --check
+
+# 4. flash-attention kernel vs XLA attention + block-size sweep
+run flash_attn 3000 python benchmarks/flash_attention_bench.py --sweep
+
+# 5. allreduce single-chip point (mesh=1; the scaling axis comes from
+#    the committed CPU-mesh run, this pins the real-chip datum)
+run allreduce_tpu 1200 python benchmarks/allreduce_scaling.py --devices 1 --steps 10
+
+# 6. Mosaic kernel gate (fast when compile cache is warm); conftest
+#    forces CPU unless told to keep the live platform
+run mosaic_gate 1200 env CHAINERMN_TPU_TEST_PLATFORM=axon \
+    python -m pytest tests/test_tpu_mosaic.py -v
+
+echo "=== series done; JSON lines:" >&2
+for f in "$RES"/bench_*_"$TAG".out; do
+  tail -1 "$f"
+done
