@@ -29,31 +29,14 @@ Writes JSONL to ``benchmarks/results/flash_attention_<platform>.jsonl``
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def marginal_time(make_fn, k1, k2, reps=3):
-    import jax
-    fns = {k: make_fn(k) for k in (k1, k2)}
-    for k in (k1, k2):
-        jax.device_get(fns[k]())  # compile + warm
-    times = {}
-    for k in (k1, k2):
-        best = []
-        for _ in range(reps):
-            t0 = time.perf_counter()
-            jax.device_get(fns[k]())
-            best.append(time.perf_counter() - t0)
-        times[k] = min(best)
-    return max((times[k2] - times[k1]) / (k2 - k1), 1e-9)
-
-
 def attn_flops(b, t, h, d, causal, bwd):
-    # QK^T + PV: 2 * 2 * b*h*t*t*d MACs -> 4*b*h*t^2*d mul-adds
-    f = 4.0 * b * h * t * t * d * 2.0
+    # QK^T + PV: each is t^2*d MACs = 2*t^2*d FLOPs per (batch, head)
+    f = 4.0 * b * h * t * t * d
     if causal:
         f *= 0.5
     if bwd:
@@ -108,8 +91,12 @@ def bench_config(b, t, h, d, causal, dtype, use_pallas, bwd,
             return out[0, 0, 0, :1].astype(jnp.float32)
         return run
 
+    # reuse bench.py's measurement primitive (same contract: make(k)
+    # returns a compiled thunk; marginal slope between two chain
+    # lengths, devget-synced)
+    from bench import marginal_time
     k1, k2 = (1, 3) if quick else (2, 6)
-    per = marginal_time(make, k1, k2)
+    per, _overhead, _times = marginal_time(make, k1, k2, reps=3)
     return per
 
 
@@ -130,7 +117,17 @@ def main():
     here = os.path.dirname(os.path.abspath(__file__))
     out_path = os.path.join(
         here, 'results', 'flash_attention_%s.jsonl' % platform)
-    results = []
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    out_file = open(out_path, 'w')
+    n_rows = 0
+
+    def record(row):
+        # append per row so a late failure keeps earlier measurements
+        nonlocal n_rows
+        out_file.write(json.dumps(row) + '\n')
+        out_file.flush()
+        n_rows += 1
+        print(json.dumps(row), flush=True)
 
     # CPU: tiny plumbing shapes (interpret-mode Pallas is slow);
     # TPU: the real long-context sweep
@@ -150,16 +147,19 @@ def main():
                        'causal': causal, 'bwd': bwd,
                        'dtype': str(dtype.__name__),
                        'platform': platform, 'note': seqs_note}
-                for name, use_pallas in (('pallas', True),
-                                         ('xla', False)):
-                    per = bench_config(b, t, h, d, causal, dtype,
-                                       use_pallas, bwd, quick=quick)
-                    row[name + '_ms'] = per * 1e3
-                    row[name + '_tflops'] = attn_flops(
-                        b, t, h, d, causal, bwd) / per / 1e12
-                row['speedup'] = row['xla_ms'] / row['pallas_ms']
-                results.append(row)
-                print(json.dumps(row), flush=True)
+                try:
+                    for name, use_pallas in (('pallas', True),
+                                             ('xla', False)):
+                        per = bench_config(b, t, h, d, causal, dtype,
+                                           use_pallas, bwd,
+                                           quick=quick)
+                        row[name + '_ms'] = per * 1e3
+                        row[name + '_tflops'] = attn_flops(
+                            b, t, h, d, causal, bwd) / per / 1e12
+                    row['speedup'] = row['xla_ms'] / row['pallas_ms']
+                except Exception as e:  # keep earlier rows (OOM etc.)
+                    row['error'] = str(e)[-300:]
+                record(row)
 
     if sweep and not cpu:
         b, t, h, d = 4, 2048, 8, 64
@@ -177,14 +177,10 @@ def main():
                 except Exception as e:  # Mosaic lowering limits
                     row = {'sweep': True, 'block_q': bq, 'block_k': bk,
                            'error': str(e)[-300:], 'platform': platform}
-                results.append(row)
-                print(json.dumps(row), flush=True)
+                record(row)
 
-    os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    with open(out_path, 'w') as f:
-        for row in results:
-            f.write(json.dumps(row) + '\n')
-    print('wrote %s (%d rows)' % (out_path, len(results)))
+    out_file.close()
+    print('wrote %s (%d rows)' % (out_path, n_rows))
 
 
 if __name__ == '__main__':

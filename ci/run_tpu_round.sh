@@ -24,8 +24,9 @@ run() {  # run <name> <timeout_s> <cmd...>
   return $rc
 }
 
-# 1. headline ResNet-50 (full measurement)
-run bench_resnet50 3000 python bench.py
+# 1. headline ResNet-50 (full measurement; budget covers the probe's
+#    worst case ~780s plus the 2400s measurement child)
+run bench_resnet50 3600 python bench.py
 
 # 2. the other BASELINE workloads (quick scans: still marginal-timed
 #    on-chip, shorter chains)
