@@ -118,11 +118,15 @@ def main():
     out_path = os.path.join(
         here, 'results', 'flash_attention_%s.jsonl' % platform)
     os.makedirs(os.path.dirname(out_path), exist_ok=True)
-    out_file = open(out_path, 'w')
+    # write rows to a temp file, renamed into place at the end AND on
+    # any partial failure with >=1 row -- an aborted run neither
+    # truncates the previously committed results nor loses what it
+    # measured
+    tmp_path = out_path + '.tmp'
+    out_file = open(tmp_path, 'w')
     n_rows = 0
 
     def record(row):
-        # append per row so a late failure keeps earlier measurements
         nonlocal n_rows
         out_file.write(json.dumps(row) + '\n')
         out_file.flush()
@@ -140,6 +144,23 @@ def main():
         seqs_note = 'tpu'
     dtype = jnp.float32 if cpu else jnp.bfloat16
 
+    try:
+        _run_all(configs, seqs_note, dtype, cpu, sweep, quick,
+                 platform, record)
+    finally:
+        out_file.close()
+        if n_rows:
+            os.replace(tmp_path, out_path)
+            print('wrote %s (%d rows)' % (out_path, n_rows))
+        else:
+            try:
+                os.unlink(tmp_path)
+            except OSError:
+                pass
+
+
+def _run_all(configs, seqs_note, dtype, cpu, sweep, quick, platform,
+             record):
     for b, t, h, d in configs:
         for causal in (False, True):
             for bwd in (False, True):
@@ -178,9 +199,6 @@ def main():
                     row = {'sweep': True, 'block_q': bq, 'block_k': bk,
                            'error': str(e)[-300:], 'platform': platform}
                 record(row)
-
-    out_file.close()
-    print('wrote %s (%d rows)' % (out_path, n_rows))
 
 
 if __name__ == '__main__':

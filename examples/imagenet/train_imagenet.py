@@ -54,6 +54,14 @@ def main():
     parser.add_argument('--cpu', action='store_true')
     parser.add_argument('--mesh', default=None)
     parser.add_argument('--quick', action='store_true')
+    parser.add_argument('--allreduce-dtype', default=None,
+                        help='cast gradients to this dtype for the '
+                             'collective (e.g. bfloat16): halves '
+                             'bytes on the wire')
+    parser.add_argument('--double-buffering', action='store_true',
+                        help='apply the previous step\'s reduced '
+                             'gradients so the collective overlaps '
+                             'the step tail (staleness-1 updates)')
     parser.add_argument('--dtype', default='bfloat16',
                         choices=['bfloat16', 'float32'])
     args = parser.parse_args()
@@ -138,7 +146,9 @@ def main():
         warmup_epochs=min(5, args.epoch),
         total_epochs=max(args.epoch, 1))
     optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.sgd(lr, momentum=0.9), comm)
+        optax.sgd(lr, momentum=0.9), comm,
+        allreduce_dtype=args.allreduce_dtype,
+        double_buffering=args.double_buffering)
 
     updater = training.StandardUpdater(
         train_iter, optimizer, clf.loss, params, comm,
