@@ -144,14 +144,22 @@ def main():
         seqs_note = 'tpu'
     dtype = jnp.float32 if cpu else jnp.bfloat16
 
+    done = False
     try:
         _run_all(configs, seqs_note, dtype, cpu, sweep, quick,
                  platform, record)
+        done = True
     finally:
         out_file.close()
-        if n_rows:
+        if done:
             os.replace(tmp_path, out_path)
             print('wrote %s (%d rows)' % (out_path, n_rows))
+        elif n_rows:
+            # keep what was measured WITHOUT clobbering a previously
+            # complete results file
+            os.replace(tmp_path, out_path + '.partial')
+            print('aborted; kept %d rows in %s.partial'
+                  % (n_rows, out_path))
         else:
             try:
                 os.unlink(tmp_path)

@@ -28,6 +28,7 @@ class TransformerBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     sequence_axis: Optional[str] = None
     dropout: float = 0.0
+    sp_scheme: str = 'ring'  # 'ring' | 'ulysses' (see parallel.sequence)
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -41,10 +42,16 @@ class TransformerBlock(nn.Module):
                               dtype=self.dtype, name='qkv')(h)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         if self.sequence_axis is not None:
-            # sequence dim sharded over the mesh axis: ring attention
-            from chainermn_tpu.parallel import ring_attention
-            attn = ring_attention(q, k, v, self.sequence_axis,
-                                  causal=True)
+            # sequence dim sharded over the mesh axis
+            from chainermn_tpu.parallel import (ring_attention,
+                                                ulysses_attention)
+            if self.sp_scheme not in ('ring', 'ulysses'):
+                raise ValueError(
+                    "sp_scheme must be 'ring' or 'ulysses', got %r"
+                    % (self.sp_scheme,))
+            sp = (ulysses_attention if self.sp_scheme == 'ulysses'
+                  else ring_attention)
+            attn = sp(q, k, v, self.sequence_axis, causal=True)
         else:
             attn = ops.flash_attention(q, k, v, causal=True)
         attn = attn.reshape(attn.shape[:2] + (self.d_model,))
@@ -80,6 +87,7 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     sequence_axis: Optional[str] = None
     dropout: float = 0.0
+    sp_scheme: str = 'ring'  # 'ring' | 'ulysses' (see parallel.sequence)
 
     @nn.compact
     def __call__(self, tokens, train=False):
@@ -98,8 +106,8 @@ class TransformerLM(nn.Module):
         for i in range(self.n_layers):
             x = TransformerBlock(
                 self.d_model, self.n_heads, self.d_ff, self.dtype,
-                self.sequence_axis, self.dropout, name=f'block_{i}')(
-                    x, train=train)
+                self.sequence_axis, self.dropout, self.sp_scheme,
+                name=f'block_{i}')(x, train=train)
         gf = self.param('lnf_scale', nn.initializers.ones,
                         (self.d_model,))
         bf = self.param('lnf_bias', nn.initializers.zeros,

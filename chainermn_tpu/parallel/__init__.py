@@ -10,8 +10,9 @@ TPU-native strategy set it points toward:
   ``train_mnist_model_parallel.py:66``)
 - :mod:`tensor` -- tensor (operator) parallelism: column/row-sharded
   matmuls with psum/all_gather on a mesh axis
-- :mod:`sequence` -- sequence/context parallelism: ring attention with
-  blockwise KV rotation (long-context first-class)
+- :mod:`sequence` -- sequence/context parallelism: ring attention
+  (blockwise KV rotation) and ulysses attention (all_to_all head
+  resharding); long-context first-class
 - :mod:`moe` -- expert parallelism: all_to_all token dispatch
 
 AUTODIFF CAVEAT: differentiate OUTSIDE ``shard_map`` when the mapped
@@ -28,6 +29,7 @@ pattern.  Purely local losses (data parallelism) are unaffected.
 from chainermn_tpu.parallel.pipeline import Pipeline  # noqa
 from chainermn_tpu.parallel.tensor import (  # noqa
     column_parallel_dense, row_parallel_dense, tp_mlp)
-from chainermn_tpu.parallel.sequence import ring_attention  # noqa
+from chainermn_tpu.parallel.sequence import (  # noqa
+    ring_attention, ulysses_attention)
 from chainermn_tpu.parallel.moe import MoELayer  # noqa
 from chainermn_tpu.parallel import zero  # noqa

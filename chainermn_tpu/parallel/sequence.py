@@ -1,13 +1,28 @@
-"""Sequence/context parallelism: ring attention.
+"""Sequence/context parallelism: ring attention and all-to-all
+(Ulysses-style) attention.
 
 Long-context support the reference never had (SURVEY 5 lists it as the
-mesh-axis the design must leave room for; here it is first-class).
-The sequence is sharded over a mesh axis; each device holds a query
-block and rotates its key/value block around the ring with
-``ppermute``, accumulating attention in the numerically stable
-flash/blockwise form (running max + rescaled numerator/denominator).
-Communication overlaps compute chunk-by-chunk and peak memory is
-O(T_local^2 / ring) instead of O(T^2).
+mesh-axis the design must leave room for; here it is first-class), in
+the two standard schemes:
+
+- :func:`ring_attention`: the sequence stays sharded; each device
+  rotates its key/value block around the ring with ``ppermute``,
+  accumulating attention in the numerically stable flash/blockwise
+  form (running max + rescaled numerator/denominator).  ``axis_size``
+  communication rounds that overlap compute chunk-by-chunk; peak
+  memory O(T_local^2) score blocks.  Head count unconstrained.
+
+- :func:`ulysses_attention`: two ``all_to_all`` reshardings swap the
+  sharded dimension (sequence <-> heads) so each device runs PLAIN
+  full-sequence attention on its head group -- which means the fused
+  Pallas flash kernel applies unchanged.  Communication is two
+  collectives regardless of axis size; requires
+  ``n_heads % axis_size == 0``.
+
+Rule of thumb: ulysses while heads divide evenly (better
+collective/compute overlap profile on ICI), ring when the head count
+is the constraint or the sequence is too long for even one head
+group's full-length attention.
 """
 
 import jax.numpy as jnp
@@ -65,3 +80,41 @@ def ring_attention(q, k, v, axis, causal=False, scale=None):
         block, (k, v, m0, num0, den0), jnp.arange(n_ring))
     out = num / jnp.maximum(den[..., None], 1e-30)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, axis, causal=False, scale=None,
+                      attn_fn=None):
+    """All-to-all sequence parallelism inside ``shard_map``.
+
+    q, k, v: (B, T_local, H, D), sequence dim sharded over ``axis``
+    (size P).  An ``all_to_all`` reshards to (B, T, H/P, D) -- full
+    sequence, local head group -- where plain attention runs (the
+    fused Pallas kernel by default, so causal masking needs no
+    position offsets), and a second ``all_to_all`` reshards the
+    output back.  Mathematically identical to full softmax attention
+    over the global sequence; both collectives are differentiable
+    (their transposes are the reverse resharding).
+
+    ``attn_fn(q, k, v, causal=..., scale=...)``: override the inner
+    attention (must accept (B, T, H/P, D), honor ``causal``/``scale``,
+    and return the same shape).
+    """
+    p = lax.axis_size(axis)
+    h = q.shape[2]
+    if h % p:
+        raise ValueError(
+            'ulysses_attention needs n_heads %% axis_size == 0, got '
+            '%d heads over %d devices (use ring_attention instead)'
+            % (h, p))
+
+    def to_heads(x):
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    if attn_fn is None:
+        from chainermn_tpu import ops
+        attn_fn = ops.flash_attention
+    out = attn_fn(qh, kh, vh, causal=causal, scale=scale)
+    return lax.all_to_all(out, axis, split_axis=1, concat_axis=2,
+                          tiled=True)

@@ -8,6 +8,7 @@ import pytest
 from jax.sharding import PartitionSpec as P
 
 from chainermn_tpu.parallel import (MoELayer, Pipeline, ring_attention,
+                                    ulysses_attention,
                                     tp_mlp)
 from chainermn_tpu.parallel.pipeline import microbatch, stack_stage_params
 
@@ -378,3 +379,76 @@ def test_moe_sort_dispatch_matches_dense():
     np.testing.assert_allclose(np.asarray(run(sort_dispatch)),
                                np.asarray(run(dense_dispatch_reference)),
                                atol=1e-5)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_ulysses_attention_matches_dense(causal):
+    """All-to-all sequence parallelism == dense oracle: sequence
+    sharded over 8 devices, 8 heads resharded to 1 per device."""
+    mesh = _mesh((8,), ('sp',))
+    b, t, h, d = 2, 32, 8, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+
+    def f(q, k, v):
+        return ulysses_attention(q, k, v, 'sp', causal=causal)
+
+    out = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(None, 'sp'),) * 3,
+        out_specs=P(None, 'sp'), check_vma=False))(q, k, v)
+
+    scale = d ** -0.5
+    scores = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+    if causal:
+        mask = np.tril(np.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    ref = jnp.einsum('bhqk,bkhd->bqhd', probs, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ulysses_attention_grads_match_dense():
+    mesh = _mesh((8,), ('sp',))
+    b, t, h, d = 1, 16, 8, 8
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    k = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+    v = jnp.asarray(rng.randn(b, t, h, d), jnp.float32)
+
+    def loss(q, k, v):
+        def f(q, k, v):
+            out = ulysses_attention(q, k, v, 'sp', causal=True)
+            return jax.lax.psum(jnp.sum(out ** 2), 'sp')
+        return jax.shard_map(f, mesh=mesh,
+                             in_specs=(P(None, 'sp'),) * 3,
+                             out_specs=P(), check_vma=False)(q, k, v)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    def dense_loss(q, k, v):
+        scale = d ** -0.5
+        scores = jnp.einsum('bqhd,bkhd->bhqk', q, k) * scale
+        mask = np.tril(np.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum('bhqk,bkhd->bqhd', probs, v)
+        return jnp.sum(out ** 2)
+
+    g_ref = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for a, r in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = _mesh((8,), ('sp',))
+    b, t, h, d = 1, 16, 6, 8  # 6 heads over 8 devices
+    x = jnp.zeros((b, t, h, d), jnp.float32)
+    with pytest.raises(ValueError, match='ring_attention instead'):
+        jax.jit(jax.shard_map(
+            lambda q, k, v: ulysses_attention(q, k, v, 'sp'),
+            mesh=mesh, in_specs=(P(None, 'sp'),) * 3,
+            out_specs=P(None, 'sp'), check_vma=False))(x, x, x)

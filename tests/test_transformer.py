@@ -18,10 +18,11 @@ from jax.sharding import Mesh, PartitionSpec as P
 from chainermn_tpu.models import TransformerLM, lm_loss
 
 
-def _tiny(seq_axis=None):
+def _tiny(seq_axis=None, sp_scheme='ring'):
     return TransformerLM(vocab_size=64, d_model=32, n_heads=2,
                          n_layers=2, d_ff=64, max_len=128,
-                         dtype=jnp.float32, sequence_axis=seq_axis)
+                         dtype=jnp.float32, sequence_axis=seq_axis,
+                         sp_scheme=sp_scheme)
 
 
 @pytest.fixture(scope='module')
@@ -122,3 +123,22 @@ class TestSequenceParallel:
         p2, _, loss2 = sharded(p1, s1, tokens, targets)
         assert np.isfinite(float(loss1)) and np.isfinite(float(loss2))
         assert float(loss2) < float(loss1)
+
+
+def test_ulysses_matches_single_device():
+    """sp_scheme='ulysses' (all_to_all head resharding) must also
+    reproduce the unsharded model: 2 heads over 2 devices."""
+    model = _tiny()
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 32), 0, 64)
+    params = model.init(jax.random.PRNGKey(1), tokens)['params']
+    ref = model.apply({'params': params}, tokens)
+
+    n_sp = 2
+    sp_model = _tiny(seq_axis='sp', sp_scheme='ulysses')
+    mesh = Mesh(np.array(jax.devices()[:n_sp]), ('sp',))
+    out = jax.jit(jax.shard_map(
+        lambda p, t: sp_model.apply({'params': p}, t),
+        mesh=mesh, in_specs=(P(), P(None, 'sp')),
+        out_specs=P(None, 'sp', None), check_vma=False))(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
