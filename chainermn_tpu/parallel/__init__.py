@@ -17,7 +17,8 @@ TPU-native strategy set it points toward:
 
 AUTODIFF CAVEAT: differentiate OUTSIDE ``shard_map`` when the mapped
 computation's value crosses devices (pipeline ``ppermute``, ring
-attention rotation, MoE ``all_to_all``): with ``check_vma=False``,
+attention rotation, ulysses/MoE ``all_to_all``): with
+``check_vma=False``,
 ``jax.grad`` *inside* shard_map mis-transposes cross-device dataflow
 (the replication-tracking rewrite behind correct collective transposes
 is off) and the error is large, not roundoff.  Grad-of-the-mapped-
