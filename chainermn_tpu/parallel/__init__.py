@@ -31,6 +31,6 @@ from chainermn_tpu.parallel.pipeline import Pipeline  # noqa
 from chainermn_tpu.parallel.tensor import (  # noqa
     column_parallel_dense, row_parallel_dense, tp_mlp)
 from chainermn_tpu.parallel.sequence import (  # noqa
-    ring_attention, ulysses_attention)
+    mapped_global_loss, ring_attention, ulysses_attention)
 from chainermn_tpu.parallel.moe import MoELayer  # noqa
 from chainermn_tpu.parallel import zero  # noqa

@@ -82,6 +82,39 @@ def ring_attention(q, k, v, axis, causal=False, scale=None):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def mapped_global_loss(loss_fn, mesh, batch_spec, axes=None):
+    """The canonical sequence-parallel training-loss wrapper.
+
+    Returns ``mapped(params, *batch) -> scalar``: ``loss_fn(params,
+    *batch) -> (loss, aux)`` evaluated per shard inside ``shard_map``
+    (params replicated, every batch array sharded with
+    ``batch_spec``), with the per-shard mean losses ``pmean``'d over
+    ``axes`` (default: all mesh axes) into the global mean.  ``aux``
+    is discarded.
+
+    Differentiate the RESULT with ``jax.grad`` -- outside the
+    ``shard_map`` -- per the package AUTODIFF CAVEAT: taking the grad
+    inside mis-transposes the attention collectives
+    (ring ``ppermute`` / ulysses ``all_to_all``).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    if axes is None:
+        axes = tuple(mesh.axis_names)
+
+    def mapped(params, *batch):
+        def f(p, *b):
+            loss, _aux = loss_fn(p, *b)
+            return lax.pmean(loss, axes)
+        return jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(),) + (batch_spec,) * len(batch),
+            out_specs=P(), check_vma=False)(params, *batch)
+
+    return mapped
+
+
 def ulysses_attention(q, k, v, axis, causal=False, scale=None,
                       attn_fn=None):
     """All-to-all sequence parallelism inside ``shard_map``.

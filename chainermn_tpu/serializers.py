@@ -80,32 +80,36 @@ def load_npz(path, template):
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
-def resume_updater(path, updater, comm):
+def resume_updater(path, updater, comm=None):
     """Restore a snapshot written by ``extensions.snapshot()`` into a
     live updater: params, optimizer state, BatchNorm/model state, and
     the iteration/epoch counters (so stop triggers and log filenames
-    continue rather than restart)."""
+    continue rather than restart).
+
+    Every restored leaf is placed with the LIVE updater leaf's own
+    sharding, so whatever layout the updater established at
+    construction is preserved: replicated (``StandardUpdater``),
+    mesh-sharded optimizer state (``zero=True``), stage-sharded
+    pipeline params (``PipelineUpdater``).  The loaded host arrays
+    never alias device buffers, so donation stays safe.  ``comm`` is
+    accepted for backward compatibility and unused."""
     template = {'params': updater.params, 'opt_state': updater.opt_state,
                 'iteration': 0, 'epoch': 0}
     if getattr(updater, 'model_state', None) is not None:
         template['model_state'] = updater.model_state
     state = load_npz(path, template)
-    updater.params = comm.replicate(state['params'])
-    if getattr(updater, '_zero', False):
-        # restore the ZeRO layout: stacked state goes back sharded
-        # over the mesh, not replicated (replication would cost the
-        # N-times memory the sharding exists to avoid)
-        import jax
-        from jax.sharding import NamedSharding
-        shardings = jax.tree_util.tree_map(
-            lambda spec: NamedSharding(comm.mesh, spec),
-            updater._zero_specs)
-        updater.opt_state = jax.device_put(state['opt_state'],
-                                           shardings)
-    else:
-        updater.opt_state = comm.replicate(state['opt_state'])
+
+    def place(new_tree, cur_tree):
+        return jax.tree_util.tree_map(
+            lambda new, cur: (jax.device_put(new, cur.sharding)
+                              if isinstance(cur, jax.Array) else new),
+            new_tree, cur_tree)
+
+    updater.params = place(state['params'], updater.params)
+    updater.opt_state = place(state['opt_state'], updater.opt_state)
     if 'model_state' in template:
-        updater.model_state = comm.replicate(state['model_state'])
+        updater.model_state = place(state['model_state'],
+                                    updater.model_state)
     updater.iteration = int(state['iteration'])
     it = updater.iterator
     if hasattr(it, 'restore_epoch'):

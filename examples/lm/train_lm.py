@@ -59,6 +59,9 @@ def main():
     p.add_argument('--mesh', default=None,
                    help='DPxSP, e.g. 2x4 (default: all devices on sp '
                         'when >1, else single device)')
+    p.add_argument('--tokens', default=None,
+                   help='token-id corpus as a 1-D int .npy file '
+                        '(default: synthetic Markov text)')
     p.add_argument('--lr', type=float, default=3e-4)
     p.add_argument('--cpu', action='store_true',
                    help='8 virtual CPU devices')
@@ -90,7 +93,8 @@ def main():
         raise SystemExit('mesh %dx%d needs %d devices, have %d'
                          % (dp, sp, n_dev, len(devices)))
     if args.batchsize % dp or args.seq_len % sp:
-        raise SystemExit('batch must divide dp and seq-len divide sp')
+        raise SystemExit('dp must divide the batch size and sp must '
+                         'divide the sequence length')
     mesh = Mesh(np.asarray(devices[:n_dev]).reshape(dp, sp),
                 ('dp', 'sp'))
     print('mesh: dp=%d x sp=%d  scheme=%s  T=%d'
@@ -104,15 +108,24 @@ def main():
         sp_scheme=args.sp_scheme)
 
     rng = np.random.RandomState(0)
-    corpus = synthetic_tokens(
-        args.batchsize * (args.seq_len + 1) * 8, args.vocab, rng)
+    if args.tokens:
+        corpus = np.load(args.tokens).astype(np.int32).ravel()
+        if corpus.max() >= args.vocab:
+            raise SystemExit('--tokens ids exceed --vocab %d'
+                             % args.vocab)
+        need = args.batchsize * (args.seq_len + 1) + 1
+        if len(corpus) < need:
+            raise SystemExit('--tokens corpus too short: %d < %d'
+                             % (len(corpus), need))
+    else:
+        corpus = synthetic_tokens(
+            args.batchsize * (args.seq_len + 1) * 8, args.vocab, rng)
 
     def sample_batch(step):
         i = (step * args.batchsize * args.seq_len) % (
             len(corpus) - args.batchsize * (args.seq_len + 1))
         window = corpus[i:i + args.batchsize * (args.seq_len + 1)]
-        window = window[:args.batchsize * (args.seq_len + 1)].reshape(
-            args.batchsize, args.seq_len + 1)
+        window = window.reshape(args.batchsize, args.seq_len + 1)
         return window[:, :-1], window[:, 1:]
 
     # init with the axis-free twin: identical param structure, no mesh
@@ -127,18 +140,11 @@ def main():
     opt = optax.adamw(args.lr, weight_decay=0.01)
     opt_state = opt.init(params)
 
-    # differentiate OUTSIDE the shard_map: taking the grad inside
-    # mis-transposes the attention collectives (see the AUTODIFF
-    # CAVEAT in chainermn_tpu/parallel/__init__.py); the optimizer
-    # runs on the replicated tree under the same jit
-    def mapped_loss(params, tokens, targets):
-        def f(p, x, y):
-            loss, _ = loss_fn(p, x, y)
-            return jax.lax.pmean(loss, ('dp', 'sp'))
-        return jax.shard_map(
-            f, mesh=mesh,
-            in_specs=(P(), P('dp', 'sp'), P('dp', 'sp')),
-            out_specs=P(), check_vma=False)(params, tokens, targets)
+    # the canonical SP loss wrapper: shard_mapped global mean,
+    # differentiated from OUTSIDE (see its docstring / the package
+    # AUTODIFF CAVEAT); the optimizer runs on the replicated tree
+    from chainermn_tpu.parallel import mapped_global_loss
+    mapped_loss = mapped_global_loss(loss_fn, mesh, P('dp', 'sp'))
 
     def step(params, opt_state, tokens, targets):
         loss, grads = jax.value_and_grad(mapped_loss)(

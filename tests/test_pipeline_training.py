@@ -378,6 +378,46 @@ def test_donate_does_not_delete_caller_arrays():
     assert np.all(np.isfinite(got2['w']))
 
 
+def test_pipeline_snapshot_resume(tmp_path):
+    """snapshot/resume round-trip preserves the PipelineUpdater's
+    stage-sharded layout: params restored with P('stage'), training
+    continues bit-identically with the pre-snapshot trajectory."""
+    from chainermn_tpu import serializers
+
+    mesh = pipeline_mesh(N_STAGES)
+    x, y = _data()
+    batch = [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]
+
+    def make_updater():
+        return PipelineUpdater(
+            iter([]), optax.adam(1e-2), stage_fn, loss_on_last,
+            stack_stage_params(make_params()), mesh, n_micro=4,
+            donate=False)
+
+    upd = make_updater()
+    for _ in range(2):
+        upd.update_core(upd.shard_batch(batch))
+    path = str(tmp_path / 'snap')
+    serializers.save_npz(path, {
+        'params': upd.params, 'opt_state': upd.opt_state,
+        'iteration': upd.iteration, 'epoch': 0})
+    upd.update_core(upd.shard_batch(batch))
+    expect = jax.device_get(upd.params)
+
+    fresh = make_updater()
+    serializers.resume_updater(path, fresh)
+    assert fresh.iteration == 2
+    # layout preserved: stage-sharded, not replicated
+    leaf = jax.tree_util.tree_leaves(fresh.params)[0]
+    assert leaf.sharding.spec[0] == 'stage', leaf.sharding
+    fresh.update_core(fresh.shard_batch(batch))
+    got = jax.device_get(fresh.params)
+    np.testing.assert_allclose(got['w'], expect['w'],
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(got['b'], expect['b'],
+                               rtol=1e-6, atol=1e-7)
+
+
 def test_pipeline_training_converges():
     """Short pipelined training run drives the loss down on a
     learnable task (linearly separable clusters)."""

@@ -108,17 +108,8 @@ class TestSequenceParallel:
         loss_fn = lm_loss(
             lambda p, t: sp_model.apply({'params': p}, t))
 
-        def mapped_loss(params, tokens, targets):
-            def f(p, x, y):
-                loss, _ = loss_fn(p, x, y)
-                # per-shard token means are equal-weight: pmean is the
-                # global mean
-                return jax.lax.pmean(loss, 'sp')
-            return jax.shard_map(
-                f, mesh=mesh,
-                in_specs=(P(), P(None, 'sp'), P(None, 'sp')),
-                out_specs=P(), check_vma=False)(params, tokens,
-                                                targets)
+        from chainermn_tpu.parallel import mapped_global_loss
+        mapped_loss = mapped_global_loss(loss_fn, mesh, P(None, 'sp'))
 
         # first-step gradient equivalence vs the unsharded model --
         # this is the check that catches grad-inside-shard_map
