@@ -97,6 +97,9 @@ def resume_updater(path, updater, comm=None):
                 'iteration': 0, 'epoch': 0}
     if getattr(updater, 'model_state', None) is not None:
         template['model_state'] = updater.model_state
+    if getattr(updater, 'extra', None) is not None:
+        # PipelineUpdater's replicated prologue/epilogue params
+        template['extra'] = updater.extra
     state = load_npz(path, template)
 
     def place(new_tree, cur_tree):
@@ -110,6 +113,8 @@ def resume_updater(path, updater, comm=None):
     if 'model_state' in template:
         updater.model_state = place(state['model_state'],
                                     updater.model_state)
+    if 'extra' in template:
+        updater.extra = place(state['extra'], updater.extra)
     updater.iteration = int(state['iteration'])
     it = updater.iterator
     if hasattr(it, 'restore_epoch'):

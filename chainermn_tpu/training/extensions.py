@@ -148,6 +148,9 @@ def snapshot(filename='snapshot_iter_{iteration}', rank0_only=True):
         }
         if getattr(u, 'model_state', None) is not None:
             state['model_state'] = u.model_state
+        if getattr(u, 'extra', None) is not None:
+            # PipelineUpdater's replicated prologue/epilogue params
+            state['extra'] = u.extra
         serializers.save_npz(path, state)
     ext.trigger = (1, 'epoch')
     ext.priority = 50

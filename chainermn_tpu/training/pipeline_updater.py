@@ -104,13 +104,34 @@ class PipelineUpdater:
         ``schedule_check=False`` bypasses it.
       schedule_check: verify the optimizer is elementwise when
         ``schedule='1f1b'`` (see above).
+      prologue: ``prologue(extra_params, x) -> activations``, run
+        replicated on the full local batch BEFORE micro-batching
+        (embedding/positional lookup); its output feeds stage 0.
+        Requires ``extra_params``.
+      extra_params: replicated parameter pytree for the heterogeneous
+        ends of a real model (embedding table, final norm, head),
+        trained jointly with the stage-stacked body; ``loss_on_last``
+        then takes ``(extra, outputs, y_micro)``.  gpipe schedule
+        only (1f1b discards the stage-0 input cotangent the prologue
+        backward needs).
     """
 
     def __init__(self, iterator, optimizer, stage_fn, loss_on_last,
                  params_stacked, mesh, n_micro, remat=False,
-                 donate=True, schedule='gpipe', schedule_check=True):
+                 donate=True, schedule='gpipe', schedule_check=True,
+                 prologue=None, extra_params=None):
         if schedule not in ('gpipe', '1f1b'):
             raise ValueError("schedule must be 'gpipe' or '1f1b'")
+        extra_used = extra_params is not None
+        if extra_used and schedule == '1f1b':
+            raise ValueError(
+                "extra_params/prologue require schedule='gpipe': the "
+                "1f1b schedule hand-propagates cotangents per stage "
+                'and discards the stage-0 input cotangent the '
+                'prologue backward needs')
+        if prologue is not None and not extra_used:
+            raise ValueError('prologue requires extra_params (pass an '
+                             'empty dict if it is parameter-free)')
         if schedule == '1f1b':
             if remat:
                 raise ValueError(
@@ -140,10 +161,18 @@ class PipelineUpdater:
         stage_sharding = NamedSharding(mesh, P(AXIS_STAGE))
         self.params = owned_device_put(params_stacked, stage_sharding,
                                        donate)
+        # heterogeneous ends: replicated prologue/epilogue parameters
+        # (embedding table, head, final norm) trained alongside the
+        # stage-stacked body
+        self.extra = (owned_device_put(
+            extra_params, NamedSharding(mesh, P()), donate)
+            if extra_used else None)
         # optimizer state mirrors the stage-stacked params leafwise
         # (elementwise transformations update stacked leaves exactly as
         # they would per stage); scalar leaves (step counts) replicate
-        opt_state0 = optimizer.init(params_stacked)
+        opt_tree0 = ({'stages': params_stacked, 'extra': extra_params}
+                     if extra_used else params_stacked)
+        opt_state0 = optimizer.init(opt_tree0)
         # per-leaf specs: a state leaf is stage-stacked iff it is
         # >=2-D with leading dim n_stages (params-shaped state --
         # momentum/EMA under any key name -- AND per-stage factored
@@ -160,13 +189,23 @@ class PipelineUpdater:
                 params_stacked)[0]]
 
         def _leaf_spec(kp, leaf):
+            ks = jax.tree_util.keystr(kp)
+            if extra_used:
+                # a leaf belongs to the replicated 'extra' branch iff
+                # "['extra']" is the FIRST of the two top-level branch
+                # keys on its path -- a bare substring test would
+                # false-positive on a BODY param key named 'extra'
+                # (path "...['stages']['extra']...")
+                si = ks.find("['stages']")
+                ei = ks.find("['extra']")
+                if ei != -1 and (si == -1 or ei < si):
+                    return P()  # replicated prologue/epilogue state
             shape = getattr(leaf, 'shape', None)
             if shape is None:
                 return P()
             if len(shape) >= 2 and shape[0] == self.n_stages:
                 return P(AXIS_STAGE)
             if len(shape) == 1:
-                ks = jax.tree_util.keystr(kp)
                 if any(s == shape and ks.endswith(pk)
                        for pk, s in _p_sigs):
                     return P(AXIS_STAGE)
@@ -174,14 +213,15 @@ class PipelineUpdater:
 
         opt_specs = jax.tree_util.tree_map_with_path(
             _leaf_spec, opt_state0)
-        # protect=params_stacked: opt_state0 is internal (aliasing
-        # within it is harmless), but state that embeds the caller's
-        # params (lookahead slow weights) must not be donated aliased
+        # protect=opt_tree0 (the caller's trees): opt_state0 is
+        # internal (aliasing within it is harmless), but state that
+        # embeds the caller's params (lookahead slow weights) must not
+        # be donated aliased
         self.opt_state = owned_device_put(
             opt_state0,
             jax.tree_util.tree_map(
                 lambda spec: NamedSharding(mesh, spec), opt_specs),
-            donate, protect=params_stacked)
+            donate, protect=opt_tree0)
 
         body = stage_fn if not remat else jax.checkpoint(stage_fn)
         pipe = Pipeline(body, self.n_stages, axis=AXIS_STAGE)
@@ -200,9 +240,10 @@ class PipelineUpdater:
         # how ``tests/test_parallel.py::test_pipeline_backward`` pins
         # the schedule's reverse pairing.
 
-        def device_loss(params, x, y):
+        def device_loss(params, extra, x, y):
             p_local = jax.tree_util.tree_map(lambda a: a[0], params)
-            outs = pipe(p_local, microbatch(x, n_micro_))
+            acts = prologue(extra, x) if prologue is not None else x
+            outs = pipe(p_local, microbatch(acts, n_micro_))
             stage = lax.axis_index(AXIS_STAGE)
             onlast = stage == n_stages - 1
             # mask the ACTIVATIONS fed to the loss, not just the loss
@@ -216,8 +257,11 @@ class PipelineUpdater:
             outs_safe = jax.tree_util.tree_map(
                 lambda o: jnp.where(onlast, o, jnp.zeros_like(o)),
                 outs)
-            loss, metrics = loss_on_last(outs_safe,
-                                         microbatch(y, n_micro_))
+            y_micro = microbatch(y, n_micro_)
+            if extra_used:
+                loss, metrics = loss_on_last(extra, outs_safe, y_micro)
+            else:
+                loss, metrics = loss_on_last(outs_safe, y_micro)
             # garbage on non-last stages is masked with where, NOT
             # multiplication: the garbage loss can be inf/NaN (loss_fn
             # on raw activations) and inf * 0 = NaN would poison the
@@ -232,19 +276,31 @@ class PipelineUpdater:
                     AXIS_DATA), metrics)
             return loss, metrics
 
-        def mapped_loss(params, x, y):
+        def mapped_loss(params, extra, x, y):
             return jax.shard_map(
                 device_loss, mesh=mesh,
-                in_specs=(P(AXIS_STAGE), P(AXIS_DATA), P(AXIS_DATA)),
-                out_specs=(P(), P()), check_vma=False)(params, x, y)
+                in_specs=(P(AXIS_STAGE), P(), P(AXIS_DATA),
+                          P(AXIS_DATA)),
+                out_specs=(P(), P()), check_vma=False)(
+                    params, extra, x, y)
 
-        def train_step(params, opt_state, x, y):
+        def train_step(params, extra, opt_state, x, y):
             (loss, metrics), grads = jax.value_and_grad(
-                mapped_loss, has_aux=True)(params, x, y)
-            updates, opt_state = optimizer.update(grads, opt_state,
-                                                  params)
-            params = optax.apply_updates(params, updates)
-            return params, opt_state, dict(metrics, loss=loss)
+                mapped_loss, argnums=(0, 1), has_aux=True)(
+                    params, extra, x, y)
+            if extra_used:
+                tree = {'stages': params, 'extra': extra}
+                gtree = {'stages': grads[0], 'extra': grads[1]}
+            else:
+                tree, gtree = params, grads[0]
+            updates, opt_state = optimizer.update(gtree, opt_state,
+                                                  tree)
+            tree = optax.apply_updates(tree, updates)
+            if extra_used:
+                params, extra = tree['stages'], tree['extra']
+            else:
+                params = tree
+            return params, extra, opt_state, dict(metrics, loss=loss)
 
         # 1F1B: gradients are hand-propagated per stage inside the
         # shard_map (no autodiff through collectives, so the
@@ -301,22 +357,30 @@ class PipelineUpdater:
                 s_local, opt_specs)
             return p_out, s_out, dict(metrics, loss=loss)
 
-        def train_step_1f1b(params, opt_state, x, y):
-            return jax.shard_map(
+        def train_step_1f1b(params, extra, opt_state, x, y):
+            # extra is always None here (enforced above); threaded
+            # through for the uniform _step signature
+            p, s, metrics = jax.shard_map(
                 device_step_1f1b, mesh=mesh,
                 in_specs=(P(AXIS_STAGE), opt_specs,
                           P(AXIS_DATA), P(AXIS_DATA)),
                 out_specs=(P(AXIS_STAGE), opt_specs, P()),
                 check_vma=False)(params, opt_state, x, y)
+            return p, extra, s, metrics
 
-        kw = {'donate_argnums': (0, 1)} if donate else {}
+        if donate:
+            kw = {'donate_argnums': (0, 1, 2) if extra_used
+                  else (0, 2)}
+        else:
+            kw = {}
         self._step = jax.jit(
             train_step if schedule == 'gpipe' else train_step_1f1b,
             **kw)
         # forward-only path for evaluation: same pipeline schedule and
         # loss, NO gradient/optimizer (params not donated)
         self._eval = jax.jit(
-            lambda params, x, y: mapped_loss(params, x, y))
+            lambda params, extra, x, y: mapped_loss(params, extra,
+                                                    x, y))
 
     def shard_batch(self, batch):
         arrays = concat_examples(batch)
@@ -326,8 +390,8 @@ class PipelineUpdater:
         return tuple(jax.device_put(a, data_sharding) for a in arrays)
 
     def update_core(self, arrays):
-        self.params, self.opt_state, metrics = self._step(
-            self.params, self.opt_state, *arrays)
+        self.params, self.extra, self.opt_state, metrics = self._step(
+            self.params, self.extra, self.opt_state, *arrays)
         self.iteration += 1
         return metrics
 
@@ -346,7 +410,7 @@ class PipelineUpdater:
         pipeline schedule and the loss but neither gradients nor the
         optimizer -- use this for validation batches (a train step on
         eval data would fit the validation set)."""
-        loss, metrics = self._eval(self.params, *arrays)
+        loss, metrics = self._eval(self.params, self.extra, *arrays)
         return {k: float(v) for k, v in
                 dict(metrics, loss=loss).items()}
 

@@ -378,6 +378,86 @@ def test_donate_does_not_delete_caller_arrays():
     assert np.all(np.isfinite(got2['w']))
 
 
+def test_pipeline_heterogeneous_ends_match_sequential():
+    """prologue + extra_params: an embedding front and a head/loss
+    back with their own trained parameters, wrapped around the
+    stage-stacked body -- one pipelined step must equal one step of
+    the sequentially composed model (body grads AND end grads)."""
+    mesh = pipeline_mesh(N_STAGES)
+    params_list = make_params()
+    rng = np.random.RandomState(7)
+    d_in = 8
+    extra = {'We': jnp.asarray(rng.randn(d_in, DIM) * 0.4, jnp.float32),
+             'Wh': jnp.asarray(rng.randn(DIM, N_CLASSES) * 0.4,
+                               jnp.float32)}
+    x = jnp.asarray(rng.randn(32, d_in), jnp.float32)
+    y = jnp.asarray(rng.randint(0, N_CLASSES, 32), jnp.int32)
+
+    def prologue(e, xx):
+        return jnp.tanh(xx @ e['We'])
+
+    def loss_with_head(e, outs, y_micro):
+        logits = outs.reshape(-1, DIM) @ e['Wh']
+        yy = y_micro.reshape(-1)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yy).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == yy).astype(
+            jnp.float32))
+        return loss, {'accuracy': acc}
+
+    opt = optax.sgd(0.1, momentum=0.9)
+    upd = PipelineUpdater(iter([]), opt, stage_fn, loss_with_head,
+                          stack_stage_params(params_list), mesh,
+                          n_micro=4, donate=False, prologue=prologue,
+                          extra_params=extra)
+    metrics = upd.update_core(upd.shard_batch(
+        [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
+    loss_pipe = float(metrics['loss'])
+
+    def seq_loss(tree):
+        h = jnp.tanh(x @ tree['extra']['We'])
+        for p in tree['stages']:
+            h = stage_fn(p, h)
+        logits = h @ tree['extra']['Wh']
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y).mean()
+
+    tree0 = {'stages': params_list, 'extra': extra}
+    loss_seq, grads_seq = jax.value_and_grad(seq_loss)(tree0)
+    # oracle optimizer step over the same combined structure the
+    # updater uses ({'stages': STACKED, 'extra': ...})
+    tree0_stacked = {'stages': stack_stage_params(params_list),
+                     'extra': extra}
+    grads_stacked = {'stages': stack_stage_params(grads_seq['stages']),
+                     'extra': grads_seq['extra']}
+    state = opt.init(tree0_stacked)
+    updates, _ = opt.update(grads_stacked, state, tree0_stacked)
+    ref = optax.apply_updates(tree0_stacked, updates)
+
+    assert abs(loss_pipe - float(loss_seq)) < 1e-5
+    new_params = jax.device_get(upd.params)
+    new_extra = jax.device_get(upd.extra)
+    np.testing.assert_allclose(new_params['w'],
+                               np.asarray(ref['stages']['w']),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(new_extra['We'],
+                               np.asarray(ref['extra']['We']),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(new_extra['Wh'],
+                               np.asarray(ref['extra']['Wh']),
+                               rtol=1e-5, atol=1e-6)
+    # config errors are loud
+    with pytest.raises(ValueError, match='gpipe'):
+        PipelineUpdater(iter([]), opt, stage_fn, loss_with_head,
+                        stack_stage_params(params_list), mesh,
+                        n_micro=4, schedule='1f1b', prologue=prologue,
+                        extra_params=extra, schedule_check=False)
+    with pytest.raises(ValueError, match='extra_params'):
+        PipelineUpdater(iter([]), opt, stage_fn, loss_on_last,
+                        stack_stage_params(params_list), mesh,
+                        n_micro=4, prologue=prologue)
+
+
 def test_pipeline_snapshot_resume(tmp_path):
     """snapshot/resume round-trip preserves the PipelineUpdater's
     stage-sharded layout: params restored with P('stage'), training
@@ -415,6 +495,51 @@ def test_pipeline_snapshot_resume(tmp_path):
     np.testing.assert_allclose(got['w'], expect['w'],
                                rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(got['b'], expect['b'],
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_pipeline_snapshot_resume_with_extra(tmp_path):
+    """Snapshot/resume round-trips the replicated prologue/epilogue
+    params too (regression: self.extra used to be silently dropped,
+    resuming with fresh end weights against restored momenta)."""
+    from chainermn_tpu import serializers
+
+    mesh = pipeline_mesh(N_STAGES)
+    x, y = _data()
+    batch = [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]
+    rng = np.random.RandomState(9)
+    extra0 = {'Wh': jnp.asarray(rng.randn(DIM, N_CLASSES) * 0.4,
+                                jnp.float32)}
+
+    def loss_with_head(e, outs, y_micro):
+        logits = outs.reshape(-1, DIM) @ e['Wh']
+        yy = y_micro.reshape(-1)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yy).mean(), {}
+
+    def make_updater():
+        return PipelineUpdater(
+            iter([]), optax.adam(1e-2), stage_fn, loss_with_head,
+            stack_stage_params(make_params()), mesh, n_micro=4,
+            donate=False, extra_params=extra0)
+
+    upd = make_updater()
+    for _ in range(2):
+        upd.update_core(upd.shard_batch(batch))
+    path = str(tmp_path / 'snap')
+    serializers.save_npz(path, {
+        'params': upd.params, 'opt_state': upd.opt_state,
+        'extra': upd.extra, 'iteration': upd.iteration, 'epoch': 0})
+    upd.update_core(upd.shard_batch(batch))
+    expect = jax.device_get({'p': upd.params, 'e': upd.extra})
+
+    fresh = make_updater()
+    serializers.resume_updater(path, fresh)
+    fresh.update_core(fresh.shard_batch(batch))
+    got = jax.device_get({'p': fresh.params, 'e': fresh.extra})
+    np.testing.assert_allclose(got['p']['w'], expect['p']['w'],
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(got['e']['Wh'], expect['e']['Wh'],
                                rtol=1e-6, atol=1e-7)
 
 
