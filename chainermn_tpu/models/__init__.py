@@ -21,7 +21,7 @@ from chainermn_tpu.models.resnet50 import (  # noqa
     ResNet, ResNet50, ResNet101, ResNet152)
 from chainermn_tpu.models.seq2seq import Seq2seq, seq2seq_loss  # noqa
 from chainermn_tpu.models.transformer import (  # noqa
-    TransformerLM, TransformerBlock, lm_loss)
+    TransformerLM, TransformerBlock, lm_loss, pipeline_parts)
 
 
 def get_arch(name, **kwargs):

@@ -118,6 +118,94 @@ class TransformerLM(nn.Module):
         return logits
 
 
+def pipeline_parts(model, params, n_stages, pad_id=-1):
+    """Split a ``TransformerLM`` parameter tree into
+    :class:`~chainermn_tpu.training.PipelineUpdater` pieces.
+
+    Returns ``(stage_fn, prologue, loss_on_last, params_stacked,
+    extra)``: the block stack becomes the stage-sharded body
+    (``n_layers`` must divide into ``n_stages`` even groups) while
+    embedding/positional table/final norm/head become the replicated
+    ``extra`` tree.  The pipelined composition computes EXACTLY
+    ``model.apply`` + :func:`lm_loss` with the same parameters and the
+    same fused kernels -- a model trained unpipelined can be resumed
+    pipelined and vice versa
+    (``tests/test_pipeline_training.py::test_transformer_pipeline_parts``).
+
+    ``model`` must have ``sequence_axis=None`` (pipeline shards the
+    batch, not the sequence) and is used with ``train=False``
+    semantics (no dropout).
+    """
+    if model.sequence_axis is not None:
+        raise ValueError('pipeline_parts shards the batch dimension; '
+                         'build the model with sequence_axis=None')
+    if model.dropout:
+        raise ValueError('pipeline_parts runs the blocks without '
+                         'dropout rngs; build the model with '
+                         'dropout=0.0 (training would otherwise '
+                         'silently drop the regularization the '
+                         'unpipelined run applies)')
+    if model.n_layers % n_stages:
+        raise ValueError('%d layers do not split into %d stages'
+                         % (model.n_layers, n_stages))
+    import jax
+    from chainermn_tpu.parallel.pipeline import stack_stage_params
+
+    n_per = model.n_layers // n_stages
+    block = TransformerBlock(model.d_model, model.n_heads, model.d_ff,
+                             model.dtype)
+    layer_trees = [params['block_%d' % i]
+                   for i in range(model.n_layers)]
+    per_stage = [
+        jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls),
+            *layer_trees[s * n_per:(s + 1) * n_per])
+        for s in range(n_stages)]
+    params_stacked = stack_stage_params(per_stage)
+    extra = {'embedding': params['embed']['embedding'],
+             'pos_embed': params['pos_embed'],
+             'lnf_scale': params['lnf_scale'],
+             'lnf_bias': params['lnf_bias'],
+             'lm_head': params['lm_head']}
+
+    def stage_fn(p_stage, x):
+        for j in range(n_per):
+            bp = jax.tree_util.tree_map(lambda a: a[j], p_stage)
+            x = block.apply({'params': bp}, x)
+        return x
+
+    def prologue(e, tokens):
+        # nn.Embed(dtype=model.dtype) lookup + position slice, as in
+        # TransformerLM.__call__ with pos0 = 0
+        x = jnp.take(e['embedding'], tokens, axis=0).astype(model.dtype)
+        pos = e['pos_embed'][:tokens.shape[1]]
+        return x + pos.astype(model.dtype)
+
+    def loss_on_last(e, outs, y_micro):
+        from chainermn_tpu.training.pipeline_updater import AXIS_DATA
+        h = ops.layer_norm(outs, e['lnf_scale'],
+                           e['lnf_bias']).astype(model.dtype)
+        logits = (h.astype(jnp.float32)
+                  @ e['lm_head']['kernel'].astype(jnp.float32)
+                  + e['lm_head']['bias'])
+        v = logits.shape[-1]
+        flat = logits.reshape(-1, v)
+        yy = y_micro.reshape(-1).astype(jnp.int32)
+        ce = ops.softmax_cross_entropy(flat, yy)
+        mask = (yy != pad_id).astype(jnp.float32)
+        # GLOBAL masked mean: sums psum'd over the data axis BEFORE
+        # dividing, so unevenly padded shards weight each token
+        # equally -- exactly lm_loss's reduction (a per-shard mean
+        # pmean'd by the updater would weight a lightly-padded
+        # shard's tokens less)
+        total = lax.psum(jnp.sum(ce * mask), AXIS_DATA)
+        n = jnp.maximum(lax.psum(jnp.sum(mask), AXIS_DATA), 1.0)
+        loss = total / n
+        return loss, {'perp': jnp.exp(jnp.minimum(loss, 20.0))}
+
+    return stage_fn, prologue, loss_on_last, params_stacked, extra
+
+
 def lm_loss(apply_fn, pad_id=-1):
     """Next-token loss over (tokens, targets); fused cross-entropy.
 

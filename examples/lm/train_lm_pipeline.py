@@ -61,9 +61,8 @@ def main():
     import jax.numpy as jnp
     import optax
 
-    from chainermn_tpu import ops
-    from chainermn_tpu.models.transformer import TransformerBlock
-    from chainermn_tpu.parallel.pipeline import stack_stage_params
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.models.transformer import pipeline_parts
     from chainermn_tpu.training.pipeline_updater import (
         PipelineUpdater, pipeline_mesh)
 
@@ -79,54 +78,19 @@ def main():
           % (mesh.shape['data'], n_stages, n_layers,
              args.layers_per_stage))
 
-    block = TransformerBlock(args.d_model, args.n_heads,
-                             4 * args.d_model, dtype=jnp.float32)
-    rng = jax.random.PRNGKey(0)
-    acts0 = jnp.zeros((1, args.seq_len, args.d_model), jnp.float32)
-    layer_keys = jax.random.split(rng, n_layers)
-    layer_params = [block.init(k, acts0)['params'] for k in layer_keys]
-    # stack layers within a stage, then stages: leaves (S, L, ...)
-    per_stage = [
-        jax.tree_util.tree_map(
-            lambda *ls: jnp.stack(ls),
-            *layer_params[s * args.layers_per_stage:
-                          (s + 1) * args.layers_per_stage])
-        for s in range(n_stages)]
-    stacked = stack_stage_params(per_stage)
-
-    nrng = np.random.RandomState(1)
-    extra = {
-        'embed': jnp.asarray(
-            nrng.randn(args.vocab, args.d_model) * 0.02, jnp.float32),
-        'pos': jnp.asarray(
-            nrng.randn(args.seq_len, args.d_model) * 0.02, jnp.float32),
-        'lnf_g': jnp.ones((args.d_model,), jnp.float32),
-        'lnf_b': jnp.zeros((args.d_model,), jnp.float32),
-        'head': jnp.asarray(
-            nrng.randn(args.d_model, args.vocab) * 0.02, jnp.float32),
-    }
-
-    L = args.layers_per_stage
-
-    def stage_fn(p_stage, x):
-        for j in range(L):
-            bp = jax.tree_util.tree_map(lambda a: a[j], p_stage)
-            x = block.apply({'params': bp}, x)
-        return x
-
-    def prologue(e, tokens):
-        return e['embed'][tokens] + e['pos'][None, :tokens.shape[1]]
-
-    def loss_on_last(e, outs, y_micro):
-        h = outs.reshape(-1, args.d_model)
-        h = ops.layer_norm(h, e['lnf_g'], e['lnf_b'])
-        logits = h @ e['head']
-        yy = y_micro.reshape(-1)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits, yy).mean()
-        acc = jnp.mean((jnp.argmax(logits, -1) == yy).astype(
-            jnp.float32))
-        return loss, {'accuracy': acc}
+    # the REAL model class, split by the canonical bridge: block
+    # stack -> stage-sharded body, embed/pos/final-norm/head ->
+    # replicated extras (the pipelined composition computes exactly
+    # model.apply with the same parameters)
+    model = TransformerLM(
+        vocab_size=args.vocab, d_model=args.d_model,
+        n_heads=args.n_heads, n_layers=n_layers,
+        d_ff=4 * args.d_model, max_len=args.seq_len,
+        dtype=jnp.float32)
+    tokens0 = jnp.zeros((1, args.seq_len), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens0)['params']
+    stage_fn, prologue, loss_on_last, stacked, extra = pipeline_parts(
+        model, params, n_stages)
 
     corpus = synthetic_tokens(
         args.batchsize * (args.seq_len + 1) * 8, args.vocab,
@@ -154,9 +118,8 @@ def main():
         if s % 10 == 0 or s == args.steps - 1:
             tok_s = (args.batchsize * args.seq_len * (s + 1)
                      / (time.time() - t0))
-            print('step %4d  loss %.4f  acc %.3f  (%.0f tok/s)'
-                  % (s, float(m['loss']), float(m['accuracy']),
-                     tok_s))
+            print('step %4d  loss %.4f  perp %.1f  (%.0f tok/s)'
+                  % (s, float(m['loss']), float(m['perp']), tok_s))
     final = float(m['loss'])
     print('loss %.4f -> %.4f (uniform=%.4f)'
           % (first, final, np.log(args.vocab)))

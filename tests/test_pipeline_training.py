@@ -564,3 +564,93 @@ def test_pipeline_training_converges():
         accs.append(float(m['accuracy']))
     assert losses[-1] < 0.5 * losses[0]
     assert accs[-1] > 0.85
+
+
+def test_transformer_pipeline_parts():
+    """models.pipeline_parts: the pipelined TransformerLM equals the
+    plain model with the SAME parameter values -- forward loss exactly
+    (via evaluate) and one optimizer step (body + ends)."""
+    from chainermn_tpu.models import TransformerLM, lm_loss
+    from chainermn_tpu.models.transformer import pipeline_parts
+
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=2,
+                          n_layers=4, d_ff=64, max_len=64,
+                          dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.PRNGKey(0), (8, 16), 0, 64)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params = model.init(jax.random.PRNGKey(1), tokens)['params']
+
+    stage_fn, prologue, loss_on_last, stacked, extra = pipeline_parts(
+        model, params, N_STAGES)
+    mesh = pipeline_mesh(N_STAGES)
+    opt = optax.sgd(0.1)
+    upd = PipelineUpdater(iter([]), opt, stage_fn, loss_on_last,
+                          stacked, mesh, n_micro=2, donate=False,
+                          prologue=prologue, extra_params=extra)
+    batch = [(np.asarray(tokens[i]), np.asarray(targets[i]))
+             for i in range(tokens.shape[0])]
+    arrays = upd.shard_batch(batch)
+
+    # forward equality
+    loss_fn = lm_loss(lambda p, t: model.apply({'params': p}, t))
+    loss_ref, _ = loss_fn(params, tokens, targets)
+    m = upd.evaluate(arrays)
+    assert abs(m['loss'] - float(loss_ref)) < 1e-5
+
+    # one-step equality: grads of the composed model drive the same
+    # sgd update in both formulations
+    grads_ref = jax.grad(
+        lambda p: loss_fn(p, tokens, targets)[0])(params)
+    m = upd.update_core(arrays)
+    assert abs(float(m['loss']) - float(loss_ref)) < 1e-5
+    new_extra = jax.device_get(upd.extra)
+    np.testing.assert_allclose(
+        new_extra['embedding'],
+        np.asarray(params['embed']['embedding']
+                   - 0.1 * grads_ref['embed']['embedding']),
+        rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        new_extra['lm_head']['kernel'],
+        np.asarray(params['lm_head']['kernel']
+                   - 0.1 * grads_ref['lm_head']['kernel']),
+        rtol=1e-5, atol=1e-6)
+    new_stacked = jax.device_get(upd.params)
+    for s in range(N_STAGES):
+        blk = 'block_%d' % s  # 1 layer per stage
+        ref_w = (params[blk]['qkv']['kernel']
+                 - 0.1 * grads_ref[blk]['qkv']['kernel'])
+        np.testing.assert_allclose(
+            new_stacked['qkv']['kernel'][s, 0],
+            np.asarray(ref_w), rtol=1e-5, atol=1e-6)
+
+    # pad_id with UNEVEN padding across data shards: the bridge's
+    # psum-before-divide reduction must still equal lm_loss's global
+    # masked mean (a per-shard mean pmean'd would not)
+    PAD = 0
+    tpad = np.array(targets)  # writable copy
+    tpad[:2, 4:] = PAD   # heavy padding concentrated in shard A rows
+    tpad = jnp.asarray(tpad)
+    parts_pad = pipeline_parts(model, params, N_STAGES, pad_id=PAD)
+    upd_pad = PipelineUpdater(iter([]), opt, parts_pad[0],
+                              parts_pad[2], parts_pad[3], mesh,
+                              n_micro=2, donate=False,
+                              prologue=parts_pad[1],
+                              extra_params=parts_pad[4])
+    arrays_pad = upd_pad.shard_batch(
+        [(np.asarray(tokens[i]), np.asarray(tpad[i]))
+         for i in range(tokens.shape[0])])
+    loss_pad_ref, _ = lm_loss(
+        lambda p, t: model.apply({'params': p}, t),
+        pad_id=PAD)(params, tokens, tpad)
+    m_pad = upd_pad.evaluate(arrays_pad)
+    assert abs(m_pad['loss'] - float(loss_pad_ref)) < 1e-5
+
+    # config errors are loud
+    with pytest.raises(ValueError, match='split'):
+        pipeline_parts(model, params, 3)
+    from chainermn_tpu.models import TransformerLM as TLM
+    drop_model = TLM(vocab_size=64, d_model=32, n_heads=2, n_layers=4,
+                     d_ff=64, max_len=64, dtype=jnp.float32,
+                     dropout=0.1)
+    with pytest.raises(ValueError, match='dropout'):
+        pipeline_parts(drop_model, params, N_STAGES)
