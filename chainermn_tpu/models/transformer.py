@@ -156,11 +156,9 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
                              model.dtype)
     layer_trees = [params['block_%d' % i]
                    for i in range(model.n_layers)]
-    per_stage = [
-        jax.tree_util.tree_map(
-            lambda *ls: jnp.stack(ls),
-            *layer_trees[s * n_per:(s + 1) * n_per])
-        for s in range(n_stages)]
+    per_stage = [stack_stage_params(layer_trees[s * n_per:
+                                                (s + 1) * n_per])
+                 for s in range(n_stages)]
     params_stacked = stack_stage_params(per_stage)
     extra = {'embedding': params['embed']['embedding'],
              'pos_embed': params['pos_embed'],

@@ -149,6 +149,63 @@ def main():
         out_specs=P(), check_vma=False))(leaf)
     res['param_leafsum'] = float(np.asarray(jax.device_get(leafsum)))
 
+    # PIPELINE training across controllers: the stage axis SPANS
+    # processes, so every GPipe boundary ppermute (forward rotation
+    # and its backward transpose) crosses the controller boundary --
+    # the distributed analogue of the reference's inter-rank
+    # Send/Recv pipeline.  Loss pinned against a locally computed
+    # sequential oracle (all processes seed the same params/batch).
+    from jax.sharding import Mesh
+    from chainermn_tpu.parallel.pipeline import stack_stage_params
+    from chainermn_tpu.training.pipeline_updater import PipelineUpdater
+
+    n_stages = nprocs
+    all_dev = sorted(jax.devices(),
+                     key=lambda d: (d.process_index, d.id))
+    arr = np.empty((LOCAL_DEVICES, n_stages), dtype=object)
+    for p in range(n_stages):
+        pdevs = [d for d in all_dev if d.process_index == p]
+        for li in range(LOCAL_DEVICES):
+            arr[li, p] = pdevs[li]
+    pmesh = Mesh(arr, ('data', 'stage'))
+    dimp = 8
+    prng = np.random.RandomState(42)  # identical on every process
+    plist = [{'w': jnp.asarray(prng.randn(dimp, dimp) * 0.5,
+                               jnp.float32)} for _ in range(n_stages)]
+
+    def pstage(p, x):
+        return jnp.tanh(x @ p['w'])
+
+    def ploss(outs, ym):
+        logits = outs.reshape(-1, dimp)
+        yy = ym.reshape(-1)
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yy)
+        return ce.mean(), {}
+
+    pupd = PipelineUpdater(iter([]), optax.sgd(0.1), pstage, ploss,
+                           stack_stage_params(plist), pmesh,
+                           n_micro=2, donate=False)
+    bsz = LOCAL_DEVICES * 4
+    bx = prng.randn(bsz, dimp).astype(np.float32)
+    by = (prng.rand(bsz) * dimp).astype(np.int32)
+    dsh = NamedSharding(pmesh, P('data'))
+    gx2 = jax.make_array_from_callback((bsz, dimp), dsh,
+                                       lambda idx: bx[idx])
+    gy2 = jax.make_array_from_callback((bsz,), dsh,
+                                       lambda idx: by[idx])
+    pm = pupd.update_core((gx2, gy2))
+    res['pp_loss'] = float(np.asarray(jax.device_get(pm['loss'])))
+
+    def pseq(x, y):
+        h = x
+        for p in plist:
+            h = pstage(p, h)
+        return float(optax.softmax_cross_entropy_with_integer_labels(
+            h, y).mean())
+
+    res['pp_loss_ref'] = pseq(jnp.asarray(bx), jnp.asarray(by))
+
     # orbax per-host sharded save/restore
     ckdir = os.path.join(outdir, 'ckpt')
     serializers.save_checkpoint(ckdir, {'x': garr}, step=1)

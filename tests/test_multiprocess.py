@@ -122,3 +122,10 @@ def test_multiprocess_end_to_end(tmp_path, nprocs):
     assert losses[0][-1] < losses[0][0]  # SGD makes progress
     leafsums = [res['param_leafsum'] for res in results]
     assert max(leafsums) - min(leafsums) < 1e-5
+
+    # pipeline training with the stage axis SPANNING controllers:
+    # boundary ppermute crosses the process boundary, and the
+    # pipelined loss equals each process's local sequential oracle
+    for res in results:
+        assert abs(res['pp_loss'] - res['pp_loss_ref']) < 1e-5, (
+            res['pp_loss'], res['pp_loss_ref'])
