@@ -10,6 +10,7 @@ reference parity requirement but the natural extension of its sharded
 design.)
 """
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -42,3 +43,57 @@ def tp_mlp(x, w_in, b_in, w_out, b_out, axis, activation=jnp.tanh):
     if activation is not None:
         h = activation(h)
     return row_parallel_dense(h, w_out, axis, b_out)
+
+
+def tp_attention(x, wqkv, wo, axis, n_heads, causal=False, bo=None,
+                 attn_fn=None):
+    """Megatron-sharded self-attention: one psum per block.
+
+    The QKV projection is column-parallel with HEADS as the sharded
+    unit -- ``wqkv``: (d_model, 3, local_heads, d_head), each device
+    computing attention for its own head group with no communication
+    (heads are embarrassingly parallel) -- and the output projection
+    is row-parallel, ``wo``: (local_heads * d_head, d_model), whose
+    ``psum`` sums the head groups' contributions, completing the
+    logical concat-then-project.  Requires
+    ``n_heads % axis_size == 0``.
+
+    x: (B, T, d_model) replicated over ``axis``; returns the same.
+    ``attn_fn(q, k, v, causal=...)`` defaults to the fused Pallas
+    flash kernel.
+    """
+    p = lax.axis_size(axis)
+    if n_heads % p:
+        raise ValueError('tp_attention needs n_heads %% axis_size '
+                         '== 0, got %d heads over %d devices'
+                         % (n_heads, p))
+    qkv = jnp.einsum('btd,dchf->btchf', x, wqkv)  # c=3, h=local, f=dh
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    if attn_fn is None:
+        from chainermn_tpu import ops
+        attn_fn = ops.flash_attention
+    attn = attn_fn(q, k, v, causal=causal)        # (B, T, local_h, dh)
+    attn = attn.reshape(attn.shape[:2] + (-1,))   # (B, T, local_h*dh)
+    return row_parallel_dense(attn, wo, axis, bo)
+
+
+def tp_transformer_block(x, params, axis, n_heads, causal=True,
+                         layer_norm=None):
+    """A full Megatron block: LN -> TP attention -> residual -> LN ->
+    TP MLP -> residual, two psums per block total.
+
+    ``params``: ``ln1_scale/ln1_bias/wqkv/wo/bo`` (attention) and
+    ``ln2_scale/ln2_bias/w_in/b_in/w_out/b_out`` (MLP; ``b_in`` is
+    sharded with ``w_in``'s columns, ``bo``/``b_out`` replicated).
+    ``layer_norm`` defaults to the fused kernel.
+    """
+    if layer_norm is None:
+        from chainermn_tpu import ops
+        layer_norm = ops.layer_norm
+    h = layer_norm(x, params['ln1_scale'], params['ln1_bias'])
+    x = x + tp_attention(h, params['wqkv'], params['wo'], axis,
+                         n_heads, causal=causal, bo=params['bo'])
+    h = layer_norm(x, params['ln2_scale'], params['ln2_bias'])
+    return x + tp_mlp(h, params['w_in'], params['b_in'],
+                      params['w_out'], params['b_out'], axis,
+                      activation=jax.nn.gelu)

@@ -452,3 +452,132 @@ def test_ulysses_rejects_indivisible_heads():
             lambda q, k, v: ulysses_attention(q, k, v, 'sp'),
             mesh=mesh, in_specs=(P(None, 'sp'),) * 3,
             out_specs=P(None, 'sp'), check_vma=False))(x, x, x)
+
+
+@pytest.mark.parametrize('causal', [False, True])
+def test_tp_attention_matches_dense(causal):
+    """Megatron-sharded attention == dense oracle with the SAME
+    (gathered) weights: heads column-sharded in, rows psum'd out."""
+    from chainermn_tpu.parallel import tp_attention
+    from chainermn_tpu.ops.flash_attention import mha_reference
+
+    mesh = _mesh((8,), ('tp',))
+    b, t, h, dh, d = 2, 16, 8, 8, 32
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(b, t, d) * 0.5, jnp.float32)
+    wqkv = jnp.asarray(rng.randn(d, 3, h, dh) * 0.2, jnp.float32)
+    wo = jnp.asarray(rng.randn(h * dh, d) * 0.2, jnp.float32)
+    bo = jnp.asarray(rng.randn(d) * 0.1, jnp.float32)
+
+    def f(x, wqkv, wo, bo):
+        return tp_attention(x, wqkv, wo, 'tp', n_heads=h,
+                            causal=causal, bo=bo)
+
+    out = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(), P(None, None, 'tp'), P('tp'), P()),
+        out_specs=P(), check_vma=False))(x, wqkv, wo, bo)
+
+    qkv = jnp.einsum('btd,dchf->btchf', x, wqkv)
+    ref = mha_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                        causal=causal)
+    ref = ref.reshape(b, t, h * dh) @ wo + bo
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_tp_attention_grads_match_dense():
+    from chainermn_tpu.parallel import tp_attention
+    from chainermn_tpu.ops.flash_attention import mha_reference
+
+    mesh = _mesh((8,), ('tp',))
+    b, t, h, dh, d = 1, 8, 8, 4, 16
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(b, t, d) * 0.5, jnp.float32)
+    wqkv = jnp.asarray(rng.randn(d, 3, h, dh) * 0.2, jnp.float32)
+    wo = jnp.asarray(rng.randn(h * dh, d) * 0.2, jnp.float32)
+
+    def loss(x, wqkv, wo):
+        def f(x, wqkv, wo):
+            out = tp_attention(x, wqkv, wo, 'tp', n_heads=h,
+                               causal=True)
+            return jnp.sum(out ** 2)
+        return jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(), P(None, None, 'tp'), P('tp')),
+            out_specs=P(), check_vma=False)(x, wqkv, wo)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))(x, wqkv, wo)
+
+    def dense_loss(x, wqkv, wo):
+        qkv = jnp.einsum('btd,dchf->btchf', x, wqkv)
+        ref = mha_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                            causal=True)
+        return jnp.sum((ref.reshape(b, t, h * dh) @ wo) ** 2)
+
+    g_ref = jax.grad(dense_loss, argnums=(0, 1, 2))(x, wqkv, wo)
+    for a, r in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_tp_transformer_block_matches_dense():
+    """Full Megatron block (LN -> TP attention -> LN -> TP MLP, two
+    psums) == the locally composed dense computation."""
+    from chainermn_tpu import ops
+    from chainermn_tpu.parallel import tp_transformer_block
+    from chainermn_tpu.ops.flash_attention import mha_reference
+
+    mesh = _mesh((8,), ('tp',))
+    b, t, h, dh, d, ff = 2, 16, 8, 4, 32, 64
+    rng = np.random.RandomState(2)
+    params = {
+        'ln1_scale': jnp.ones((d,)), 'ln1_bias': jnp.zeros((d,)),
+        'wqkv': jnp.asarray(rng.randn(d, 3, h, dh) * 0.2, jnp.float32),
+        'wo': jnp.asarray(rng.randn(h * dh, d) * 0.2, jnp.float32),
+        'bo': jnp.asarray(rng.randn(d) * 0.1, jnp.float32),
+        'ln2_scale': jnp.ones((d,)), 'ln2_bias': jnp.zeros((d,)),
+        'w_in': jnp.asarray(rng.randn(d, ff) * 0.2, jnp.float32),
+        'b_in': jnp.asarray(rng.randn(ff) * 0.1, jnp.float32),
+        'w_out': jnp.asarray(rng.randn(ff, d) * 0.2, jnp.float32),
+        'b_out': jnp.asarray(rng.randn(d) * 0.1, jnp.float32),
+    }
+    x = jnp.asarray(rng.randn(b, t, d) * 0.5, jnp.float32)
+    specs = {
+        'ln1_scale': P(), 'ln1_bias': P(),
+        'wqkv': P(None, None, 'tp'), 'wo': P('tp'), 'bo': P(),
+        'ln2_scale': P(), 'ln2_bias': P(),
+        'w_in': P(None, 'tp'), 'b_in': P('tp'),
+        'w_out': P('tp'), 'b_out': P(),
+    }
+
+    def f(x, params):
+        return tp_transformer_block(x, params, 'tp', n_heads=h)
+
+    out = jax.jit(jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), specs),
+        out_specs=P(), check_vma=False))(x, params)
+
+    # dense oracle, same math
+    gelu = jax.nn.gelu
+    hh = ops.layer_norm(x, params['ln1_scale'], params['ln1_bias'])
+    qkv = jnp.einsum('btd,dchf->btchf', hh, params['wqkv'])
+    attn = mha_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                         causal=True)
+    x1 = x + (attn.reshape(b, t, h * dh) @ params['wo']
+              + params['bo'])
+    hh = ops.layer_norm(x1, params['ln2_scale'], params['ln2_bias'])
+    ref = x1 + (gelu(hh @ params['w_in'] + params['b_in'])
+                @ params['w_out'] + params['b_out'])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+    # head-divisibility guard
+    from chainermn_tpu.parallel import tp_attention
+    with pytest.raises(ValueError, match='n_heads'):
+        jax.jit(jax.shard_map(
+            lambda xx: tp_attention(
+                xx, jnp.zeros((4, 3, 6, 4)), jnp.zeros((24, 4)),
+                'tp', n_heads=6),
+            mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False))(jnp.zeros((1, 8, 4), jnp.float32))
