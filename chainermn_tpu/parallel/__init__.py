@@ -33,5 +33,6 @@ from chainermn_tpu.parallel.tensor import (  # noqa
     tp_mlp, tp_transformer_block)
 from chainermn_tpu.parallel.sequence import (  # noqa
     mapped_global_loss, ring_attention, ulysses_attention)
-from chainermn_tpu.parallel.moe import MoELayer  # noqa
+from chainermn_tpu.parallel.moe import (  # noqa
+    MoELayer, moe_transformer_block)
 from chainermn_tpu.parallel import zero  # noqa

@@ -185,3 +185,39 @@ class MoELayer:
         aux = jnp.sum(density * density_proxy) * n_experts
         return y, {'aux_loss': aux,
                    'dropped_fraction': 1.0 - keep.mean()}
+
+
+def moe_transformer_block(x, params, layer, n_heads, causal=True,
+                          layer_norm=None, attn_fn=None):
+    """Transformer block with a switch-MoE feed-forward: LN ->
+    attention -> residual -> LN -> MoE FFN -> residual.
+
+    Runs inside ``shard_map`` with the BATCH sharded over the
+    ``layer.axis`` mesh axis (the standard EP layout: the data axis
+    owns the experts).  Attention weights are replicated and each
+    device attends over its own token shard with the fused flash
+    kernel (attention never crosses the axis); the MoE FFN dispatches
+    the flattened (B_local*T, d) tokens with ``all_to_all``.
+
+    ``params``: ``ln1_scale/ln1_bias``, ``wqkv`` (d, 3, H, d_head)
+    replicated, ``wo`` (H*d_head, d) replicated, ``bo``,
+    ``ln2_scale/ln2_bias``, and ``moe`` (the
+    :meth:`MoELayer.init_params` tree, experts sharded over the
+    axis).  Returns ``(y, aux)`` with the MoE auxiliary losses --
+    add ``aux['aux_loss']`` (scaled) to the training loss.
+    """
+    from chainermn_tpu.parallel.tensor import qkv_attention
+    if layer_norm is None:
+        from chainermn_tpu import ops
+        layer_norm = ops.layer_norm
+    if params['wqkv'].shape[2] != n_heads:
+        raise ValueError('wqkv carries %d heads but n_heads=%d'
+                         % (params['wqkv'].shape[2], n_heads))
+    b, t, d = x.shape
+    h = layer_norm(x, params['ln1_scale'], params['ln1_bias'])
+    attn = qkv_attention(h, params['wqkv'], causal=causal,
+                         attn_fn=attn_fn)
+    x = x + (attn @ params['wo'] + params['bo'])
+    h = layer_norm(x, params['ln2_scale'], params['ln2_bias'])
+    y_flat, aux = layer(params['moe'], h.reshape(b * t, d))
+    return x + y_flat.reshape(b, t, d), aux

@@ -45,6 +45,22 @@ def tp_mlp(x, w_in, b_in, w_out, b_out, axis, activation=jnp.tanh):
     return row_parallel_dense(h, w_out, axis, b_out)
 
 
+def qkv_attention(x, wqkv, causal=False, attn_fn=None):
+    """Shared attention core: fused QKV projection
+    (``wqkv``: (d_model, 3, heads, d_head)) -> attention -> heads
+    re-flattened, ``(B, T, heads * d_head)``.  Used with the full
+    head set by :func:`~chainermn_tpu.parallel.moe.
+    moe_transformer_block` (replicated weights) and with the LOCAL
+    head group by :func:`tp_attention` (head-sharded weights)."""
+    qkv = jnp.einsum('btd,dchf->btchf', x, wqkv)  # c=3
+    if attn_fn is None:
+        from chainermn_tpu import ops
+        attn_fn = ops.flash_attention
+    attn = attn_fn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                   causal=causal)
+    return attn.reshape(attn.shape[:2] + (-1,))
+
+
 def tp_attention(x, wqkv, wo, axis, n_heads, causal=False, bo=None,
                  attn_fn=None):
     """Megatron-sharded self-attention: one psum per block.
@@ -67,13 +83,11 @@ def tp_attention(x, wqkv, wo, axis, n_heads, causal=False, bo=None,
         raise ValueError('tp_attention needs n_heads %% axis_size '
                          '== 0, got %d heads over %d devices'
                          % (n_heads, p))
-    qkv = jnp.einsum('btd,dchf->btchf', x, wqkv)  # c=3, h=local, f=dh
-    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-    if attn_fn is None:
-        from chainermn_tpu import ops
-        attn_fn = ops.flash_attention
-    attn = attn_fn(q, k, v, causal=causal)        # (B, T, local_h, dh)
-    attn = attn.reshape(attn.shape[:2] + (-1,))   # (B, T, local_h*dh)
+    if wqkv.shape[2] * p != n_heads:
+        raise ValueError('wqkv carries %d local heads on %d devices '
+                         'but n_heads=%d'
+                         % (wqkv.shape[2], p, n_heads))
+    attn = qkv_attention(x, wqkv, causal=causal, attn_fn=attn_fn)
     return row_parallel_dense(attn, wo, axis, bo)
 
 
