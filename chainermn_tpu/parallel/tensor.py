@@ -49,9 +49,9 @@ def qkv_attention(x, wqkv, causal=False, attn_fn=None):
     """Shared attention core: fused QKV projection
     (``wqkv``: (d_model, 3, heads, d_head)) -> attention -> heads
     re-flattened, ``(B, T, heads * d_head)``.  Used with the full
-    head set by :func:`~chainermn_tpu.parallel.moe.
-    moe_transformer_block` (replicated weights) and with the LOCAL
-    head group by :func:`tp_attention` (head-sharded weights)."""
+    head set by ``moe.moe_transformer_block`` (replicated weights)
+    and with the LOCAL head group by :func:`tp_attention`
+    (head-sharded weights)."""
     qkv = jnp.einsum('btd,dchf->btchf', x, wqkv)  # c=3
     if attn_fn is None:
         from chainermn_tpu import ops

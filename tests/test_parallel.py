@@ -454,6 +454,29 @@ def test_ulysses_rejects_indivisible_heads():
             out_specs=P(None, 'sp'), check_vma=False))(x, x, x)
 
 
+def _dense_block_oracle(x, params, n_heads, d_head, ffn=None):
+    """Locally composed dense oracle for the Megatron-style block:
+    LN -> QKV -> softmax attention -> wo/bo residual, then ``ffn(x1,
+    params)`` (default: the gelu MLP) -- shared by the TP, MoE and
+    dp x tp block tests so the pinned math lives in ONE place."""
+    from chainermn_tpu import ops
+    from chainermn_tpu.ops.flash_attention import mha_reference
+
+    b, t, _ = x.shape
+    hh = ops.layer_norm(x, params['ln1_scale'], params['ln1_bias'])
+    qkv = jnp.einsum('btd,dchf->btchf', hh, params['wqkv'])
+    attn = mha_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+                         causal=True)
+    x1 = x + (attn.reshape(b, t, n_heads * d_head) @ params['wo']
+              + params['bo'])
+    if ffn is None:
+        hh = ops.layer_norm(x1, params['ln2_scale'],
+                            params['ln2_bias'])
+        return x1 + (jax.nn.gelu(hh @ params['w_in'] + params['b_in'])
+                     @ params['w_out'] + params['b_out'])
+    return ffn(x1, params)
+
+
 @pytest.mark.parametrize('causal', [False, True])
 def test_tp_attention_matches_dense(causal):
     """Megatron-sharded attention == dense oracle with the SAME
@@ -524,9 +547,7 @@ def test_tp_attention_grads_match_dense():
 def test_tp_transformer_block_matches_dense():
     """Full Megatron block (LN -> TP attention -> LN -> TP MLP, two
     psums) == the locally composed dense computation."""
-    from chainermn_tpu import ops
     from chainermn_tpu.parallel import tp_transformer_block
-    from chainermn_tpu.ops.flash_attention import mha_reference
 
     mesh = _mesh((8,), ('tp',))
     b, t, h, dh, d, ff = 2, 16, 8, 4, 32, 64
@@ -558,17 +579,7 @@ def test_tp_transformer_block_matches_dense():
         f, mesh=mesh, in_specs=(P(), specs),
         out_specs=P(), check_vma=False))(x, params)
 
-    # dense oracle, same math
-    gelu = jax.nn.gelu
-    hh = ops.layer_norm(x, params['ln1_scale'], params['ln1_bias'])
-    qkv = jnp.einsum('btd,dchf->btchf', hh, params['wqkv'])
-    attn = mha_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-                         causal=True)
-    x1 = x + (attn.reshape(b, t, h * dh) @ params['wo']
-              + params['bo'])
-    hh = ops.layer_norm(x1, params['ln2_scale'], params['ln2_bias'])
-    ref = x1 + (gelu(hh @ params['w_in'] + params['b_in'])
-                @ params['w_out'] + params['b_out'])
+    ref = _dense_block_oracle(x, params, h, dh)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=2e-4, atol=2e-4)
 
@@ -591,7 +602,6 @@ def test_moe_transformer_block_matches_dense():
     from chainermn_tpu import ops
     from chainermn_tpu.parallel import MoELayer, moe_transformer_block
     from chainermn_tpu.parallel.moe import _route
-    from chainermn_tpu.ops.flash_attention import mha_reference
 
     mesh = _mesh((8,), ('expert',))
     b, t, h, dh, d, ff = 8, 8, 2, 8, 16, 32
@@ -624,15 +634,9 @@ def test_moe_transformer_block_matches_dense():
     val_full = jax.jit(loss)(x, params)
     val = val_full[0]
 
-    # dense oracle on the full batch: same attention math, per-token
-    # top-1 expert apply (no capacity cut)
-    def dense(x, params):
-        hh = ops.layer_norm(x, params['ln1_scale'], params['ln1_bias'])
-        qkv = jnp.einsum('btd,dchf->btchf', hh, params['wqkv'])
-        attn = mha_reference(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
-                             causal=True)
-        x1 = x + (attn.reshape(b, t, h * dh) @ params['wo']
-                  + params['bo'])
+    # dense oracle on the full batch: shared attention math, per-token
+    # top-1 expert apply as the FFN (no capacity cut)
+    def moe_ffn(x1, params):
         hh = ops.layer_norm(x1, params['ln2_scale'],
                             params['ln2_bias'])
         flat = hh.reshape(b * t, d)
@@ -642,6 +646,9 @@ def test_moe_transformer_block_matches_dense():
         hmid = jnp.maximum(jnp.einsum('td,tdf->tf', flat, w_in), 0)
         y = jnp.einsum('tf,tfd->td', hmid, w_out) * gate
         return x1 + y.reshape(b, t, d)
+
+    def dense(x, params):
+        return _dense_block_oracle(x, params, h, dh, ffn=moe_ffn)
 
     ref = dense(x, params)
     assert abs(float(val) - float(jnp.sum(ref ** 2))) < 1e-3
@@ -656,3 +663,63 @@ def test_moe_transformer_block_matches_dense():
                     jax.tree_util.tree_leaves(g_ref)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(r),
                                    rtol=2e-3, atol=2e-3)
+
+
+def test_dp_tp_composed_training_step():
+    """2-D composition: batch over 'dp', Megatron block weights over
+    'tp', in ONE mapped step -- gradients (pmean over dp, psum'd by
+    the tp transpose) equal the dense full-batch oracle, and one sgd
+    step matches."""
+    from chainermn_tpu.parallel import tp_transformer_block
+
+    mesh = _mesh((2, 4), ('dp', 'tp'))
+    b, t, h, dh, d, ff = 4, 8, 4, 4, 16, 32
+    rng = np.random.RandomState(5)
+    params = {
+        'ln1_scale': jnp.ones((d,)), 'ln1_bias': jnp.zeros((d,)),
+        'wqkv': jnp.asarray(rng.randn(d, 3, h, dh) * 0.2, jnp.float32),
+        'wo': jnp.asarray(rng.randn(h * dh, d) * 0.2, jnp.float32),
+        'bo': jnp.asarray(rng.randn(d) * 0.1, jnp.float32),
+        'ln2_scale': jnp.ones((d,)), 'ln2_bias': jnp.zeros((d,)),
+        'w_in': jnp.asarray(rng.randn(d, ff) * 0.2, jnp.float32),
+        'b_in': jnp.asarray(rng.randn(ff) * 0.1, jnp.float32),
+        'w_out': jnp.asarray(rng.randn(ff, d) * 0.2, jnp.float32),
+        'b_out': jnp.asarray(rng.randn(d) * 0.1, jnp.float32),
+    }
+    specs = {'ln1_scale': P(), 'ln1_bias': P(),
+             'wqkv': P(None, None, 'tp'), 'wo': P('tp'), 'bo': P(),
+             'ln2_scale': P(), 'ln2_bias': P(),
+             'w_in': P(None, 'tp'), 'b_in': P('tp'),
+             'w_out': P('tp'), 'b_out': P()}
+    x = jnp.asarray(rng.randn(b, t, d) * 0.5, jnp.float32)
+
+    def loss(params, x):
+        def f(p, xx):
+            y = tp_transformer_block(xx, p, 'tp', n_heads=h)
+            # per-shard mean -> global mean over the batch shards
+            return jax.lax.pmean(jnp.mean(y ** 2), 'dp')
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=(specs, P('dp')),
+            out_specs=P(), check_vma=False)(params, x)
+
+    val, grads = jax.jit(jax.value_and_grad(loss))(params, x)
+
+    def dense_loss(params, x):
+        return jnp.mean(_dense_block_oracle(x, params, h, dh) ** 2)
+
+    val_ref, grads_ref = jax.value_and_grad(dense_loss)(params, x)
+    assert abs(float(val) - float(val_ref)) < 1e-5
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(grads[k]), np.asarray(grads_ref[k]),
+            rtol=2e-3, atol=2e-4, err_msg=k)
+
+    # one sgd step through the composed formulation stays aligned
+    new_p = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                   params, grads)
+    new_ref = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g,
+                                     params, grads_ref)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(new_p[k]),
+                                   np.asarray(new_ref[k]),
+                                   rtol=2e-3, atol=2e-4)
