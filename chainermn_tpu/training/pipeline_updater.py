@@ -11,13 +11,16 @@ reference's Send/Recv backward pairing at scale), reduces gradients
 over the data axis, and applies the optimizer -- loss computed on the
 LAST stage only and broadcast so every host observes the same metrics.
 
-Mesh layout: 2-D ``(data, stage)``.  Parameters are stacked per stage
+Mesh layout: 2-D ``(data, stage)`` -- or 3-D ``(data, stage, tp)``
+with ``pipeline_mesh(n_tp=...)`` + ``param_specs``, where each
+stage's weights are additionally Megatron-sharded over ``tp``.
+Parameters are stacked per stage
 (:func:`~chainermn_tpu.parallel.pipeline.stack_stage_params`) and
-sharded ``P('stage')`` -- each device holds ONLY its stage's weights,
-the memory/compute scaling the SPMD ``MultiNodeChainList`` mode
-deliberately does not attempt (``link.py:33-38``).  Gradients need no
-collective over ``stage`` (disjoint ownership); they are ``pmean``'d
-over ``data``.
+sharded ``P('stage', ...)`` -- each device holds ONLY its stage's
+(tp-shard of) weights, the memory/compute scaling the SPMD
+``MultiNodeChainList`` mode deliberately does not attempt
+(``link.py:33-38``).  Gradients need no collective over ``stage``
+(disjoint ownership); they are ``pmean``'d over ``data``.
 
 Memory profile (why GPipe-via-scan, not 1F1B): differentiating the
 scheduling ``lax.scan`` stores one carry per tick, i.e.
@@ -48,20 +51,32 @@ AXIS_DATA = 'data'
 AXIS_STAGE = 'stage'
 
 
-def pipeline_mesh(n_stages, devices=None):
-    """A ``(data, stage)`` mesh using all local devices: the trailing
-    (fastest-varying, most ICI-local) axis carries the stages so
-    boundary ``ppermute`` traffic rides neighbor links."""
+AXIS_TP = 'tp'
+
+
+def pipeline_mesh(n_stages, devices=None, n_tp=1):
+    """A ``(data, stage)`` mesh -- or ``(data, stage, tp)`` when
+    ``n_tp > 1`` -- using all local devices: the trailing
+    (fastest-varying, most ICI-local) axes carry the stage boundary
+    ``ppermute`` and the per-block tensor-parallel ``psum`` so that
+    traffic rides neighbor links."""
     import numpy as np
+    if n_tp < 1 or n_stages < 1:
+        raise ValueError('n_stages and n_tp must be >= 1, got %d, %d'
+                         % (n_stages, n_tp))
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    if n % n_stages:
-        raise ValueError('%d devices not divisible into %d stages'
-                         % (n, n_stages))
-    arr = np.asarray(devices, dtype=object).reshape(
-        n // n_stages, n_stages)
-    return Mesh(arr, (AXIS_DATA, AXIS_STAGE))
+    if n % (n_stages * n_tp):
+        raise ValueError('%d devices not divisible into %d stages x '
+                         '%d tp' % (n, n_stages, n_tp))
+    arr = np.asarray(devices, dtype=object)
+    if n_tp > 1:
+        return Mesh(arr.reshape(n // (n_stages * n_tp), n_stages,
+                                n_tp),
+                    (AXIS_DATA, AXIS_STAGE, AXIS_TP))
+    return Mesh(arr.reshape(n // n_stages, n_stages),
+                (AXIS_DATA, AXIS_STAGE))
 
 
 class PipelineUpdater:
@@ -114,14 +129,41 @@ class PipelineUpdater:
         then takes ``(extra, outputs, y_micro)``.  gpipe schedule
         only (1f1b discards the stage-0 input cotangent the prologue
         backward needs).
+      param_specs: optional pytree of ``PartitionSpec`` (matching
+        ``params_stacked``, every spec leading with ``'stage'``) that
+        ADDS sharded axes beyond the stage axis -- e.g.
+        ``P('stage', None, 'tp')`` for Megatron-sharded stage weights
+        on a ``pipeline_mesh(n_stages, n_tp=...)``.  ``stage_fn`` is
+        then responsible for the matching collectives (``tp_mlp``'s
+        psum) and must return activations REPLICATED over the extra
+        axes.  Optimizer state mirroring a params leaf inherits its
+        full spec.  gpipe schedule only.
     """
 
     def __init__(self, iterator, optimizer, stage_fn, loss_on_last,
                  params_stacked, mesh, n_micro, remat=False,
                  donate=True, schedule='gpipe', schedule_check=True,
-                 prologue=None, extra_params=None):
+                 prologue=None, extra_params=None, param_specs=None):
         if schedule not in ('gpipe', '1f1b'):
             raise ValueError("schedule must be 'gpipe' or '1f1b'")
+        if param_specs is not None:
+            if schedule == '1f1b':
+                raise ValueError(
+                    "param_specs require schedule='gpipe': extra "
+                    'sharded axes imply collectives inside stage_fn '
+                    "(e.g. tensor-parallel psum), and 1f1b's "
+                    'hand-propagated backward requires a '
+                    'collective-free stage body')
+            bad = [
+                sp for sp in jax.tree_util.tree_leaves(
+                    param_specs,
+                    is_leaf=lambda v: isinstance(v, P))
+                if not (isinstance(sp, P) and len(sp) >= 1
+                        and sp[0] == AXIS_STAGE)]
+            if bad:
+                raise ValueError(
+                    'every param spec must lead with the stage axis '
+                    "(P('stage', ...)), got %r" % (bad[:3],))
         extra_used = extra_params is not None
         if extra_used and schedule == '1f1b':
             raise ValueError(
@@ -158,9 +200,15 @@ class PipelineUpdater:
         self.n_stages = mesh.shape[AXIS_STAGE]
         self.iteration = 0
 
-        stage_sharding = NamedSharding(mesh, P(AXIS_STAGE))
-        self.params = owned_device_put(params_stacked, stage_sharding,
-                                       donate)
+        p_specs = (param_specs if param_specs is not None
+                   else jax.tree_util.tree_map(
+                       lambda _: P(AXIS_STAGE), params_stacked))
+        self.params = owned_device_put(
+            params_stacked,
+            jax.tree_util.tree_map(
+                lambda sp: NamedSharding(mesh, sp), p_specs,
+                is_leaf=lambda v: isinstance(v, P)),
+            donate)
         # heterogeneous ends: replicated prologue/epilogue parameters
         # (embedding table, head, final norm) trained alongside the
         # stage-stacked body
@@ -184,9 +232,12 @@ class PipelineUpdater:
         # over stages would silently hand each stage a different
         # scalar.  Shared by placement AND the 1f1b shard_map specs.
         _p_sigs = [
-            (jax.tree_util.keystr(kp), getattr(v, 'shape', None))
-            for kp, v in jax.tree_util.tree_flatten_with_path(
-                params_stacked)[0]]
+            (jax.tree_util.keystr(kp), getattr(v, 'shape', None), sp)
+            for (kp, v), sp in zip(
+                jax.tree_util.tree_flatten_with_path(
+                    params_stacked)[0],
+                jax.tree_util.tree_leaves(
+                    p_specs, is_leaf=lambda v: isinstance(v, P)))]
 
         def _leaf_spec(kp, leaf):
             ks = jax.tree_util.keystr(kp)
@@ -203,12 +254,21 @@ class PipelineUpdater:
             shape = getattr(leaf, 'shape', None)
             if shape is None:
                 return P()
+            # mirror state (momentum/EMA): same keypath suffix and
+            # shape as a params leaf -> inherit that leaf's FULL spec
+            # (stage + any extra tensor-parallel axes)
+            for pk, s, sp in _p_sigs:
+                if shape == s and ks.endswith(pk):
+                    return sp
             if len(shape) >= 2 and shape[0] == self.n_stages:
+                # renamed-key or factored per-stage state: shape-only
+                # match inherits the spec; otherwise stage-shard the
+                # leading dim (correct for e.g. adafactor row/col
+                # moments, whose trailing dims match no params leaf)
+                for pk, s, sp in _p_sigs:
+                    if shape == s:
+                        return sp
                 return P(AXIS_STAGE)
-            if len(shape) == 1:
-                if any(s == shape and ks.endswith(pk)
-                       for pk, s in _p_sigs):
-                    return P(AXIS_STAGE)
             return P()
 
         opt_specs = jax.tree_util.tree_map_with_path(
@@ -279,7 +339,7 @@ class PipelineUpdater:
         def mapped_loss(params, extra, x, y):
             return jax.shard_map(
                 device_loss, mesh=mesh,
-                in_specs=(P(AXIS_STAGE), P(), P(AXIS_DATA),
+                in_specs=(p_specs, P(), P(AXIS_DATA),
                           P(AXIS_DATA)),
                 out_specs=(P(), P()), check_vma=False)(
                     params, extra, x, y)

@@ -654,3 +654,76 @@ def test_transformer_pipeline_parts():
                      dropout=0.1)
     with pytest.raises(ValueError, match='dropout'):
         pipeline_parts(drop_model, params, N_STAGES)
+
+
+def test_pipeline_tensor_parallel_composed():
+    """PP x TP x DP in one step: 8 devices as (data=2, stage=2, tp=2),
+    each stage a Megatron-sharded MLP (column/row + psum over 'tp'),
+    stage boundary ppermute over 'stage', grads pmean'd over 'data' --
+    loss and one momentum-sgd step equal the dense sequential
+    oracle."""
+    from jax.sharding import PartitionSpec as P
+    from chainermn_tpu.parallel import tp_mlp
+
+    n_stages, ff = 2, 32
+    mesh = pipeline_mesh(n_stages, n_tp=2)
+    assert mesh.shape == {'data': 2, 'stage': 2, 'tp': 2}
+    rng = np.random.RandomState(11)
+    params_list = [
+        {'w_in': jnp.asarray(rng.randn(DIM, ff) * 0.3, jnp.float32),
+         'b_in': jnp.asarray(rng.randn(ff) * 0.1, jnp.float32),
+         'w_out': jnp.asarray(rng.randn(ff, DIM) * 0.3, jnp.float32),
+         'b_out': jnp.asarray(rng.randn(DIM) * 0.1, jnp.float32)}
+        for _ in range(n_stages)]
+    stacked = stack_stage_params(params_list)
+    specs = {'w_in': P('stage', None, 'tp'), 'b_in': P('stage', 'tp'),
+             'w_out': P('stage', 'tp', None), 'b_out': P('stage')}
+
+    def tp_stage(p, x):
+        return tp_mlp(x, p['w_in'], p['b_in'], p['w_out'], p['b_out'],
+                      'tp')
+
+    x, y = _data()
+    opt = optax.sgd(0.1, momentum=0.9)
+    upd = PipelineUpdater(iter([]), opt, tp_stage, loss_on_last,
+                          stacked, mesh, n_micro=4, donate=False,
+                          param_specs=specs)
+    metrics = upd.update_core(upd.shard_batch(
+        [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
+    loss_pipe = float(metrics['loss'])
+
+    def seq_loss(plist, x, y):
+        h = x
+        for p in plist:
+            h = jnp.tanh(h @ p['w_in'] + p['b_in']) @ p['w_out'] \
+                + p['b_out']
+        return optax.softmax_cross_entropy_with_integer_labels(
+            h, y).mean()
+
+    loss_seq, grads_seq = jax.value_and_grad(seq_loss)(
+        params_list, x, y)
+    state = opt.init(params_list)
+    updates, _ = opt.update(grads_seq, state, params_list)
+    ref = optax.apply_updates(params_list, updates)
+    assert abs(loss_pipe - float(loss_seq)) < 1e-5
+    new_stacked = jax.device_get(upd.params)
+    for s in range(n_stages):
+        for k in ('w_in', 'b_in', 'w_out', 'b_out'):
+            np.testing.assert_allclose(
+                new_stacked[k][s], np.asarray(ref[s][k]),
+                rtol=1e-5, atol=1e-6, err_msg='%s stage %d' % (k, s))
+    # momentum state inherited the tp sharding of its params leaf
+    mu_leaves = [
+        l for l in jax.tree_util.tree_leaves(upd.opt_state)
+        if getattr(l, 'ndim', 0) == 3 and l.shape[-1] == ff]
+    assert mu_leaves and all(
+        'tp' in str(l.sharding.spec) for l in mu_leaves)
+    # config errors are loud
+    with pytest.raises(ValueError, match='stage axis'):
+        PipelineUpdater(iter([]), opt, tp_stage, loss_on_last,
+                        stacked, mesh, n_micro=4,
+                        param_specs={k: P('tp') for k in specs})
+    with pytest.raises(ValueError, match='gpipe'):
+        PipelineUpdater(iter([]), opt, tp_stage, loss_on_last,
+                        stacked, mesh, n_micro=4, schedule='1f1b',
+                        schedule_check=False, param_specs=specs)
