@@ -14,6 +14,21 @@ TAG=${1:-r3}
 RES=benchmarks/results
 mkdir -p "$RES"
 
+# preflight: one bounded probe so a dead tunnel fails the series in
+# ~2 minutes instead of burning every step's own probe window
+if ! timeout 120 python -c "
+import jax, jax.numpy as jnp
+assert jax.default_backend() == 'tpu', (
+    'not a TPU backend: %s -- a silent CPU fallback would record '
+    'bogus artifacts as TPU data' % jax.default_backend())
+y = jax.jit(lambda a: a @ a)(jnp.ones((256, 256), jnp.bfloat16))
+jax.device_get(y[:1, :1])
+print('preflight ok:', jax.default_backend())
+" >&2; then
+  echo "preflight FAILED: TPU backend unreachable; aborting series" >&2
+  exit 2
+fi
+
 run() {  # run <name> <timeout_s> <cmd...>
   local name=$1 tmo=$2; shift 2
   echo "=== [$name] $*" >&2
