@@ -34,7 +34,8 @@ class StandardUpdater:
 
     def __init__(self, iterator, optimizer, loss_fn, params, comm,
                  has_aux=False, donate=True, model_state=None, rng=None,
-                 zero=False, accum_steps=1, zero_check=True):
+                 zero=False, accum_steps=1, zero_check=True,
+                 zero_reduce_dtype=None):
         """``model_state``: optional non-trainable collections (e.g.
         BatchNorm running stats).  When given, ``loss_fn`` must have
         the extended signature
@@ -64,6 +65,12 @@ class StandardUpdater:
         (:func:`chainermn_tpu.parallel.zero.check_elementwise`);
         ``zero_check=False`` bypasses it.
 
+        ``zero_reduce_dtype`` (e.g. ``'bfloat16'``): cast gradients
+        to a narrower dtype for the ZeRO reduce-scatter and back for
+        the optimizer update -- the zero=True twin of the multi-node
+        optimizer's ``allreduce_dtype`` (which does not compose with
+        zero because zero takes the raw optax optimizer).
+
         ``accum_steps=k`` splits each per-device batch into k
         micro-batches processed by ``lax.scan`` with gradients
         averaged before the (single) optimizer step -- k-times larger
@@ -76,6 +83,13 @@ class StandardUpdater:
         self._has_aux = has_aux
         self._has_state = model_state is not None
         self._zero = zero
+        self._zero_reduce_dtype = (jnp.dtype(zero_reduce_dtype)
+                                   if zero_reduce_dtype is not None
+                                   else None)
+        if self._zero_reduce_dtype is not None and not zero:
+            raise ValueError('zero_reduce_dtype requires zero=True '
+                             '(use allreduce_dtype on the multi-node '
+                             'optimizer for the plain path)')
         if accum_steps < 1:
             raise ValueError('accum_steps must be >= 1')
         self._accum_steps = accum_steps
@@ -127,6 +141,7 @@ class StandardUpdater:
         from chainermn_tpu.communicators.mesh_utility import AXES
         has_state = self._has_state
         is_zero = self._zero
+        reduce_dtype = self._zero_reduce_dtype
         axes = AXES
 
         accum = self._accum_steps
@@ -206,8 +221,18 @@ class StandardUpdater:
                 return synced, opt_state
 
             def later_call(_):
+                g = grads
+                if reduce_dtype is not None:
+                    # narrow-dtype reduce-scatter: halves the bytes on
+                    # the wire; the mean lands in the narrow dtype and
+                    # is widened back for the optimizer update
+                    g = jax.tree_util.tree_map(
+                        lambda x: x.astype(reduce_dtype), g)
                 g_sh = jax.tree_util.tree_map(
-                    lambda g: z.scatter_grad_leaf(g, n, axes), grads)
+                    lambda g_: z.scatter_grad_leaf(g_, n, axes), g)
+                if reduce_dtype is not None:
+                    g_sh = jax.tree_util.tree_map(
+                        lambda r, g0: r.astype(g0.dtype), g_sh, grads)
                 p_sh = jax.tree_util.tree_map(
                     lambda p: z.param_shard_leaf(p, n, rank), params)
                 opt_local = z.squeeze_state(opt_state)

@@ -169,3 +169,44 @@ def test_elementwise_probe_accepts_good_optimizers():
                 optax.adamw(1e-3), optax.chain(
                     optax.clip(1.0), optax.sgd(0.1))):
         zero_mod.check_elementwise(opt)
+
+
+def test_zero_reduce_dtype_close_to_full_precision():
+    """zero_reduce_dtype='bfloat16' halves reduce-scatter bytes; the
+    trajectory must track the f32 run within bf16 tolerance and stay
+    identical across devices."""
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    rng = np.random.RandomState(0)
+    x = rng.rand(32, 6).astype(np.float32)
+    y = (x.sum(axis=1) > 3.0).astype(np.int32)
+    model = MLP(n_units=16, n_out=2)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 6)))['params']
+    loss_fn = classifier_loss(
+        lambda p, xb: model.apply({'params': p}, xb))
+
+    def run(dtype):
+        upd = training.StandardUpdater(
+            iter([]), optax.adam(1e-2), loss_fn, params, comm,
+            has_aux=True, zero=True, zero_reduce_dtype=dtype)
+        arrays = upd.shard_batch([(x[i], y[i]) for i in range(32)])
+        for _ in range(3):
+            upd.update_core(arrays)
+        return np.concatenate([
+            np.asarray(l).ravel()
+            for l in jax.tree_util.tree_leaves(
+                jax.device_get(upd.params))])
+
+    full = run(None)
+    narrow = run('bfloat16')
+    assert not np.allclose(narrow, full[::-1])  # sanity: not trivial
+    np.testing.assert_allclose(narrow, full, rtol=2e-2, atol=2e-3)
+
+    with pytest.raises(ValueError, match='zero=True'):
+        training.StandardUpdater(
+            iter([]), optax.adam(1e-2), loss_fn, params, comm,
+            has_aux=True, zero_reduce_dtype='bfloat16')
