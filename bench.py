@@ -353,14 +353,30 @@ def _build_conv(name, quick, on_cpu):
                 baseline_derivation=deriv)
 
 
-def build_seq2seq(quick, on_cpu):
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
+def _updater_setup(loss, params, examples):
+    """Shared LM/MLP bench plumbing: communicator + multi-node adam +
+    StandardUpdater (donate=False so scans can replay from the same
+    buffers) + sharded batch -- ONE place for the updater-construction
+    contract the three non-conv builders share."""
     import optax
 
     import chainermn_tpu
     from chainermn_tpu import training
+
+    comm = chainermn_tpu.create_communicator('xla')
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    upd = training.StandardUpdater(
+        iter([]), optimizer, loss, params, comm, has_aux=True,
+        donate=False)
+    return upd, upd.shard_batch(examples)
+
+
+def build_seq2seq(quick, on_cpu):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from chainermn_tpu.models import Seq2seq, seq2seq_loss
 
     layers, units, vocab = (2, 256, 4000) if on_cpu else (2, 512, 8000)
@@ -369,7 +385,6 @@ def build_seq2seq(quick, on_cpu):
     batch = per_dev * jax.device_count()
     model = Seq2seq(n_layers=layers, n_source_vocab=vocab,
                     n_target_vocab=vocab, n_units=units)
-    comm = chainermn_tpu.create_communicator('xla')
     rng = np.random.RandomState(0)
     xs = rng.randint(1, vocab, (batch, seq_len)).astype(np.int32)
     ys_in = rng.randint(1, vocab, (batch, seq_len)).astype(np.int32)
@@ -379,13 +394,9 @@ def build_seq2seq(quick, on_cpu):
                         jnp.zeros((1, seq_len), jnp.int32))['params']
     loss = seq2seq_loss(
         lambda p, a, b: model.apply({'params': p}, a, b))
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.adam(1e-3), comm)
-    upd = training.StandardUpdater(
-        iter([]), optimizer, loss, params, comm, has_aux=True,
-        donate=False)
-    arrays = upd.shard_batch([(xs[i], ys_in[i], ys_out[i])
-                              for i in range(batch)])
+    upd, arrays = _updater_setup(
+        loss, params,
+        [(xs[i], ys_in[i], ys_out[i]) for i in range(batch)])
     # LSTM train flops/token/layer ~ 3 * 16u^2 (fwd 8u^2 MACs x2);
     # + decoder softmax 3 * 2uV per target token; enc+dec tokens
     tokens = batch * seq_len  # target tokens (the reported unit)
@@ -403,10 +414,7 @@ def build_transformer(quick, on_cpu):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    import optax
 
-    import chainermn_tpu
-    from chainermn_tpu import training
     from chainermn_tpu.models import TransformerLM, lm_loss
 
     if on_cpu:
@@ -419,19 +427,14 @@ def build_transformer(quick, on_cpu):
     model = TransformerLM(vocab_size=vocab, d_model=d_model,
                           n_heads=n_heads, n_layers=n_layers,
                           d_ff=4 * d_model, max_len=seq)
-    comm = chainermn_tpu.create_communicator('xla')
     rng = np.random.RandomState(0)
     toks = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
     tgts = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, seq), jnp.int32))['params']
     loss = lm_loss(lambda p, t: model.apply({'params': p}, t))
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.adam(1e-3), comm)
-    upd = training.StandardUpdater(
-        iter([]), optimizer, loss, params, comm, has_aux=True,
-        donate=False)
-    arrays = upd.shard_batch([(toks[i], tgts[i]) for i in range(batch)])
+    upd, arrays = _updater_setup(
+        loss, params, [(toks[i], tgts[i]) for i in range(batch)])
     tokens = batch * seq
     # per token fwd: 12 d^2 per layer (qkvo + 2-layer 4d MLP) +
     # 4*seq*d attention matmuls per layer (causal halves it) + lm head
@@ -497,28 +500,20 @@ def build_mlp(quick, on_cpu):
     import jax
     import jax.numpy as jnp
     import numpy as np
-    import optax
 
-    import chainermn_tpu
-    from chainermn_tpu import training
     from chainermn_tpu.models import MLP, classifier_loss
 
     per_dev = 128
     batch = per_dev * jax.device_count()
     model = MLP(n_units=1000, n_out=10)
-    comm = chainermn_tpu.create_communicator('xla')
     rng = np.random.RandomState(0)
     x = rng.rand(batch, 784).astype(np.float32)
     y = rng.randint(0, 10, batch).astype(np.int32)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 784), jnp.float32))['params']
     loss = classifier_loss(lambda p, xx: model.apply({'params': p}, xx))
-    optimizer = chainermn_tpu.create_multi_node_optimizer(
-        optax.adam(1e-3), comm)
-    upd = training.StandardUpdater(
-        iter([]), optimizer, loss, params, comm, has_aux=True,
-        donate=False)
-    arrays = upd.shard_batch([(x[i], y[i]) for i in range(batch)])
+    upd, arrays = _updater_setup(
+        loss, params, [(x[i], y[i]) for i in range(batch)])
     fwd = 2.0 * (784 * 1000 + 1000 * 1000 + 1000 * 10)
     base = BASELINE_IMG_PER_SEC_PER_CHIP * 4.1e9 * 3.0 / (3.0 * fwd)
     return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,

@@ -63,22 +63,28 @@ def bench_config(b, t, h, d, causal, dtype, use_pallas, bwd,
          ).astype(dtype)
 
     if use_pallas:
-        def attn(qq):
-            return ops.flash_attention(qq, k, v, causal=causal,
+        def attn(qq, kk_, vv_):
+            return ops.flash_attention(qq, kk_, vv_, causal=causal,
                                        block_q=block_q,
                                        block_k=block_k)
     else:
-        def attn(qq):
-            return mha_reference(qq, k, v, causal=causal)
+        def attn(qq, kk_, vv_):
+            return mha_reference(qq, kk_, vv_, causal=causal)
 
     if bwd:
         def one(qq):
-            return jax.grad(
-                lambda z: (attn(z).astype(jnp.float32) ** 2).sum()
-            )(qq).astype(qq.dtype)
+            # differentiate wrt ALL of q/k/v: grads over q alone let
+            # XLA dead-code the dK/dV matmuls on the unfused arm and
+            # skew the comparison against attn_flops's full 3.5x
+            # backward accounting
+            dq, dk, dv = jax.grad(
+                lambda q_, k_, v_: (attn(q_, k_, v_).astype(
+                    jnp.float32) ** 2).sum(),
+                argnums=(0, 1, 2))(qq, k, v)
+            return (dq + dk + dv).astype(qq.dtype)
     else:
         def one(qq):
-            return attn(qq).astype(qq.dtype)
+            return attn(qq, k, v).astype(qq.dtype)
 
     def make(n):
         @jax.jit

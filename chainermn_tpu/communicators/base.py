@@ -28,6 +28,20 @@ from chainermn_tpu.communicators.mesh_utility import (
     AXIS_INTER, AXIS_INTRA, AXES)
 
 
+def _kv_key_state(client, key):
+    """Tri-state probe of a coordination-store key: ``'present'``,
+    ``'absent'`` (the store POSITIVELY reports NOT_FOUND, i.e. the
+    receiver consumed-and-deleted it), or ``'unknown'`` (a transient
+    store/transport error -- neither conclusion is safe)."""
+    try:
+        client.key_value_try_get(key)
+        return 'present'
+    except Exception as e:
+        if 'NOT_FOUND' in str(e):
+            return 'absent'
+        return 'unknown'
+
+
 def _is_tracing(tree):
     return any(isinstance(leaf, jax.core.Tracer)
                for leaf in jax.tree_util.tree_leaves(tree))
@@ -287,12 +301,15 @@ class CommunicatorBase:
                  if now - v[2] > 60.0 and now - probed.get(k, 0) > 60.0),
                 key=lambda k: sent[k][2])[:2]
             for k in stale:
-                try:
-                    client.key_value_try_get(k)
-                    probed[k] = now  # still undelivered; back off
-                except Exception:
+                state = _kv_key_state(client, k)
+                if state == 'absent':
                     del sent[k]  # consumed: nothing left to GC
                     probed.pop(k, None)
+                else:
+                    # present -> still undelivered; unknown (transient
+                    # store error) -> KEEP the record: dropping it
+                    # would permanently leak the key from the sweep
+                    probed[k] = now
 
     def recv_obj(self, source, tag=0, timeout=120.0, channel=None):
         """Blocking receive of the next object from process
@@ -366,15 +383,15 @@ class CommunicatorBase:
         for key in sorted(old):
             stream, seq, _ = old[key]
             try:
-                # distinguish consumed (receiver deleted it: cursor must
-                # NOT rewind) from undelivered (still present: delete
-                # and free its sequence slot for a retry)
-                present = True
-                try:
-                    client.key_value_try_get(key)
-                except Exception:
-                    present = False
-                if present:
+                # distinguish consumed (receiver deleted it: cursor
+                # must NOT rewind) from undelivered (still present:
+                # delete and free its sequence slot for a retry); a
+                # transient store error is NEITHER -- keep the record
+                # for a later sweep rather than mis-classifying
+                state = _kv_key_state(client, key)
+                if state == 'unknown':
+                    continue
+                if state == 'present':
                     client.key_value_delete(key)
                     swept_min[stream] = min(
                         swept_min.get(stream, seq), seq)
