@@ -134,7 +134,10 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
 
     ``model`` must have ``sequence_axis=None`` (pipeline shards the
     batch, not the sequence) and is used with ``train=False``
-    semantics (no dropout).
+    semantics (no dropout).  Use the updater's default gpipe
+    schedule: the returned ``loss_on_last`` psums masked token counts
+    over the data axis (global pad weighting), which 1f1b's
+    per-device loss vjp cannot transpose.
     """
     if model.sequence_axis is not None:
         raise ValueError('pipeline_parts shards the batch dimension; '

@@ -85,9 +85,60 @@ class Pipeline:
         return outputs
 
 
+_COLLECTIVE_PRIMS = frozenset((
+    'psum', 'pmin', 'pmax', 'ppermute', 'pbroadcast', 'all_to_all',
+    'all_gather', 'reduce_scatter', 'psum_scatter',
+    'psum_invariant'))
+
+
+def _jaxpr_collectives(jaxpr, found):
+    for eq in jaxpr.eqns:
+        if eq.primitive.name in _COLLECTIVE_PRIMS:
+            found.add(eq.primitive.name)
+        for v in eq.params.values():
+            inner = getattr(v, 'jaxpr', None)
+            if inner is not None:
+                _jaxpr_collectives(inner, found)
+            elif isinstance(v, (list, tuple)):
+                for vv in v:
+                    inner = getattr(vv, 'jaxpr', None)
+                    if inner is not None:
+                        _jaxpr_collectives(inner, found)
+
+
+def assert_collective_free(what, fn, *args):
+    """Trace-time guard: raise if ``fn(*args)``'s OUTPUTS depend on
+    collective primitives.  The 1F1B schedule takes per-device vjps
+    of the stage body, loss and prologue inside
+    ``shard_map(check_vma=False)``, where collective transposes are
+    silently WRONG (see the package AUTODIFF CAVEAT) -- fail loudly
+    instead of training on corrupt gradients.
+
+    The jaxpr is dead-code-eliminated down to the probed outputs
+    first: ``make_jaxpr`` records everything executed, so without DCE
+    a collective in a DISCARDED side value (e.g. pmean'd metrics the
+    probe's loss-only lambda drops -- never differentiated, perfectly
+    safe) would be a false positive."""
+    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
+    try:
+        from jax._src.interpreters import partial_eval as pe
+        jaxpr, _ = pe.dce_jaxpr(jaxpr, [True] * len(jaxpr.outvars))
+    except Exception:
+        pass  # private API moved: probe conservatively without DCE
+    found = set()
+    _jaxpr_collectives(jaxpr, found)
+    if found:
+        raise ValueError(
+            '%s contains collective primitives %s: the 1f1b schedule '
+            'differentiates it per device, where collective '
+            'transposes are incorrect -- use the gpipe schedule (or '
+            'make it collective-free)' % (what, sorted(found)))
+
+
 def pipeline_1f1b_grads(stage_fn, per_micro_loss, params_local,
                         x_microbatches, y_microbatches, n_stages,
-                        axis='stage'):
+                        axis='stage', extra=None,
+                        collect_input_cotangents=True):
     """One-forward-one-backward pipeline pass: returns
     ``(loss, metrics, grads_local)`` -- loss/metrics are MEANS over
     the ``n_micro`` micro-batches (no further division needed), valid
@@ -119,6 +170,20 @@ def pipeline_1f1b_grads(stage_fn, per_micro_loss, params_local,
     per device), and ``per_micro_loss(y, y_micro) -> (loss, metrics)``
     must decompose as a mean over micro-batches (standard mean losses
     do; the total is averaged over ``M`` here).
+
+    ``extra``: optional replicated pytree for heterogeneous ends.
+    ``per_micro_loss`` then takes ``(extra, y, y_micro)`` and the
+    return grows to ``(loss, metrics, grads_local, extra_grads,
+    x_cotangents)``: ``extra_grads`` is d(mean loss)/d(extra) through
+    the LOSS only, valid on the LAST stage (zeros elsewhere -- psum
+    over ``axis``); ``x_cotangents`` is the (M, ...) stack of
+    d(mean loss)/d(pipeline input micro), valid on STAGE 0 (zeros
+    elsewhere) -- feed it to the prologue's vjp to complete the
+    embedding backward.  Pass
+    ``collect_input_cotangents=False`` when there is no prologue to
+    feed: the (M, ...) buffer (note: O(n_micro) carry memory, unlike
+    the 2S-bounded activation ring) is then skipped entirely and
+    ``x_cotangents`` comes back empty.
     """
     S = n_stages
     M = x_microbatches.shape[0]
@@ -132,7 +197,8 @@ def pipeline_1f1b_grads(stage_fn, per_micro_loss, params_local,
     zero_grads = jax.tree_util.tree_map(jnp.zeros_like, params_local)
 
     def tick(carry, t):
-        state_f, state_b, ring, grads, loss_sum, metrics_sum = carry
+        (state_f, state_b, ring, grads, loss_sum, metrics_sum,
+         extra_grads, dx_buf) = carry
 
         # ---- forward slot (identical to the GPipe schedule)
         m_f = t - stage
@@ -161,12 +227,22 @@ def pipeline_1f1b_grads(stage_fn, per_micro_loss, params_local,
         # one loss evaluation (no reliance on CSE to dedupe).
         ym = y_microbatches[jnp.clip(m_b, 0, M - 1)]
 
-        def scaled_loss(yy):
-            loss_m, metrics_m = per_micro_loss(yy, ym)
-            return loss_m / M, (loss_m, metrics_m)
+        if extra is None:
+            def scaled_loss(yy):
+                loss_m, metrics_m = per_micro_loss(yy, ym)
+                return loss_m / M, (loss_m, metrics_m)
 
-        (_, (loss_m, metrics_m)), g_loss = jax.value_and_grad(
-            scaled_loss, has_aux=True)(y_re)
+            (_, (loss_m, metrics_m)), g_loss = jax.value_and_grad(
+                scaled_loss, has_aux=True)(y_re)
+            g_ex = None
+        else:
+            def scaled_loss(yy, e):
+                loss_m, metrics_m = per_micro_loss(e, yy, ym)
+                return loss_m / M, (loss_m, metrics_m)
+
+            (_, (loss_m, metrics_m)), (g_loss, g_ex) = \
+                jax.value_and_grad(scaled_loss, argnums=(0, 1),
+                                   has_aux=True)(y_re, extra)
         g_in = jnp.where(is_last, g_loss.astype(state_b.dtype), state_b)
         dp, dx = vjp(g_in.astype(y_re.dtype))
         grads = jax.tree_util.tree_map(
@@ -177,6 +253,22 @@ def pipeline_1f1b_grads(stage_fn, per_micro_loss, params_local,
         metrics_sum = jax.tree_util.tree_map(
             lambda acc, v: acc + jnp.where(emit, v, jnp.zeros_like(v)),
             metrics_sum, metrics_m)
+        if extra is not None:
+            # head/epilogue grads: last stage's valid bwd ticks only
+            extra_grads = jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(
+                    emit, g, jnp.zeros_like(g)), extra_grads, g_ex)
+        if extra is not None and collect_input_cotangents:
+            # pipeline-input cotangent: stage 0's valid bwd ticks --
+            # stash micro m_b's dx so the caller can run the prologue
+            # backward once the scan is done
+            idx = jnp.clip(m_b, 0, M - 1)
+            cur = lax.dynamic_index_in_dim(dx_buf, idx, 0,
+                                           keepdims=False)
+            take = jnp.logical_and(stage == 0, bwd_valid)
+            dx_buf = lax.dynamic_update_index_in_dim(
+                dx_buf, jnp.where(take, dx.astype(dx_buf.dtype), cur),
+                idx, 0)
 
         # ---- rotate: activations forward, cotangents backward
         state_f = lax.ppermute(y, axis, perm_fwd)
@@ -184,27 +276,41 @@ def pipeline_1f1b_grads(stage_fn, per_micro_loss, params_local,
             jnp.where(bwd_valid, dx, jnp.zeros_like(dx)), axis,
             perm_bwd)
         return (state_f, state_b, ring, grads, loss_sum,
-                metrics_sum), None
+                metrics_sum, extra_grads, dx_buf), None
 
     # shape/zero templates (homogeneous pipelines: y shape == x shape)
     y0 = jax.eval_shape(lambda: stage_fn(params_local, act_shape))
     state_f0 = jnp.zeros(y0.shape, act_shape.dtype)
     state_b0 = jnp.zeros(act_shape.shape, act_shape.dtype)
     ring0 = jnp.zeros((B,) + act_shape.shape, act_shape.dtype)
-    l0, m0 = jax.eval_shape(
-        lambda: per_micro_loss(state_f0, y_microbatches[0]))
+    if extra is None:
+        l0, m0 = jax.eval_shape(
+            lambda: per_micro_loss(state_f0, y_microbatches[0]))
+        extra_grads0 = None
+        dx_buf0 = jnp.zeros((0,), act_shape.dtype)  # unused slot
+    else:
+        l0, m0 = jax.eval_shape(
+            lambda: per_micro_loss(extra, state_f0,
+                                   y_microbatches[0]))
+        extra_grads0 = jax.tree_util.tree_map(jnp.zeros_like, extra)
+        dx_buf0 = (jnp.zeros((M,) + act_shape.shape, act_shape.dtype)
+                   if collect_input_cotangents
+                   else jnp.zeros((0,), act_shape.dtype))
     loss0 = jnp.zeros(l0.shape, l0.dtype)
     metrics0 = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), m0)
 
-    (state_f, state_b, ring, grads, loss_sum, metrics_sum), _ = \
+    (state_f, state_b, ring, grads, loss_sum, metrics_sum,
+     extra_grads, dx_buf), _ = \
         lax.scan(tick,
                  (state_f0, state_b0, ring0, zero_grads, loss0,
-                  metrics0),
+                  metrics0, extra_grads0, dx_buf0),
                  jnp.arange(total_ticks))
     loss = loss_sum / M
     metrics = jax.tree_util.tree_map(lambda v: v / M, metrics_sum)
-    return loss, metrics, grads
+    if extra is None:
+        return loss, metrics, grads
+    return loss, metrics, grads, extra_grads, dx_buf
 
 
 def stack_stage_params(params_per_stage):

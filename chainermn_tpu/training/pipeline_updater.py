@@ -43,9 +43,29 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.parallel.pipeline import (
-    Pipeline, microbatch, pipeline_1f1b_grads)
+    Pipeline, assert_collective_free, microbatch, pipeline_1f1b_grads)
 from chainermn_tpu.training.convert import concat_examples
 from chainermn_tpu.training.placement import owned_device_put
+
+
+def _assert_1f1b_safe(loss_probe, loss_args, stage_fn, p_local,
+                      act_micro, prologue=None, extra=None, x=None):
+    """Trace-time probes: the 1f1b schedule takes per-device vjps of
+    the stage body, loss and prologue, so any of them containing a
+    collective in a DIFFERENTIATED output would train on silently
+    mis-transposed gradients (e.g.
+    ``models.transformer.pipeline_parts``'s loss psums over the data
+    axis -- that composition needs gpipe).  Fail loudly instead.
+    ``loss_probe(*loss_args)`` must return the loss scalar only
+    (metrics are aux, never differentiated, and may psum freely)."""
+    assert_collective_free("loss_on_last under schedule='1f1b'",
+                           loss_probe, *loss_args)
+    assert_collective_free(
+        "stage_fn under schedule='1f1b'", stage_fn, p_local,
+        act_micro)
+    if prologue is not None:
+        assert_collective_free(
+            "prologue under schedule='1f1b'", prologue, extra, x)
 
 AXIS_DATA = 'data'
 AXIS_STAGE = 'stage'
@@ -126,9 +146,12 @@ class PipelineUpdater:
       extra_params: replicated parameter pytree for the heterogeneous
         ends of a real model (embedding table, final norm, head),
         trained jointly with the stage-stacked body; ``loss_on_last``
-        then takes ``(extra, outputs, y_micro)``.  gpipe schedule
-        only (1f1b discards the stage-0 input cotangent the prologue
-        backward needs).
+        then takes ``(extra, outputs, y_micro)``.  Works under BOTH
+        schedules; under 1f1b the loss and prologue must be
+        collective-free like the stage body (their vjps are taken
+        per device -- a loss that psums over the data axis, such as
+        :func:`~chainermn_tpu.models.transformer.pipeline_parts`'s,
+        needs gpipe).
       param_specs: optional pytree of ``PartitionSpec`` (matching
         ``params_stacked``, every spec leading with ``'stage'``) that
         ADDS sharded axes beyond the stage axis -- e.g.
@@ -165,12 +188,6 @@ class PipelineUpdater:
                     'every param spec must lead with the stage axis '
                     "(P('stage', ...)), got %r" % (bad[:3],))
         extra_used = extra_params is not None
-        if extra_used and schedule == '1f1b':
-            raise ValueError(
-                "extra_params/prologue require schedule='gpipe': the "
-                "1f1b schedule hand-propagates cotangents per stage "
-                'and discards the stage-0 input cotangent the '
-                'prologue backward needs')
         if prologue is not None and not extra_used:
             raise ValueError('prologue requires extra_params (pass an '
                              'empty dict if it is parameter-free)')
@@ -368,7 +385,7 @@ class PipelineUpdater:
         # runs on each stage's complete local tree in the same program.
         stage_spec = P(AXIS_STAGE)
 
-        def device_step_1f1b(params, opt_state, x, y):
+        def device_step_1f1b(params, extra, opt_state, x, y):
             p_local = jax.tree_util.tree_map(lambda a: a[0], params)
             # squeeze only the stage-stacked optimizer leaves; scalar
             # leaves (replicated, spec P()) pass through untouched
@@ -376,25 +393,70 @@ class PipelineUpdater:
                 lambda a, sp: a[0] if sp == stage_spec else a,
                 opt_state, opt_specs)
 
-            def per_micro_loss(yy, ym):
-                return loss_on_last(yy[None], ym[None])
+            if extra_used:
+                y_m = microbatch(y, n_micro_)
 
-            loss, metrics, grads = pipeline_1f1b_grads(
-                stage_fn, per_micro_loss, p_local,
-                microbatch(x, n_micro_), microbatch(y, n_micro_),
-                n_stages, axis=AXIS_STAGE)
-            grads = lax.pmean(grads, AXIS_DATA)
-            updates, s_local = optimizer.update(grads, s_local,
-                                                p_local)
-            new_p = optax.apply_updates(p_local, updates)
+                def per_micro_loss(e, yy, ym):
+                    return loss_on_last(e, yy[None], ym[None])
+
+                if prologue is not None:
+                    # ONE prologue forward: jax.vjp's primal IS the
+                    # activation stack fed to the pipeline (no
+                    # reliance on CSE to dedupe a second trace)
+                    acts_m, vjp_pro = jax.vjp(
+                        lambda e: microbatch(prologue(e, x),
+                                             n_micro_), extra)
+                else:
+                    acts_m = microbatch(x, n_micro_)
+                _assert_1f1b_safe(
+                    lambda e, yy, ym: per_micro_loss(e, yy, ym)[0],
+                    (extra, acts_m[0], y_m[0]), stage_fn, p_local,
+                    acts_m[0], prologue=prologue, extra=extra, x=x)
+                loss, metrics, grads, g_extra, dx_buf = \
+                    pipeline_1f1b_grads(
+                        stage_fn, per_micro_loss, p_local,
+                        acts_m, y_m, n_stages, axis=AXIS_STAGE,
+                        extra=extra,
+                        collect_input_cotangents=prologue is not None)
+                if prologue is not None:
+                    # complete the embedding backward: the scan
+                    # collected d(loss)/d(pipeline input micro) on
+                    # stage 0 (zeros elsewhere)
+                    (g_pro,) = vjp_pro(dx_buf.astype(acts_m.dtype))
+                    g_extra = jax.tree_util.tree_map(
+                        lambda a, b: a + b, g_extra, g_pro)
+                # head grads live on the last stage, prologue grads
+                # on stage 0, zeros elsewhere: psum over stage sums
+                # the disjoint contributions, pmean over data averages
+                g_extra = lax.pmean(
+                    lax.psum(g_extra, AXIS_STAGE), AXIS_DATA)
+                grads = lax.pmean(grads, AXIS_DATA)
+                tree = {'stages': p_local, 'extra': extra}
+                gtree = {'stages': grads, 'extra': g_extra}
+            else:
+                def per_micro_loss(yy, ym):
+                    return loss_on_last(yy[None], ym[None])
+
+                x_m = microbatch(x, n_micro_)
+                y_m = microbatch(y, n_micro_)
+                _assert_1f1b_safe(
+                    lambda yy, ym: per_micro_loss(yy, ym)[0],
+                    (x_m[0], y_m[0]), stage_fn, p_local, x_m[0])
+                loss, metrics, grads = pipeline_1f1b_grads(
+                    stage_fn, per_micro_loss, p_local, x_m, y_m,
+                    n_stages, axis=AXIS_STAGE)
+                grads = lax.pmean(grads, AXIS_DATA)
+                tree, gtree = p_local, grads
+            updates, s_local = optimizer.update(gtree, s_local, tree)
+            new_tree = optax.apply_updates(tree, updates)
             # trace-time guard: a mis-sharded optimizer-state leaf
             # (e.g. a replicated vector broadcasting against
             # stage-local scalars) corrupts param shapes silently --
             # fail loudly instead
             bad = [
                 (a.shape, b.shape) for a, b in zip(
-                    jax.tree_util.tree_leaves(p_local),
-                    jax.tree_util.tree_leaves(new_p))
+                    jax.tree_util.tree_leaves(tree),
+                    jax.tree_util.tree_leaves(new_tree))
                 if a.shape != b.shape]
             if bad:
                 raise ValueError(
@@ -402,7 +464,11 @@ class PipelineUpdater:
                     'optimizer-state leaf is sharded inconsistently '
                     'with the stage axis (see the opt_specs rule in '
                     'PipelineUpdater.__init__)' % (bad,))
-            p_local = new_p
+            if extra_used:
+                p_local = new_tree['stages']
+                new_extra = new_tree['extra']
+            else:
+                p_local, new_extra = new_tree, extra
             onlast = lax.axis_index(AXIS_STAGE) == n_stages - 1
             loss = lax.pmean(
                 lax.psum(jnp.where(onlast, loss, 0.0), AXIS_STAGE),
@@ -415,18 +481,15 @@ class PipelineUpdater:
             s_out = jax.tree_util.tree_map(
                 lambda a, sp: a[None] if sp == stage_spec else a,
                 s_local, opt_specs)
-            return p_out, s_out, dict(metrics, loss=loss)
+            return p_out, new_extra, s_out, dict(metrics, loss=loss)
 
         def train_step_1f1b(params, extra, opt_state, x, y):
-            # extra is always None here (enforced above); threaded
-            # through for the uniform _step signature
-            p, s, metrics = jax.shard_map(
+            return jax.shard_map(
                 device_step_1f1b, mesh=mesh,
-                in_specs=(P(AXIS_STAGE), opt_specs,
+                in_specs=(P(AXIS_STAGE), P(), opt_specs,
                           P(AXIS_DATA), P(AXIS_DATA)),
-                out_specs=(P(AXIS_STAGE), opt_specs, P()),
-                check_vma=False)(params, opt_state, x, y)
-            return p, extra, s, metrics
+                out_specs=(P(AXIS_STAGE), P(), opt_specs, P()),
+                check_vma=False)(params, extra, opt_state, x, y)
 
         if donate:
             kw = {'donate_argnums': (0, 1, 2) if extra_used

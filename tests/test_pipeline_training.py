@@ -378,11 +378,15 @@ def test_donate_does_not_delete_caller_arrays():
     assert np.all(np.isfinite(got2['w']))
 
 
-def test_pipeline_heterogeneous_ends_match_sequential():
+@pytest.mark.parametrize('schedule', ['gpipe', '1f1b'])
+def test_pipeline_heterogeneous_ends_match_sequential(schedule):
     """prologue + extra_params: an embedding front and a head/loss
     back with their own trained parameters, wrapped around the
     stage-stacked body -- one pipelined step must equal one step of
-    the sequentially composed model (body grads AND end grads)."""
+    the sequentially composed model (body grads AND end grads), for
+    BOTH schedules (1f1b accumulates head grads on the last stage and
+    completes the embedding backward from the collected stage-0 input
+    cotangents)."""
     mesh = pipeline_mesh(N_STAGES)
     params_list = make_params()
     rng = np.random.RandomState(7)
@@ -409,7 +413,7 @@ def test_pipeline_heterogeneous_ends_match_sequential():
     upd = PipelineUpdater(iter([]), opt, stage_fn, loss_with_head,
                           stack_stage_params(params_list), mesh,
                           n_micro=4, donate=False, prologue=prologue,
-                          extra_params=extra)
+                          extra_params=extra, schedule=schedule)
     metrics = upd.update_core(upd.shard_batch(
         [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
     loss_pipe = float(metrics['loss'])
@@ -447,11 +451,6 @@ def test_pipeline_heterogeneous_ends_match_sequential():
                                np.asarray(ref['extra']['Wh']),
                                rtol=1e-5, atol=1e-6)
     # config errors are loud
-    with pytest.raises(ValueError, match='gpipe'):
-        PipelineUpdater(iter([]), opt, stage_fn, loss_with_head,
-                        stack_stage_params(params_list), mesh,
-                        n_micro=4, schedule='1f1b', prologue=prologue,
-                        extra_params=extra, schedule_check=False)
     with pytest.raises(ValueError, match='extra_params'):
         PipelineUpdater(iter([]), opt, stage_fn, loss_on_last,
                         stack_stage_params(params_list), mesh,
@@ -727,3 +726,53 @@ def test_pipeline_tensor_parallel_composed():
         PipelineUpdater(iter([]), opt, tp_stage, loss_on_last,
                         stacked, mesh, n_micro=4, schedule='1f1b',
                         schedule_check=False, param_specs=specs)
+
+
+def test_1f1b_rejects_collective_loss():
+    """A loss containing a collective (e.g. pipeline_parts' data-axis
+    psum) must fail LOUDLY under 1f1b -- its per-device vjp would
+    silently mis-transpose."""
+    mesh = pipeline_mesh(N_STAGES)
+    x, y = _data()
+    extra = {'Wh': jnp.zeros((DIM, N_CLASSES), jnp.float32)}
+
+    def collective_loss(e, outs, ym):
+        logits = outs.reshape(-1, DIM) @ e['Wh']
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, ym.reshape(-1)).mean()
+        return jax.lax.pmean(loss, 'data'), {}
+
+    upd = PipelineUpdater(iter([]), optax.sgd(0.1), stage_fn,
+                          collective_loss,
+                          stack_stage_params(make_params()), mesh,
+                          n_micro=4, donate=False, schedule='1f1b',
+                          extra_params=extra)
+    with pytest.raises(ValueError, match='collective'):
+        upd.update_core(upd.shard_batch(
+            [(np.asarray(x[i]), np.asarray(y[i]))
+             for i in range(len(x))]))
+
+
+def test_1f1b_accepts_collective_metrics():
+    """Collectives in the METRICS (aux, never differentiated) are
+    safe under 1f1b and must NOT trip the guard: the probe DCEs the
+    jaxpr down to the loss output before scanning."""
+    mesh = pipeline_mesh(N_STAGES)
+    x, y = _data()
+
+    def loss_with_psum_metrics(outs, ym):
+        logits = outs.reshape(-1, DIM)
+        yy = ym.reshape(-1)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yy).mean()
+        acc = jnp.mean((jnp.argmax(logits, -1) == yy).astype(
+            jnp.float32))
+        return loss, {'acc_global': jax.lax.pmean(acc, 'data')}
+
+    upd = PipelineUpdater(iter([]), optax.sgd(0.1), stage_fn,
+                          loss_with_psum_metrics,
+                          stack_stage_params(make_params()), mesh,
+                          n_micro=4, donate=False, schedule='1f1b')
+    m = upd.update_core(upd.shard_batch(
+        [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
+    assert np.isfinite(float(m['loss']))
