@@ -19,6 +19,8 @@ Usage::
 
     python examples/lm/train_lm_pipeline.py --cpu --quick   # CPU mesh
     python examples/lm/train_lm_pipeline.py --stages 4      # TPU
+    python examples/lm/train_lm_pipeline.py --cpu --quick \\
+        --stages 2 --tp 2   # 3-D: data x stage x tp (Megatron stages)
 """
 
 import argparse
@@ -32,6 +34,98 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__),
 import numpy as np
 
 from train_lm import synthetic_tokens
+
+
+def _tp_parts(args, n_stages):
+    """3-D variant: each stage is ONE Megatron tp_transformer_block
+    whose weights are sharded over the 'tp' mesh axis (heads for the
+    attention, columns/rows for the MLP); embed/pos/final-norm/head
+    stay replicated extras.  Per-leaf specs lead with 'stage' and add
+    the tp axis per Megatron convention."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from chainermn_tpu import ops
+    from chainermn_tpu.parallel import tp_transformer_block
+    from chainermn_tpu.parallel.pipeline import stack_stage_params
+
+    d = args.d_model
+    h = args.n_heads
+    dh = d // h
+    ff = 4 * d
+    L = args.layers_per_stage
+    if h % args.tp:
+        raise SystemExit('tp must divide n-heads (tp_attention '
+                         'shards heads across the tp axis)')
+    rng = np.random.RandomState(0)
+
+    def block_params():
+        return {
+            'ln1_scale': jnp.ones((d,)), 'ln1_bias': jnp.zeros((d,)),
+            'wqkv': jnp.asarray(rng.randn(d, 3, h, dh)
+                                * d ** -0.5, jnp.float32),
+            'wo': jnp.asarray(rng.randn(h * dh, d) * d ** -0.5,
+                              jnp.float32),
+            'bo': jnp.zeros((d,), jnp.float32),
+            'ln2_scale': jnp.ones((d,)), 'ln2_bias': jnp.zeros((d,)),
+            'w_in': jnp.asarray(rng.randn(d, ff) * d ** -0.5,
+                                jnp.float32),
+            'b_in': jnp.zeros((ff,), jnp.float32),
+            'w_out': jnp.asarray(rng.randn(ff, d) * ff ** -0.5,
+                                 jnp.float32),
+            'b_out': jnp.zeros((d,), jnp.float32),
+        }
+
+    # L blocks per stage: layer dim stacked INSIDE the stage dim, so
+    # every tp axis in the specs shifts one position right
+    stacked = stack_stage_params([
+        jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls),
+            *[block_params() for _ in range(L)])
+        for _ in range(n_stages)])
+    param_specs = {
+        'ln1_scale': P('stage'), 'ln1_bias': P('stage'),
+        'wqkv': P('stage', None, None, None, 'tp'),
+        'wo': P('stage', None, 'tp'), 'bo': P('stage'),
+        'ln2_scale': P('stage'), 'ln2_bias': P('stage'),
+        'w_in': P('stage', None, None, 'tp'),
+        'b_in': P('stage', None, 'tp'),
+        'w_out': P('stage', None, 'tp', None), 'b_out': P('stage'),
+    }
+    extra = {
+        'embed': jnp.asarray(rng.randn(args.vocab, d) * 0.02,
+                             jnp.float32),
+        'pos': jnp.asarray(rng.randn(args.seq_len, d) * 0.02,
+                           jnp.float32),
+        'lnf_g': jnp.ones((d,), jnp.float32),
+        'lnf_b': jnp.zeros((d,), jnp.float32),
+        'head': jnp.asarray(rng.randn(d, args.vocab) * 0.02,
+                            jnp.float32),
+    }
+
+    def stage_fn(p_stage, x):
+        for j in range(L):
+            bp = jax.tree_util.tree_map(lambda a: a[j], p_stage)
+            x = tp_transformer_block(x, bp, 'tp', n_heads=h)
+        return x
+
+    def prologue(e, tokens):
+        return e['embed'][tokens] + e['pos'][None, :tokens.shape[1]]
+
+    def loss_on_last(e, outs, y_micro):
+        hh = ops.layer_norm(outs.reshape(-1, d), e['lnf_g'],
+                            e['lnf_b'])
+        logits = hh @ e['head']
+        yy = y_micro.reshape(-1)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, yy).mean()
+        perp = jnp.exp(jnp.minimum(loss, 20.0))
+        return loss, {'perp': perp}
+
+    return (stage_fn, prologue, loss_on_last, stacked, extra,
+            param_specs)
 
 
 def main():
@@ -49,6 +143,10 @@ def main():
                         'min 2)')
     p.add_argument('--micro', type=int, default=4,
                    help='micro-batches per step')
+    p.add_argument('--tp', type=int, default=1,
+                   help='tensor-parallel width: >1 adds a tp mesh '
+                        'axis and Megatron-shards each stage block '
+                        '(3-D PP x TP x DP)')
     p.add_argument('--lr', type=float, default=3e-4)
     p.add_argument('--cpu', action='store_true')
     p.add_argument('--quick', action='store_true')
@@ -70,27 +168,33 @@ def main():
         args.steps = min(args.steps, 40)
         args.seq_len = min(args.seq_len, 128)
 
+    if args.tp < 1:
+        raise SystemExit('--tp must be >= 1')
     n_dev = len(jax.devices())
-    n_stages = args.stages or max(2, n_dev // 2)
-    mesh = pipeline_mesh(n_stages)
+    n_stages = args.stages or max(2, n_dev // (2 * args.tp))
+    mesh = pipeline_mesh(n_stages, n_tp=args.tp)
     n_layers = n_stages * args.layers_per_stage
-    print('mesh: data=%d x stage=%d  (%d layers, %d per stage)'
-          % (mesh.shape['data'], n_stages, n_layers,
-             args.layers_per_stage))
+    print('mesh: %s  (%d layers, %d per stage)'
+          % (dict(mesh.shape), n_layers, args.layers_per_stage))
 
-    # the REAL model class, split by the canonical bridge: block
-    # stack -> stage-sharded body, embed/pos/final-norm/head ->
-    # replicated extras (the pipelined composition computes exactly
-    # model.apply with the same parameters)
-    model = TransformerLM(
-        vocab_size=args.vocab, d_model=args.d_model,
-        n_heads=args.n_heads, n_layers=n_layers,
-        d_ff=4 * args.d_model, max_len=args.seq_len,
-        dtype=jnp.float32)
-    tokens0 = jnp.zeros((1, args.seq_len), jnp.int32)
-    params = model.init(jax.random.PRNGKey(0), tokens0)['params']
-    stage_fn, prologue, loss_on_last, stacked, extra = pipeline_parts(
-        model, params, n_stages)
+    if args.tp == 1:
+        # the REAL model class, split by the canonical bridge: block
+        # stack -> stage-sharded body, embed/pos/final-norm/head ->
+        # replicated extras (the pipelined composition computes
+        # exactly model.apply with the same parameters)
+        model = TransformerLM(
+            vocab_size=args.vocab, d_model=args.d_model,
+            n_heads=args.n_heads, n_layers=n_layers,
+            d_ff=4 * args.d_model, max_len=args.seq_len,
+            dtype=jnp.float32)
+        tokens0 = jnp.zeros((1, args.seq_len), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), tokens0)['params']
+        stage_fn, prologue, loss_on_last, stacked, extra = \
+            pipeline_parts(model, params, n_stages)
+        param_specs = None
+    else:
+        stage_fn, prologue, loss_on_last, stacked, extra, \
+            param_specs = _tp_parts(args, n_stages)
 
     corpus = synthetic_tokens(
         args.batchsize * (args.seq_len + 1) * 8, args.vocab,
@@ -107,7 +211,8 @@ def main():
     upd = PipelineUpdater(
         iter([]), optax.adamw(args.lr, weight_decay=0.01), stage_fn,
         loss_on_last, stacked, mesh, n_micro=args.micro,
-        prologue=prologue, extra_params=extra)
+        prologue=prologue, extra_params=extra,
+        param_specs=param_specs)
 
     t0 = time.time()
     first = None
@@ -126,11 +231,14 @@ def main():
     if final >= first:
         raise SystemExit('loss did not improve')
 
-    # ---- memory-scaling evidence: per-device stage shard vs total
-    n_body = sum(int(np.prod(l.shape))
-                 for l in jax.tree_util.tree_leaves(upd.params))
-    print('body params: %.2fM total, %.2fM per device (1/%d shard)'
-          % (n_body / 1e6, n_body / 1e6 / n_stages, n_stages))
+    # ---- memory-scaling evidence: exact per-device shard sizes
+    leaves = jax.tree_util.tree_leaves(upd.params)
+    n_body = sum(int(np.prod(l.shape)) for l in leaves)
+    n_local = sum(int(np.prod(l.sharding.shard_shape(l.shape)))
+                  for l in leaves)
+    print('body params: %.2fM total, %.2fM per device (1/%.1f)'
+          % (n_body / 1e6, n_local / 1e6,
+             n_body / max(n_local, 1)))
 
 
 if __name__ == '__main__':
