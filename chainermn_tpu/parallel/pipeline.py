@@ -87,8 +87,8 @@ class Pipeline:
 
 _COLLECTIVE_PRIMS = frozenset((
     'psum', 'pmin', 'pmax', 'ppermute', 'pbroadcast', 'all_to_all',
-    'all_gather', 'reduce_scatter', 'psum_scatter',
-    'psum_invariant'))
+    'ragged_all_to_all', 'all_gather', 'reduce_scatter',
+    'psum_scatter', 'psum_invariant'))
 
 
 def _jaxpr_collectives(jaxpr, found):
@@ -118,7 +118,15 @@ def assert_collective_free(what, fn, *args):
     first: ``make_jaxpr`` records everything executed, so without DCE
     a collective in a DISCARDED side value (e.g. pmean'd metrics the
     probe's loss-only lambda drops -- never differentiated, perfectly
-    safe) would be a false positive."""
+    safe) would be a false positive.
+
+    KNOWN BLIND SPOT: the scan sees through jaxpr-carrying params
+    (scan/cond/closed calls and ``custom_vjp`` FORWARDS) but a
+    ``custom_vjp``'s backward rule is an opaque callable -- a custom
+    op whose bwd itself performs a collective passes the probe.  The
+    repo's own custom-vjp kernels (flash attention, fused LN/CE) have
+    collective-free backwards; audit any new one before using it in a
+    1f1b stage body."""
     jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
     try:
         from jax._src.interpreters import partial_eval as pe

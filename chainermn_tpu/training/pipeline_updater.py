@@ -127,7 +127,11 @@ class PipelineUpdater:
         ``2 * n_stages`` regardless of ``n_micro``, recompute built in.
         1f1b requires a collective-free ``stage_fn`` and a
         ``loss_on_last`` that decomposes as a mean over micro-batches
-        (standard mean losses do).  GRADIENTS are identical between
+        (standard mean losses do; NONLINEAR metrics differ between
+        schedules by Jensen -- gpipe evaluates them once on the full
+        micro-batch stack, 1f1b averages per-micro values, so e.g.
+        perplexity reads slightly higher under 1f1b).  GRADIENTS are
+        identical between
         schedules (``tests/test_pipeline_training.py``); identical
         PARAMETER trajectories additionally require an ELEMENTWISE
         optimizer -- under 1f1b the optimizer sees each stage's local
@@ -177,16 +181,26 @@ class PipelineUpdater:
                     "(e.g. tensor-parallel psum), and 1f1b's "
                     'hand-propagated backward requires a '
                     'collective-free stage body')
+            spec_leaves = jax.tree_util.tree_leaves(
+                param_specs, is_leaf=lambda v: isinstance(v, P))
             bad = [
-                sp for sp in jax.tree_util.tree_leaves(
-                    param_specs,
-                    is_leaf=lambda v: isinstance(v, P))
+                sp for sp in spec_leaves
                 if not (isinstance(sp, P) and len(sp) >= 1
                         and sp[0] == AXIS_STAGE)]
             if bad:
                 raise ValueError(
                     'every param spec must lead with the stage axis '
                     "(P('stage', ...)), got %r" % (bad[:3],))
+            n_p = len(jax.tree_util.tree_leaves(params_stacked))
+            if len(spec_leaves) != n_p:
+                # a pytree PREFIX would device_put/shard_map fine but
+                # silently mis-pair the per-leaf spec table the
+                # optimizer-state placement is derived from
+                raise ValueError(
+                    'param_specs must be LEAF-EXACT (one PartitionSpec '
+                    'per params leaf): got %d specs for %d leaves -- '
+                    'expand the prefix with jax.tree_util.tree_map'
+                    % (len(spec_leaves), n_p))
         extra_used = extra_params is not None
         if prologue is not None and not extra_used:
             raise ValueError('prologue requires extra_params (pass an '
@@ -501,11 +515,13 @@ class PipelineUpdater:
             **kw)
         # forward-only path for evaluation: same pipeline schedule and
         # loss, NO gradient/optimizer (params not donated)
-        self._eval = jax.jit(
-            lambda params, extra, x, y: mapped_loss(params, extra,
-                                                    x, y))
+        self._eval = jax.jit(mapped_loss)
 
     def shard_batch(self, batch):
+        """Collate and place a batch sharded over the data axis.
+        Dict examples flatten in INSERTION order -- the positional
+        (x, y) contract of the train step follows that order (same
+        convention as ``StandardUpdater.shard_batch``)."""
         arrays = concat_examples(batch)
         if isinstance(arrays, dict):
             arrays = tuple(arrays.values())

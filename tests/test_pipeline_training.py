@@ -722,6 +722,11 @@ def test_pipeline_tensor_parallel_composed():
         PipelineUpdater(iter([]), opt, tp_stage, loss_on_last,
                         stacked, mesh, n_micro=4,
                         param_specs={k: P('tp') for k in specs})
+    with pytest.raises(ValueError, match='LEAF-EXACT'):
+        # a pytree PREFIX would silently mis-pair the spec table
+        PipelineUpdater(iter([]), opt, tp_stage, loss_on_last,
+                        stacked, mesh, n_micro=4,
+                        param_specs={'w_in': P('stage', None, 'tp')})
     with pytest.raises(ValueError, match='gpipe'):
         PipelineUpdater(iter([]), opt, tp_stage, loss_on_last,
                         stacked, mesh, n_micro=4, schedule='1f1b',
