@@ -56,14 +56,15 @@ _COMMUNICATORS = {
 
 
 def create_communicator(communicator_name='xla', mesh=None, mesh_shape=None,
-                        devices=None):
+                        devices=None, **kwargs):
     """Create a communicator by strategy name.
 
     Parity with ``chainermn.create_communicator(name, mpi_comm)``
     (reference ``communicators/__init__.py:22-34``); ``mesh``/
     ``mesh_shape``/``devices`` replace the ``mpi_comm`` argument (the
     default -- discover all global devices -- replaces
-    ``MPI.COMM_WORLD``).
+    ``MPI.COMM_WORLD``).  Extra keyword arguments pass through to the
+    strategy (e.g. ``bucket_mb`` for ``'bucketed'``).
     """
     try:
         cls = _COMMUNICATORS[communicator_name]
@@ -71,4 +72,5 @@ def create_communicator(communicator_name='xla', mesh=None, mesh_shape=None,
         raise ValueError(
             'Unrecognized communicator: %r (choose from %s)'
             % (communicator_name, ', '.join(sorted(_COMMUNICATORS))))
-    return cls(mesh=mesh, mesh_shape=mesh_shape, devices=devices)
+    return cls(mesh=mesh, mesh_shape=mesh_shape, devices=devices,
+               **kwargs)
