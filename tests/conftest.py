@@ -24,3 +24,18 @@ import jax  # noqa: E402
 
 jax.config.update('jax_platforms', _platform)
 jax.config.update('jax_default_matmul_precision', 'highest')
+
+
+def hlo_collective_counts(fn, mesh, in_specs, out_specs, ops, *args):
+    """Count collective-op mentions in the StableHLO a shard_mapped
+    ``fn`` lowers to -- the shared primitive behind the
+    lowering-signature pin tests (single place to patch if a JAX
+    upgrade changes lowering text)."""
+    import re
+
+    import jax
+
+    txt = jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False)).lower(*args).as_text()
+    return {k: len(re.findall(k, txt)) for k in ops}

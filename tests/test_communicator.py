@@ -230,7 +230,7 @@ def test_strategy_lowerings_are_distinct():
     """Compiler-level proof that the strategies are REAL different
     lowerings, not aliases: the StableHLO each emits for the same
     gradient pytree carries its documented collective signature."""
-    import re
+    from conftest import hlo_collective_counts
 
     grads = {'a': jnp.ones((4096,), jnp.float32),
              'b': jnp.ones((128, 32), jnp.float32),
@@ -239,13 +239,9 @@ def test_strategy_lowerings_are_distinct():
     def counts(name, **kwargs):
         comm = chainermn_tpu.create_communicator(
             name, mesh_shape=(2, 4), **kwargs)
-        fn = jax.jit(jax.shard_map(
-            lambda g: comm.allreduce_grad(g), mesh=comm.mesh,
-            in_specs=(P(),), out_specs=P(), check_vma=False))
-        txt = fn.lower(grads).as_text()
-        return {k: len(re.findall(k, txt))
-                for k in ('all_reduce', 'reduce_scatter',
-                          'all_gather')}
+        return hlo_collective_counts(
+            lambda g: comm.allreduce_grad(g), comm.mesh, (P(),), P(),
+            ('all_reduce', 'reduce_scatter', 'all_gather'), grads)
 
     # naive: one collective PER LEAF
     assert counts('naive')['all_reduce'] == len(grads)

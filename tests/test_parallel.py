@@ -723,3 +723,61 @@ def test_dp_tp_composed_training_step():
         np.testing.assert_allclose(np.asarray(new_p[k]),
                                    np.asarray(new_ref[k]),
                                    rtol=2e-3, atol=2e-4)
+
+
+def test_block_collective_budgets():
+    """The documented communication budgets, pinned from StableHLO:
+    the Megatron block costs exactly TWO all_reduce (one per
+    column->row pair), ulysses exactly FOUR all_to_all (q/k/v in,
+    output back), and ring only collective_permutes (two per scan
+    body: the k and v rotation)."""
+    from chainermn_tpu.parallel import (ring_attention,
+                                        tp_transformer_block,
+                                        ulysses_attention)
+
+    mesh = _mesh((8,), ('tp',))
+    b, t, h, dh, d, ff = 2, 16, 8, 4, 32, 64
+    rng = np.random.RandomState(2)
+    params = {
+        'ln1_scale': jnp.ones((d,)), 'ln1_bias': jnp.zeros((d,)),
+        'wqkv': jnp.asarray(rng.randn(d, 3, h, dh) * 0.2,
+                            jnp.float32),
+        'wo': jnp.asarray(rng.randn(h * dh, d) * 0.2, jnp.float32),
+        'bo': jnp.zeros((d,)), 'ln2_scale': jnp.ones((d,)),
+        'ln2_bias': jnp.zeros((d,)),
+        'w_in': jnp.asarray(rng.randn(d, ff) * 0.2, jnp.float32),
+        'b_in': jnp.zeros((ff,)),
+        'w_out': jnp.asarray(rng.randn(ff, d) * 0.2, jnp.float32),
+        'b_out': jnp.zeros((d,))}
+    specs = {'ln1_scale': P(), 'ln1_bias': P(),
+             'wqkv': P(None, None, 'tp'), 'wo': P('tp'), 'bo': P(),
+             'ln2_scale': P(), 'ln2_bias': P(),
+             'w_in': P(None, 'tp'), 'b_in': P('tp'),
+             'w_out': P('tp'), 'b_out': P()}
+
+    from conftest import hlo_collective_counts
+
+    def collectives(fn, in_specs, out_specs, *args):
+        return hlo_collective_counts(
+            fn, mesh, in_specs, out_specs,
+            ('all_reduce', 'all_to_all', 'collective_permute'), *args)
+
+    x = jnp.ones((b, t, d), jnp.float32)
+    c = collectives(
+        lambda xx, p: tp_transformer_block(xx, p, 'tp', n_heads=h),
+        (P(), specs), P(), x, params)
+    assert c == {'all_reduce': 2, 'all_to_all': 0,
+                 'collective_permute': 0}, c
+
+    q = jnp.ones((2, 32, 8, 16), jnp.float32)
+    c = collectives(lambda q_, k_, v_: ulysses_attention(
+        q_, k_, v_, 'tp'), (P(None, 'tp'),) * 3, P(None, 'tp'),
+        q, q, q)
+    assert c == {'all_reduce': 0, 'all_to_all': 4,
+                 'collective_permute': 0}, c
+
+    c = collectives(lambda q_, k_, v_: ring_attention(
+        q_, k_, v_, 'tp'), (P(None, 'tp'),) * 3, P(None, 'tp'),
+        q, q, q)
+    assert c['all_reduce'] == 0 and c['all_to_all'] == 0, c
+    assert c['collective_permute'] == 2, c  # k and v, once per scan
