@@ -171,12 +171,10 @@ def test_elementwise_probe_accepts_good_optimizers():
         zero_mod.check_elementwise(opt)
 
 
-def test_zero_reduce_dtype_close_to_full_precision():
-    """zero_reduce_dtype='bfloat16' halves reduce-scatter bytes; the
-    trajectory must track the f32 run within bf16 tolerance and stay
-    identical across devices."""
+def _mlp_reduce_dtype_setup():
+    """Shared fixture for the zero_reduce_dtype tests: communicator,
+    tiny MLP + loss, deterministic batch."""
     import chainermn_tpu
-    from chainermn_tpu import training
     from chainermn_tpu.models import MLP, classifier_loss
 
     comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
@@ -188,6 +186,16 @@ def test_zero_reduce_dtype_close_to_full_precision():
                         jnp.zeros((1, 6)))['params']
     loss_fn = classifier_loss(
         lambda p, xb: model.apply({'params': p}, xb))
+    return comm, params, loss_fn, x, y
+
+
+def test_zero_reduce_dtype_close_to_full_precision():
+    """zero_reduce_dtype='bfloat16' halves reduce-scatter bytes; the
+    trajectory must track the f32 run within bf16 tolerance and stay
+    identical across devices."""
+    from chainermn_tpu import training
+
+    comm, params, loss_fn, x, y = _mlp_reduce_dtype_setup()
 
     def run(dtype):
         upd = training.StandardUpdater(
@@ -210,3 +218,52 @@ def test_zero_reduce_dtype_close_to_full_precision():
         training.StandardUpdater(
             iter([]), optax.adam(1e-2), loss_fn, params, comm,
             has_aux=True, zero_reduce_dtype='bfloat16')
+
+
+def test_zero_lowering_signature_and_reduce_dtype():
+    """The ZeRO step's StableHLO carries the documented signature
+    (reduce_scatter in, all_gather out), and zero_reduce_dtype
+    really changes the wire dtype -- this catches a silent no-op the
+    trajectory-closeness test alone cannot (f32 and a no-op'd bf16
+    would also be 'close')."""
+    from chainermn_tpu import training
+
+    comm, params, loss_fn, x, y = _mlp_reduce_dtype_setup()
+
+    def lowering(dtype):
+        upd = training.StandardUpdater(
+            iter([]), optax.adam(1e-2), loss_fn, params, comm,
+            has_aux=True, zero=True, zero_reduce_dtype=dtype,
+            donate=False)
+        arrays = upd.shard_batch([(x[i], y[i]) for i in range(32)])
+        return upd._step.lower(
+            upd.params, upd.model_state, upd.opt_state, upd._rng,
+            jnp.asarray(False), *arrays).as_text()
+
+    def scatter_operand_dtypes(txt):
+        """Dtypes flowing through the reduce_scatter ops themselves:
+        scan the few lines after each op for the type signature (the
+        stablehlo reduction region makes the op span lines)."""
+        lines = txt.splitlines()
+        found = set()
+        for i, ln in enumerate(lines):
+            if 'reduce_scatter' not in ln:
+                continue
+            for nxt in lines[i:i + 8]:
+                for m in ('xbf16>', 'xf32>'):
+                    if m in nxt:
+                        found.add(m.strip('x>'))
+                if '-> tensor<' in nxt:
+                    break
+        return found
+
+    full = lowering(None)
+    narrow = lowering('bfloat16')
+    # the ZeRO shape: scatter in, gather out
+    assert 'reduce_scatter' in full and 'all_gather' in full
+    # the narrow option REALLY narrows the WIRE dtype: the
+    # reduce_scatter ops themselves carry bf16 tensors, not merely
+    # some convert somewhere in the module
+    assert 'bf16' not in full
+    assert scatter_operand_dtypes(full) == {'f32'}
+    assert 'bf16' in scatter_operand_dtypes(narrow)
