@@ -14,7 +14,9 @@ Measurement follows ``bench.py``: the tunneled backend adds ~70ms
 RTT per dispatch and ``block_until_ready`` cannot be trusted, so each
 sample is a ``lax.scan`` chain of attention calls compiled into ONE
 program, synced by ``jax.device_get`` of a scalar slice, and the
-per-call time is the marginal cost between two chain lengths.
+per-call time is the marginal slope fit over three chain lengths
+(median-of-reps; worst segment-slope deviation recorded per row as
+``*_linearity_rel_err`` and suspect-gated at ``bench.LINEARITY_GATE``).
 
 Usage::
 
@@ -32,6 +34,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+from bench import (  # noqa: E402 (needs the sys.path insert above)
+    LINEARITY_GATE, marginal_time)
 
 
 def attn_flops(b, t, h, d, causal, bwd):
@@ -98,12 +103,13 @@ def bench_config(b, t, h, d, causal, dtype, use_pallas, bwd,
         return run
 
     # reuse bench.py's measurement primitive (same contract: make(k)
-    # returns a compiled thunk; marginal slope between two chain
-    # lengths, devget-synced)
-    from bench import marginal_time
-    k1, k2 = (1, 3) if quick else (2, 6)
-    per, _overhead, _times = marginal_time(make, k1, k2, reps=3)
-    return per
+    # returns a compiled thunk; marginal slope fit over three chain
+    # lengths, median-of-reps, devget-synced)
+    # no length-1 even in quick mode: XLA special-cases a scan of 1
+    # and its time sits off the k>=2 line (see bench.py's cpu path)
+    ks = (2, 3, 4) if quick else (2, 4, 6)
+    per, _overhead, _times, lin = marginal_time(make, ks, reps=3)
+    return per, lin
 
 
 def main():
@@ -185,12 +191,20 @@ def _run_all(configs, seqs_note, dtype, cpu, sweep, quick, platform,
                 try:
                     for name, use_pallas in (('pallas', True),
                                              ('xla', False)):
-                        per = bench_config(b, t, h, d, causal, dtype,
-                                           use_pallas, bwd,
-                                           quick=quick)
+                        per, lin = bench_config(
+                            b, t, h, d, causal, dtype, use_pallas,
+                            bwd, quick=quick)
                         row[name + '_ms'] = per * 1e3
                         row[name + '_tflops'] = attn_flops(
                             b, t, h, d, causal, bwd) / per / 1e12
+                        row[name + '_linearity_rel_err'] = round(
+                            lin, 4)
+                        if lin > LINEARITY_GATE:
+                            row['suspect'] = True
+                            row['suspect_reason'] = (
+                                row.get('suspect_reason', '') +
+                                '%s arm timing nonlinear (%.0f%%); '
+                                % (name, lin * 100))
                     row['speedup'] = row['xla_ms'] / row['pallas_ms']
                 except Exception as e:  # keep earlier rows (OOM etc.)
                     row['error'] = str(e)[-300:]
@@ -201,14 +215,19 @@ def _run_all(configs, seqs_note, dtype, cpu, sweep, quick, platform,
         for bq in (128, 256, 512):
             for bk in (128, 256, 512):
                 try:
-                    per = bench_config(b, t, h, d, True, dtype, True,
-                                       True, block_q=bq, block_k=bk,
-                                       quick=quick)
+                    per, lin = bench_config(
+                        b, t, h, d, True, dtype, True, True,
+                        block_q=bq, block_k=bk, quick=quick)
                     row = {'sweep': True, 'block_q': bq, 'block_k': bk,
                            'b': b, 't': t, 'h': h, 'd': d,
                            'causal': True, 'bwd': True,
                            'pallas_ms': per * 1e3,
+                           'linearity_rel_err': round(lin, 4),
                            'platform': platform}
+                    if lin > LINEARITY_GATE:
+                        row['suspect'] = True
+                        row['suspect_reason'] = (
+                            'timing nonlinear (%.0f%%)' % (lin * 100))
                 except Exception as e:  # Mosaic lowering limits
                     row = {'sweep': True, 'block_q': bq, 'block_k': bk,
                            'error': str(e)[-300:], 'platform': platform}

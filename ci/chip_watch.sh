@@ -1,0 +1,79 @@
+#!/bin/bash
+# Chip-watch daemon: probe the axon TPU tunnel on a timer and fire the
+# round's measurement series (ci/run_tpu_round.sh) on first contact.
+#
+# Round 3 lost its only benchmark window because the tunnel answered for
+# ~10 minutes in a 12-hour round and nobody was watching
+# (VERDICT.md round-3, "Next round" item 2).  This watcher removes the
+# human from the loop: it logs every probe, records contact windows, and
+# runs the serialized series the moment the chip answers.
+#
+# Usage: bash ci/chip_watch.sh [round_tag] [interval_s] [max_hours]
+#   round_tag   tag passed to run_tpu_round.sh (default r4)
+#   interval_s  sleep between probes (default 300)
+#   max_hours   give up after this many hours (default 11)
+#
+# Exit codes: 0 = series completed (rc recorded in log), 3 = timed out
+# without ever reaching the chip.
+set -u
+cd "$(dirname "$0")/.."
+TAG=${1:-r4}
+INTERVAL=${2:-300}
+MAX_HOURS=${3:-11}
+RES=benchmarks/results
+LOG="$RES/chip_watch_${TAG}.log"
+mkdir -p "$RES"
+
+log() { echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) $*" >> "$LOG"; }
+
+probe() {
+  # Tiny jit + device_get with a hard bound; the tunnel's usual
+  # failure mode is an indefinite hang, so timeout is the real check
+  # -- but a FAST failure (import error, wrong backend) is an
+  # environment bug, not a closed tunnel, and must be visible in the
+  # log instead of burning the whole watch window as "no contact".
+  timeout 150 python - > /tmp/chip_probe.$$ 2>&1 <<'EOF'
+import jax, jax.numpy as jnp
+assert jax.default_backend() == "tpu", jax.default_backend()
+y = jax.jit(lambda a: a @ a)(jnp.ones((256, 256), jnp.bfloat16))
+jax.device_get(y[:1, :1])
+EOF
+}
+
+log "armed: tag=$TAG interval=${INTERVAL}s max=${MAX_HOURS}h pid=$$"
+deadline=$(( $(date +%s) + MAX_HOURS * 3600 ))
+attempt=0
+while [ "$(date +%s)" -lt "$deadline" ]; do
+  attempt=$((attempt + 1))
+  t0=$(date +%s)
+  probe
+  prc=$?
+  if [ "$prc" -eq 0 ]; then
+    log "contact: attempt=$attempt probe_s=$(( $(date +%s) - t0 ))"
+    rm -f /tmp/chip_probe.$$
+    log "firing run_tpu_round.sh $TAG"
+    bash ci/run_tpu_round.sh "$TAG" >> "$LOG" 2>&1
+    rc=$?
+    log "series done rc=$rc"
+    if [ "$rc" -eq 0 ]; then
+      exit 0
+    fi
+    # Preflight passed but the series died (window closed mid-run):
+    # keep watching -- a later window can rerun; completed steps are
+    # cheap to redo with warm compile caches.
+    log "series incomplete; resuming watch"
+  else
+    took=$(( $(date +%s) - t0 ))
+    if [ "$prc" -ne 124 ] && [ "$took" -lt 30 ]; then
+      # fast non-timeout failure = broken environment, not a dead
+      # tunnel; log the error so a human (or the builder) can fix it
+      log "probe ERROR (rc=$prc, ${took}s -- env problem, not tunnel): $(tail -c 400 /tmp/chip_probe.$$ | tr '\n' ' ')"
+    else
+      log "no contact: attempt=$attempt probe_s=$took rc=$prc"
+    fi
+  fi
+  rm -f /tmp/chip_probe.$$
+  sleep "$INTERVAL"
+done
+log "gave up: no completed series within ${MAX_HOURS}h"
+exit 3
