@@ -288,11 +288,11 @@ def calibrate_matmul_roofline(quick):
         return run
 
     ks = (4, 8, 12) if quick else (8, 16, 24)
-    per, ov, _, _ = marginal_time(make, ks, reps=3)
+    per, ov, _, lin = marginal_time(make, ks, reps=3)
     tflops = flop / per / 1e12
-    _log('matmul roofline: %d^3 bf16 %.2fms/matmul -> %.1f TFLOP/s'
-         % (n, per * 1e3, tflops))
-    return tflops
+    _log('matmul roofline: %d^3 bf16 %.2fms/matmul -> %.1f TFLOP/s '
+         '(linearity %.3f)' % (n, per * 1e3, tflops, lin))
+    return tflops, lin
 
 
 # ======================================================================
@@ -597,9 +597,10 @@ def measure(argv):
 
     bur_trustworthy = None
     matmul_tflops = None
+    roofline_lin = None
     if not on_cpu:
         bur_trustworthy = probe_block_until_ready()
-        matmul_tflops = calibrate_matmul_roofline(quick)
+        matmul_tflops, roofline_lin = calibrate_matmul_roofline(quick)
 
     _log('building %s' % model_name)
     cfg = BUILDERS[model_name](quick, on_cpu)
@@ -649,6 +650,7 @@ def measure(argv):
         result['block_until_ready_trustworthy'] = bool(bur_trustworthy)
     if matmul_tflops is not None:
         result['measured_matmul_tflops'] = round(matmul_tflops, 1)
+        result['roofline_linearity_rel_err'] = round(roofline_lin, 4)
 
     suspect_reasons = []
     if want_cost:
@@ -692,6 +694,11 @@ def measure(argv):
         suspect_reasons.append(
             'fitted per-step slope non-positive: t(K) did not '
             'increase with scan length (sync not real)')
+    if roofline_lin is not None and roofline_lin > LINEARITY_GATE:
+        suspect_reasons.append(
+            'matmul roofline calibration nonlinear (%.0f%%) -- '
+            'measured_matmul_tflops and the roofline gate are '
+            'unreliable' % (roofline_lin * 100))
     elif lin_err > LINEARITY_GATE:
         suspect_reasons.append(
             'scan timing nonlinear: segment slopes deviate %.0f%% '

@@ -42,6 +42,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from chainermn_tpu.parallel import zero as zero_helpers
 from chainermn_tpu.parallel.pipeline import (
     Pipeline, assert_collective_free, microbatch, pipeline_1f1b_grads)
 from chainermn_tpu.training.convert import concat_examples
@@ -140,7 +141,12 @@ class PipelineUpdater:
         instead of the stacked-tree statistics gpipe uses.  This is
         ENFORCED by a behavioral probe
         (:func:`chainermn_tpu.parallel.zero.check_elementwise`);
-        ``schedule_check=False`` bypasses it.
+        ``schedule_check=False`` bypasses it.  Global-norm clipping
+        IS supported through the mesh-aware
+        ``zero.chain(zero.clip_by_global_norm(c), ...)``: the updater
+        completes its squared norm across stages (psum over the stage
+        axis; replicated ``extra_params`` counted once), so the 1f1b
+        trajectory matches gpipe's with ``optax.clip_by_global_norm``.
       schedule_check: verify the optimizer is elementwise when
         ``schedule='1f1b'`` (see above).
       prologue: ``prologue(extra_params, x) -> activations``, run
@@ -222,8 +228,11 @@ class PipelineUpdater:
                         "each stage's local tree, so cross-element "
                         'transforms compute per-stage statistics and '
                         "silently diverge from gpipe's stacked-tree "
-                        'trajectory.  Probe result: %s  Pass '
-                        'schedule_check=False to bypass.' % e) from e
+                        'trajectory.  For global-norm clipping use '
+                        'zero.chain(zero.clip_by_global_norm(c), ...) '
+                        '-- its norm is completed across stages.  '
+                        'Probe result: %s  Pass schedule_check=False '
+                        'to bypass.' % e) from e
         self.iterator = iterator
         self.optimizer = optimizer
         self.mesh = mesh
@@ -461,7 +470,22 @@ class PipelineUpdater:
                     n_stages, axis=AXIS_STAGE)
                 grads = lax.pmean(grads, AXIS_DATA)
                 tree, gtree = p_local, grads
-            updates, s_local = optimizer.update(gtree, s_local, tree)
+
+            # mesh-aware transforms (zero.clip_by_global_norm) finish
+            # their statistic across stages: stage leaves are disjoint
+            # along the stage axis (psum), extra leaves are replicated
+            # on every device (count once, no psum); everything is
+            # already identical along the data axis (grads pmean'd)
+            def gnorm_sq_1f1b(t):
+                if extra_used:
+                    return (zero_helpers.axes_sumsq(
+                        t['stages'], AXIS_STAGE)
+                        + zero_helpers.tree_sumsq(t['extra']))
+                return zero_helpers.axes_sumsq(t, AXIS_STAGE)
+
+            with zero_helpers.mesh_norm_scope(gnorm_sq_1f1b):
+                updates, s_local = optimizer.update(gtree, s_local,
+                                                    tree)
             new_tree = optax.apply_updates(tree, updates)
             # trace-time guard: a mis-sharded optimizer-state leaf
             # (e.g. a replicated vector broadcasting against

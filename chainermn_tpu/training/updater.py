@@ -63,7 +63,12 @@ class StandardUpdater:
         silently diverges from zero=False.  This is ENFORCED at
         construction by a behavioral probe
         (:func:`chainermn_tpu.parallel.zero.check_elementwise`);
-        ``zero_check=False`` bypasses it.
+        ``zero_check=False`` bypasses it.  The common non-elementwise
+        case -- global-norm clipping -- IS supported via the
+        mesh-aware transform:
+        ``zero.chain(zero.clip_by_global_norm(c), optax.adam(...))``
+        completes its norm with a psum of per-shard sums and matches
+        the zero=False + ``optax.clip_by_global_norm`` trajectory.
 
         ``zero_reduce_dtype`` (e.g. ``'bfloat16'``): cast gradients
         to a narrower dtype for the ZeRO reduce-scatter and back for
@@ -236,8 +241,15 @@ class StandardUpdater:
                 p_sh = jax.tree_util.tree_map(
                     lambda p: z.param_shard_leaf(p, n, rank), params)
                 opt_local = z.squeeze_state(opt_state)
-                updates, new_opt = optimizer.update(g_sh, opt_local,
-                                                    p_sh)
+                # mesh-aware transforms (zero.clip_by_global_norm)
+                # complete their statistics over the mesh: every
+                # element of the gradient tree lives on exactly one
+                # device along `axes`, so global sq-norm = psum of
+                # per-shard sums
+                with z.mesh_norm_scope(
+                        lambda t: z.axes_sumsq(t, axes)):
+                    updates, new_opt = optimizer.update(
+                        g_sh, opt_local, p_sh)
                 upd_full = jax.tree_util.tree_map(
                     lambda u, p: z.gather_update_leaf(u, p, axes),
                     updates, params)

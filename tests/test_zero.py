@@ -144,6 +144,67 @@ def test_zero_rejects_non_elementwise(bad_opt):
         _setup((2, 4), zero=True, opt=make())
 
 
+def _flat_params(upd):
+    return np.concatenate([
+        np.asarray(x).ravel() for x in
+        jax.tree_util.tree_leaves(jax.device_get(upd.params))])
+
+
+def test_zero_clip_by_global_norm_matches_replicated():
+    """VERDICT r3 item 4: global-norm clipping must WORK under
+    zero=True, not error -- via the mesh-aware transform whose squared
+    norm is completed with a psum of per-shard sums.  Pinned against
+    zero=False + optax.clip_by_global_norm, with a clip threshold low
+    enough that clipping demonstrably engages (the unclipped
+    trajectory must differ, or this test proves nothing)."""
+    c = 0.05
+    upd_ref = _setup(
+        (2, 4), zero=False,
+        opt=optax.chain(optax.clip_by_global_norm(c),
+                        optax.sgd(0.1, momentum=0.9)))
+    upd_zero = _setup(
+        (2, 4), zero=True,
+        opt=zero_mod.chain(zero_mod.clip_by_global_norm(c),
+                           optax.sgd(0.1, momentum=0.9)))
+    upd_plain = _setup((2, 4), zero=True,
+                       opt=optax.sgd(0.1, momentum=0.9))
+    for i in range(4):
+        m_ref = upd_ref.update()
+        m_zero = upd_zero.update()
+        upd_plain.update()
+        assert abs(m_ref['loss'] - m_zero['loss']) < 1e-5, \
+            (i, m_ref, m_zero)
+    np.testing.assert_allclose(_flat_params(upd_zero),
+                               _flat_params(upd_ref), atol=1e-5)
+    # teeth: clipping actually changed the trajectory
+    assert np.max(np.abs(_flat_params(upd_zero)
+                         - _flat_params(upd_plain))) > 1e-3
+
+
+def test_zero_clip_unsharded_matches_optax():
+    """Outside any mesh scope the transform IS optax's clip (local
+    tree == global tree), so replicated/zero=False use also works."""
+    rng = np.random.RandomState(0)
+    tree = {'w': jnp.asarray(rng.randn(7, 5), jnp.float32),
+            'b': jnp.asarray(rng.randn(5), jnp.float32)}
+    for c in (0.1, 1e6):  # clipping active / inactive
+        ours = zero_mod.clip_by_global_norm(c)
+        theirs = optax.clip_by_global_norm(c)
+        u1, _ = ours.update(tree, ours.init(tree))
+        u2, _ = theirs.update(tree, theirs.init(tree))
+        for a, b in zip(jax.tree_util.tree_leaves(u1),
+                        jax.tree_util.tree_leaves(u2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-6)
+
+
+def test_zero_chain_rejects_plain_clip():
+    """zero.chain validates components: the NON-mesh-aware optax clip
+    must still be rejected (it would compute shard-local norms)."""
+    with pytest.raises(ValueError, match='elementwise'):
+        zero_mod.chain(optax.clip_by_global_norm(1.0), optax.sgd(0.1))
+
+
 def test_zero_check_bypass():
     upd = _setup_check_bypass()
     assert upd.iteration == 0
