@@ -694,15 +694,18 @@ def measure(argv):
         suspect_reasons.append(
             'fitted per-step slope non-positive: t(K) did not '
             'increase with scan length (sync not real)')
+    elif lin_err > LINEARITY_GATE:
+        # elif: under a non-positive slope lin_err is the 99.0
+        # sentinel; the message above already covers it
+        suspect_reasons.append(
+            'scan timing nonlinear: segment slopes deviate %.0f%% '
+            'from the fitted per-step time' % (lin_err * 100))
     if roofline_lin is not None and roofline_lin > LINEARITY_GATE:
+        # independent measurement (calibration scan), independent gate
         suspect_reasons.append(
             'matmul roofline calibration nonlinear (%.0f%%) -- '
             'measured_matmul_tflops and the roofline gate are '
             'unreliable' % (roofline_lin * 100))
-    elif lin_err > LINEARITY_GATE:
-        suspect_reasons.append(
-            'scan timing nonlinear: segment slopes deviate %.0f%% '
-            'from the fitted per-step time' % (lin_err * 100))
     if suspect_reasons:
         result['suspect'] = True
         result['suspect_reason'] = '; '.join(suspect_reasons)

@@ -106,35 +106,67 @@ def _jaxpr_collectives(jaxpr, found):
                         _jaxpr_collectives(inner, found)
 
 
-def assert_collective_free(what, fn, *args):
-    """Trace-time guard: raise if ``fn(*args)``'s OUTPUTS depend on
-    collective primitives.  The 1F1B schedule takes per-device vjps
-    of the stage body, loss and prologue inside
-    ``shard_map(check_vma=False)``, where collective transposes are
-    silently WRONG (see the package AUTODIFF CAVEAT) -- fail loudly
-    instead of training on corrupt gradients.
+def _dce(jaxpr):
+    try:
+        from jax._src.interpreters import partial_eval as pe
+        jaxpr, _ = pe.dce_jaxpr(jaxpr, [True] * len(jaxpr.outvars))
+    except Exception:
+        # private API moved: probe without DCE.  That direction is
+        # fail-CLOSED (collectives in discarded side values become
+        # false positives), but silence here would hide that the
+        # guard's precision degraded -- say so (ADVICE r3).
+        import warnings
+        warnings.warn(
+            'chainermn_tpu: jax dce_jaxpr unavailable in this JAX '
+            'version; the 1f1b collective guard probes without '
+            'dead-code elimination and may reject collectives in '
+            'discarded (never-differentiated) side values',
+            RuntimeWarning, stacklevel=3)
+    return jaxpr
 
-    The jaxpr is dead-code-eliminated down to the probed outputs
+
+def assert_collective_free(what, fn, *args):
+    """Trace-time guard: raise if ``fn(*args)``'s outputs -- or the
+    cotangents of its VJP -- depend on collective primitives.  The
+    1F1B schedule takes per-device vjps of the stage body, loss and
+    prologue inside ``shard_map(check_vma=False)``, where collective
+    transposes are silently WRONG (see the package AUTODIFF CAVEAT)
+    -- fail loudly instead of training on corrupt gradients.
+
+    Each jaxpr is dead-code-eliminated down to the probed outputs
     first: ``make_jaxpr`` records everything executed, so without DCE
     a collective in a DISCARDED side value (e.g. pmean'd metrics the
     probe's loss-only lambda drops -- never differentiated, perfectly
     safe) would be a false positive.
 
-    KNOWN BLIND SPOT: the scan sees through jaxpr-carrying params
-    (scan/cond/closed calls and ``custom_vjp`` FORWARDS) but a
-    ``custom_vjp``'s backward rule is an opaque callable -- a custom
-    op whose bwd itself performs a collective passes the probe.  The
-    repo's own custom-vjp kernels (flash attention, fused LN/CE) have
-    collective-free backwards; audit any new one before using it in a
-    1f1b stage body."""
-    jaxpr = jax.make_jaxpr(fn)(*args).jaxpr
-    try:
-        from jax._src.interpreters import partial_eval as pe
-        jaxpr, _ = pe.dce_jaxpr(jaxpr, [True] * len(jaxpr.outvars))
-    except Exception:
-        pass  # private API moved: probe conservatively without DCE
+    The BACKWARD is probed separately (VERDICT r3 item 5): the forward
+    jaxpr sees through scan/cond/closed calls and ``custom_vjp``
+    forwards, but a ``custom_vjp``'s backward rule is an opaque
+    callable that only materializes when the pullback is traced -- a
+    custom op whose bwd performs a collective would otherwise pass.
+    Tracing ``jax.vjp``'s pullback inlines those rules, which is
+    exactly what the 1f1b schedule will execute."""
+    jaxpr = _dce(jax.make_jaxpr(fn)(*args).jaxpr)
     found = set()
     _jaxpr_collectives(jaxpr, found)
+
+    if not found:
+        import numpy as np
+
+        def vjp_probe(*a):
+            out, pullback = jax.vjp(fn, *a)
+            cots = jax.tree_util.tree_map(
+                lambda o: (jnp.ones_like(o)
+                           if jnp.issubdtype(o.dtype, jnp.inexact)
+                           else np.zeros(o.shape, jax.dtypes.float0)),
+                out)
+            return pullback(cots)
+
+        bwd = _dce(jax.make_jaxpr(vjp_probe)(*args).jaxpr)
+        _jaxpr_collectives(bwd, found)
+        if found:
+            found = {f + ' (in the backward)' for f in found}
+
     if found:
         raise ValueError(
             '%s contains collective primitives %s: the 1f1b schedule '

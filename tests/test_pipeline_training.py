@@ -849,6 +849,108 @@ def test_1f1b_rejects_collective_loss():
              for i in range(len(x))]))
 
 
+def test_1f1b_rejects_collective_in_custom_vjp_bwd():
+    """VERDICT r3 item 5: a custom_vjp whose BACKWARD performs a
+    collective must be rejected -- the forward jaxpr alone cannot see
+    the opaque bwd rule, so the guard traces the pullback too."""
+    @jax.custom_vjp
+    def sneaky(x):
+        return x
+
+    def fwd(x):
+        return x, None
+
+    def bwd(_, g):
+        return (jax.lax.pmean(g, 'data'),)
+
+    sneaky.defvjp(fwd, bwd)
+
+    def bad_stage(p, x):
+        return sneaky(jnp.tanh(x @ p['w'] + p['b']))
+
+    mesh = pipeline_mesh(N_STAGES)
+    x, y = _data()
+    upd = PipelineUpdater(iter([]), optax.sgd(0.1), bad_stage,
+                          loss_on_last,
+                          stack_stage_params(make_params()), mesh,
+                          n_micro=4, donate=False, schedule='1f1b')
+    with pytest.raises(ValueError, match='backward'):
+        upd.update_core(upd.shard_batch(
+            [(np.asarray(x[i]), np.asarray(y[i]))
+             for i in range(len(x))]))
+
+
+def test_1f1b_accepts_clean_custom_vjp():
+    """A custom_vjp with a collective-free backward (the repo's own
+    kernel pattern) must still pass the guard and train."""
+    @jax.custom_vjp
+    def clean(x):
+        return jnp.tanh(x)
+
+    def fwd(x):
+        return jnp.tanh(x), x
+
+    def bwd(x, g):
+        return (g * (1.0 - jnp.tanh(x) ** 2),)
+
+    clean.defvjp(fwd, bwd)
+
+    def stage(p, x):
+        return clean(x @ p['w'] + p['b'])
+
+    mesh = pipeline_mesh(N_STAGES)
+    x, y = _data()
+    upd = PipelineUpdater(iter([]), optax.sgd(0.1), stage,
+                          loss_on_last,
+                          stack_stage_params(make_params()), mesh,
+                          n_micro=4, donate=False, schedule='1f1b')
+    m = upd.update_core(upd.shard_batch(
+        [(np.asarray(x[i]), np.asarray(y[i])) for i in range(len(x))]))
+    assert np.isfinite(float(m['loss']))
+
+
+def _guard_probe(collective_fn):
+    """Run assert_collective_free against ``collective_fn`` with mesh
+    axes bound (the guard's real calling context)."""
+    from jax.sharding import PartitionSpec as P
+    from chainermn_tpu.parallel.pipeline import assert_collective_free
+
+    mesh = pipeline_mesh(N_STAGES)
+    x = jnp.ones((4, 4), jnp.float32)
+
+    def body(xx):
+        assert_collective_free('probe', collective_fn, xx)
+        return xx
+
+    jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(),
+                          out_specs=P(), check_vma=False))(x)
+
+
+@pytest.mark.parametrize('name', [
+    'psum', 'pmean', 'pmax', 'pmin', 'ppermute', 'all_gather',
+    'psum_scatter', 'all_to_all'])
+def test_guard_primitive_set_tracks_jax(name):
+    """ADVICE r3: the guard's hardcoded primitive frozenset must track
+    what this JAX version's collective APIs actually lower to -- if an
+    upgrade renames a primitive, the guard would silently admit it and
+    1f1b would train on mis-transposed gradients; this test breaks
+    loudly instead."""
+    from jax import lax
+    perm = [(i, (i + 1) % N_STAGES) for i in range(N_STAGES)]
+    fns = {
+        'psum': lambda x: lax.psum(x, 'stage'),
+        'pmean': lambda x: lax.pmean(x, 'data'),
+        'pmax': lambda x: lax.pmax(x, 'stage'),
+        'pmin': lambda x: lax.pmin(x, 'stage'),
+        'ppermute': lambda x: lax.ppermute(x, 'stage', perm),
+        'all_gather': lambda x: lax.all_gather(x, 'stage'),
+        'psum_scatter': lambda x: lax.psum_scatter(x, 'stage'),
+        'all_to_all': lambda x: lax.all_to_all(x, 'stage', 0, 0),
+    }
+    with pytest.raises(ValueError, match='collective'):
+        _guard_probe(fns[name])
+
+
 def test_1f1b_accepts_collective_metrics():
     """Collectives in the METRICS (aux, never differentiated) are
     safe under 1f1b and must NOT trip the guard: the probe DCEs the
