@@ -28,17 +28,58 @@ from chainermn_tpu.communicators.mesh_utility import (
     AXIS_INTER, AXIS_INTRA, AXES)
 
 
-def _kv_key_state(client, key):
+def _kv_key_state(client, key, unknown_counts=None):
     """Tri-state probe of a coordination-store key: ``'present'``,
     ``'absent'`` (the store POSITIVELY reports NOT_FOUND, i.e. the
     receiver consumed-and-deleted it), or ``'unknown'`` (a transient
-    store/transport error -- neither conclusion is safe)."""
+    store/transport error -- neither conclusion is safe).
+
+    NOT_FOUND is recognized case-insensitively in the message ("not
+    found" included) AND in any structured status-code attribute the
+    client's exception carries -- a coordination-service message
+    rewording must not silently downgrade every consumed key to
+    'unknown', which would make the GC sweep retry its sent-record
+    forever (ADVICE r3).  As a second line of defense,
+    ``unknown_counts`` (a dict the caller owns) counts consecutive
+    'unknown' verdicts per key and warns when a key stays
+    unclassifiable across many sweeps, so a systematic drift is loud
+    instead of an invisible leak."""
     try:
         client.key_value_try_get(key)
+        if unknown_counts is not None:
+            unknown_counts.pop(key, None)
         return 'present'
     except Exception as e:
-        if 'NOT_FOUND' in str(e):
+        up = str(e).upper()
+        code = ''
+        for attr in ('status_code', 'code', 'status'):
+            v = getattr(e, attr, None)
+            if v is None:
+                continue
+            try:
+                code = str(v() if callable(v) else v).upper()
+            except Exception:
+                continue
+            break
+        # positive identification only: the structured code, the gRPC
+        # status token (underscore form -- not natural prose), or a
+        # message that LEADS with the status.  A bare substring match
+        # on 'not found' would classify transient errors like 'leader
+        # not found during election' as consumed and leak the key.
+        if ('NOT_FOUND' in code or 'NOT_FOUND' in up
+                or up.lstrip().startswith('NOT FOUND')):
+            if unknown_counts is not None:
+                unknown_counts.pop(key, None)
             return 'absent'
+        if unknown_counts is not None:
+            n = unknown_counts[key] = unknown_counts.get(key, 0) + 1
+            if n in (3, 10, 30):
+                import warnings
+                warnings.warn(
+                    'chainermn_tpu p2p GC: key %r unclassifiable '
+                    'after %d probes (latest: %s); its sent-record '
+                    'is kept and retried every sweep' % (key, n, e),
+                    RuntimeWarning, stacklevel=2)
         return 'unknown'
 
 
@@ -300,8 +341,10 @@ class CommunicatorBase:
                 (k for k, v in sent.items()
                  if now - v[2] > 60.0 and now - probed.get(k, 0) > 60.0),
                 key=lambda k: sent[k][2])[:2]
+            unknowns = self.__dict__.setdefault('_p2p_unknown_counts',
+                                                {})
             for k in stale:
-                state = _kv_key_state(client, k)
+                state = _kv_key_state(client, k, unknowns)
                 if state == 'absent':
                     del sent[k]  # consumed: nothing left to GC
                     probed.pop(k, None)
@@ -388,7 +431,10 @@ class CommunicatorBase:
                 # delete and free its sequence slot for a retry); a
                 # transient store error is NEITHER -- keep the record
                 # for a later sweep rather than mis-classifying
-                state = _kv_key_state(client, key)
+                state = _kv_key_state(
+                    client, key,
+                    self.__dict__.setdefault('_p2p_unknown_counts',
+                                             {}))
                 if state == 'unknown':
                     continue
                 if state == 'present':

@@ -21,7 +21,8 @@ from chainermn_tpu.models.resnet50 import (  # noqa
     ResNet, ResNet50, ResNet101, ResNet152)
 from chainermn_tpu.models.seq2seq import Seq2seq, seq2seq_loss  # noqa
 from chainermn_tpu.models.transformer import (  # noqa
-    TransformerLM, TransformerBlock, lm_loss, pipeline_parts)
+    TransformerLM, TransformerBlock, lm_loss, lm_loss_sum,
+    pipeline_parts)
 
 
 def get_arch(name, **kwargs):

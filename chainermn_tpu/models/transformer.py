@@ -207,11 +207,16 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
     return stage_fn, prologue, loss_on_last, params_stacked, extra
 
 
-def lm_loss(apply_fn, pad_id=-1):
-    """Next-token loss over (tokens, targets); fused cross-entropy.
+def lm_loss_sum(apply_fn, pad_id=-1):
+    """Next-token loss in sum/count form: returns
+    ``((loss_sum, token_count), aux)``.
 
-    ``pad_id`` target positions are masked out (use -1 when every
-    position is real)."""
+    For sequence-parallel training with a REAL ``pad_id``: feed this
+    to ``mapped_global_loss(..., token_weighted=True)`` so the global
+    loss is ``psum(sum)/psum(count)`` -- exact under uneven padding
+    across shards, where pmean-of-local-means is Jensen-weighted and
+    silently wrong (ADVICE r3).  :func:`lm_loss` is the mean form of
+    this same computation."""
 
     def loss_fn(params, tokens, targets):
         logits = apply_fn(params, tokens)
@@ -220,9 +225,21 @@ def lm_loss(apply_fn, pad_id=-1):
             logits.reshape(b * t, v), targets.reshape(b * t).astype(
                 jnp.int32))
         mask = (targets.reshape(b * t) != pad_id).astype(jnp.float32)
-        total = jnp.sum(ce * mask)
-        n = jnp.maximum(jnp.sum(mask), 1.0)
-        loss = total / n
+        return (jnp.sum(ce * mask), jnp.sum(mask)), {}
+
+    return loss_fn
+
+
+def lm_loss(apply_fn, pad_id=-1):
+    """Next-token loss over (tokens, targets); fused cross-entropy.
+
+    ``pad_id`` target positions are masked out (use -1 when every
+    position is real)."""
+    sum_fn = lm_loss_sum(apply_fn, pad_id)
+
+    def loss_fn(params, tokens, targets):
+        (total, n), _ = sum_fn(params, tokens, targets)
+        loss = total / jnp.maximum(n, 1.0)
         return loss, {'perp': jnp.exp(jnp.minimum(loss, 20.0))}
 
     return loss_fn

@@ -82,15 +82,30 @@ def ring_attention(q, k, v, axis, causal=False, scale=None):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
-def mapped_global_loss(loss_fn, mesh, batch_spec, axes=None):
+def mapped_global_loss(loss_fn, mesh, batch_spec, axes=None,
+                       token_weighted=False):
     """The canonical sequence-parallel training-loss wrapper.
 
-    Returns ``mapped(params, *batch) -> scalar``: ``loss_fn(params,
-    *batch) -> (loss, aux)`` evaluated per shard inside ``shard_map``
-    (params replicated, every batch array sharded with
-    ``batch_spec``), with the per-shard mean losses ``pmean``'d over
-    ``axes`` (default: all mesh axes) into the global mean.  ``aux``
-    is discarded.
+    Returns ``mapped(params, *batch) -> scalar``: ``loss_fn``
+    evaluated per shard inside ``shard_map`` (params replicated, every
+    batch array sharded with ``batch_spec``), reduced over ``axes``
+    (default: all mesh axes).  ``aux`` is discarded.
+
+    ``token_weighted=False`` (default): ``loss_fn(params, *batch) ->
+    (loss, aux)`` and the per-shard MEAN losses are ``pmean``'d.  That
+    equals the global mean ONLY when every shard weighs its tokens
+    equally -- true for unmasked losses over equal-length shards.
+    With a MASKED loss (e.g. ``lm_loss`` with a real ``pad_id``) and
+    uneven padding across shards, the pmean-of-means is a
+    Jensen-weighted average that silently differs from the unsharded
+    loss (ADVICE r3).
+
+    ``token_weighted=True``: ``loss_fn(params, *batch) ->
+    ((loss_sum, weight), aux)`` -- per-shard SUM and its weight (e.g.
+    the non-pad token count) -- and the wrapper computes
+    ``psum(loss_sum) / psum(weight)``, the exact global weighted mean
+    regardless of how padding lands across shards (this is the same
+    sum-before-divide reduction ``pipeline_parts``' loss uses).
 
     Differentiate the RESULT with ``jax.grad`` -- outside the
     ``shard_map`` -- per the package AUTODIFF CAVEAT: taking the grad
@@ -105,6 +120,12 @@ def mapped_global_loss(loss_fn, mesh, batch_spec, axes=None):
 
     def mapped(params, *batch):
         def f(p, *b):
+            if token_weighted:
+                (loss_sum, weight), _aux = loss_fn(p, *b)
+                num = lax.psum(loss_sum, axes)
+                den = lax.psum(
+                    jnp.asarray(weight, jnp.float32), axes)
+                return num / jnp.maximum(den, 1e-9)
             loss, _aux = loss_fn(p, *b)
             return lax.pmean(loss, axes)
         return jax.shard_map(

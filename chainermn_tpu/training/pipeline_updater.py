@@ -171,12 +171,23 @@ class PipelineUpdater:
         psum) and must return activations REPLICATED over the extra
         axes.  Optimizer state mirroring a params leaf inherits its
         full spec.  gpipe schedule only.
+      opt_state_specs: optional LEAF-EXACT pytree of ``PartitionSpec``
+        for the optimizer state, overriding the built-in placement
+        heuristic.  The heuristic stage-shards any >=2-D state leaf
+        whose leading dim equals ``n_stages`` (and inherits param
+        specs on shape/keypath matches) -- correct for every stock
+        optax transform, but a semantically REPLICATED buffer that
+        coincidentally has that shape would be sliced ``a[0]`` per
+        stage under 1f1b (the trace-time shape guard catches most,
+        not all, such corruptions).  Exotic optimizers can state
+        their placement here, as ``param_specs`` does for parameters.
     """
 
     def __init__(self, iterator, optimizer, stage_fn, loss_on_last,
                  params_stacked, mesh, n_micro, remat=False,
                  donate=True, schedule='gpipe', schedule_check=True,
-                 prologue=None, extra_params=None, param_specs=None):
+                 prologue=None, extra_params=None, param_specs=None,
+                 opt_state_specs=None):
         if schedule not in ('gpipe', '1f1b'):
             raise ValueError("schedule must be 'gpipe' or '1f1b'")
         if param_specs is not None:
@@ -311,8 +322,41 @@ class PipelineUpdater:
                 return P(AXIS_STAGE)
             return P()
 
-        opt_specs = jax.tree_util.tree_map_with_path(
-            _leaf_spec, opt_state0)
+        if opt_state_specs is not None:
+            # explicit escape hatch (ADVICE r3): the heuristic below
+            # infers stage sharding from shapes/keypaths, and a
+            # semantically REPLICATED state leaf that happens to be
+            # >=2-D with leading dim n_stages would be mis-sliced per
+            # stage under 1f1b.  Exotic optimizers can state their
+            # placement outright, mirroring param_specs.
+            n_s = len(jax.tree_util.tree_leaves(opt_state0))
+            spec_leaves = jax.tree_util.tree_leaves(
+                opt_state_specs, is_leaf=lambda v: isinstance(v, P))
+            if (len(spec_leaves) != n_s
+                    or not all(isinstance(sp, P)
+                               for sp in spec_leaves)):
+                raise ValueError(
+                    'opt_state_specs must be LEAF-EXACT (one '
+                    'PartitionSpec per optimizer-state leaf): got %d '
+                    'specs for %d leaves'
+                    % (len(spec_leaves), n_s))
+
+            def _canon(sp):
+                # strip trailing Nones: the 1f1b squeeze/re-stack
+                # compares specs by equality with P('stage'), and
+                # P('stage', None) != P('stage') even though the
+                # placement is identical
+                t = tuple(sp)
+                while t and t[-1] is None:
+                    t = t[:-1]
+                return P(*t)
+
+            opt_specs = jax.tree_util.tree_map(
+                _canon, opt_state_specs,
+                is_leaf=lambda v: isinstance(v, P))
+        else:
+            opt_specs = jax.tree_util.tree_map_with_path(
+                _leaf_spec, opt_state0)
         # protect=opt_tree0 (the caller's trees): opt_state0 is
         # internal (aliasing within it is harmless), but state that
         # embeds the caller's params (lookahead slow weights) must not

@@ -263,3 +263,54 @@ def test_strategy_lowerings_are_distinct():
     # dummy: pack/unpack only, zero collectives
     d = counts('dummy')
     assert not any(d.values())
+
+
+def test_kv_key_state_classification():
+    """ADVICE r3: NOT_FOUND recognition must survive message rewording
+    (case, spacing) and use structured status codes when present; keys
+    that stay 'unknown' across sweeps must warn instead of silently
+    leaking their sent-records forever."""
+    from contextlib import nullcontext
+
+    import pytest
+
+    from chainermn_tpu.communicators.base import _kv_key_state
+
+    class Raises:
+        def __init__(self, exc):
+            self.exc = exc
+
+        def key_value_try_get(self, key):
+            raise self.exc
+
+    class Present:
+        def key_value_try_get(self, key):
+            return 'payload'
+
+    assert _kv_key_state(Present(), 'k') == 'present'
+    assert _kv_key_state(
+        Raises(RuntimeError('NOT_FOUND: key missing')), 'k') == 'absent'
+    assert _kv_key_state(
+        Raises(RuntimeError('not found: key absent')), 'k') == 'absent'
+    # prose that merely CONTAINS 'not found' is NOT a positive
+    # consumed signal -- a transient election error must stay unknown
+    assert _kv_key_state(
+        Raises(RuntimeError('leader not found during election')),
+        'k') == 'unknown'
+
+    class Coded(Exception):
+        status_code = 'NOT_FOUND'
+
+    assert _kv_key_state(Raises(Coded('gone')), 'k') == 'absent'
+
+    counts = {}
+    transient = Raises(RuntimeError('UNAVAILABLE: transport'))
+    for i in range(3):
+        ctx = (pytest.warns(RuntimeWarning, match='unclassifiable')
+               if i == 2 else nullcontext())
+        with ctx:
+            assert _kv_key_state(transient, 'k', counts) == 'unknown'
+    assert counts['k'] == 3
+    # resolution clears the counter
+    assert _kv_key_state(Present(), 'k', counts) == 'present'
+    assert 'k' not in counts

@@ -140,6 +140,60 @@ class TestSequenceParallel:
         assert float(loss2) < float(loss1)
 
 
+def test_sp_token_weighted_loss_exact_under_uneven_padding(setup):
+    """ADVICE r3: pmean of per-shard mean losses is Jensen-weighted
+    when padding is uneven across sequence shards; the
+    token_weighted=True path (psum(sum)/psum(count)) must equal the
+    unsharded masked loss exactly, and the default path must
+    demonstrably differ on the same batch (or this test proves
+    nothing)."""
+    from chainermn_tpu.models.transformer import lm_loss_sum
+    from chainermn_tpu.parallel import mapped_global_loss
+
+    _, params, tokens = setup
+    n_sp = 2
+    if jax.device_count() < n_sp:
+        pytest.skip('needs 2 devices')
+    pad = 0
+    targets = jnp.roll(tokens, -1, axis=1)
+    # mask out the trailing 10 of 32 positions: shard 0 keeps all 16,
+    # shard 1 only 6 -- maximally uneven
+    targets = targets.at[:, -10:].set(pad)
+
+    sp_model = _tiny(seq_axis='sp')
+    mesh = Mesh(np.array(jax.devices()[:n_sp]), ('sp',))
+
+    ref_loss_fn = lm_loss(
+        lambda p, t: _tiny().apply({'params': p}, t), pad_id=pad)
+    ref = float(ref_loss_fn(params, tokens, targets)[0])
+
+    weighted = mapped_global_loss(
+        lm_loss_sum(lambda p, t: sp_model.apply({'params': p}, t),
+                    pad_id=pad),
+        mesh, P(None, 'sp'), token_weighted=True)
+    got = float(jax.jit(weighted)(params, tokens, targets))
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+    plain = mapped_global_loss(
+        lm_loss(lambda p, t: sp_model.apply({'params': p}, t),
+                pad_id=pad),
+        mesh, P(None, 'sp'))
+    jensen = float(jax.jit(plain)(params, tokens, targets))
+    assert abs(jensen - ref) > 1e-4, (
+        'pmean-of-means coincides with the weighted mean; pick a more '
+        'uneven mask so the test has teeth (ref=%f jensen=%f)'
+        % (ref, jensen))
+
+    # gradients of the weighted path match the unsharded masked loss
+    g_ref = jax.grad(lambda p: ref_loss_fn(p, tokens, targets)[0])(
+        params)
+    g_sp = jax.jit(jax.grad(weighted))(params, tokens, targets)
+    for a, r in zip(jax.tree_util.tree_leaves(g_sp),
+                    jax.tree_util.tree_leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=5e-3, atol=5e-4)
+
+
 def test_ulysses_matches_single_device():
     """sp_scheme='ulysses' (all_to_all head resharding) must also
     reproduce the unsharded model: 2 heads over 2 devices."""
