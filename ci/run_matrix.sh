@@ -13,5 +13,10 @@ for N in 1 2 3; do
     python -m pytest tests/test_device_matrix.py -q
 done
 
-echo "=== full suite: 8 virtual devices ==="
+# fast set (default: @pytest.mark.slow excluded) is the edit-test
+# loop; the FULL set runs once here so no coverage is lost
+echo "=== fast suite: 8 virtual devices ==="
 python -m pytest tests/ -q
+
+echo "=== slow tail: 8 virtual devices ==="
+python -m pytest tests/ -q --runslow -m slow

@@ -39,3 +39,24 @@ def hlo_collective_counts(fn, mesh, in_specs, out_specs, ops, *args):
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False)).lower(*args).as_text()
     return {k: len(re.findall(k, txt)) for k in ops}
+
+
+def pytest_addoption(parser):
+    parser.addoption(
+        '--runslow', action='store_true', default=False,
+        help='include @pytest.mark.slow tests (the full-coverage '
+             'pass; ci/run_matrix.sh runs it once)')
+
+
+def pytest_collection_modifyitems(config, items):
+    """Default run stays under ~5 minutes (VERDICT r3 item 7): the
+    slow tail is opt-in via --runslow; ci/run_matrix.sh runs the fast
+    set per device count and the FULL set once, so coverage is not
+    lost -- only moved out of the edit-test loop."""
+    if config.getoption('--runslow'):
+        return
+    import pytest
+    skip = pytest.mark.skip(reason='slow: run with --runslow')
+    for item in items:
+        if 'slow' in item.keywords:
+            item.add_marker(skip)

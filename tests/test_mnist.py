@@ -97,6 +97,7 @@ def test_mnist_convergence(tmp_path, mesh_shape):
                          % (acc, source))
 
 
+@pytest.mark.slow
 def test_gate_fails_on_broken_gradient_mean(tmp_path):
     """Deliberate-bug sanity check (VERDICT r3 item 6): turn the
     gradient mean-allreduce into a sum (missing 1/size) and the gate
@@ -111,6 +112,7 @@ def test_gate_fails_on_broken_gradient_mean(tmp_path):
         'the convergence bar has no teeth' % acc)
 
 
+@pytest.mark.slow
 def test_gate_fails_on_crippled_model(tmp_path):
     """Capacity teeth: the antipodal-cluster task is not linearly
     separable and a 2-unit MLP must fail the bar -- the gate measures

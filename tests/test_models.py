@@ -26,6 +26,7 @@ def _param_count(tree):
 
 
 @pytest.mark.parametrize('name,insize,mrange', ZOO)
+@pytest.mark.slow
 def test_zoo_forward(name, insize, mrange):
     model = models.get_arch(name, num_classes=50, dtype=jnp.float32)
     x = jnp.zeros((2, insize, insize, 3), jnp.float32)
@@ -49,6 +50,7 @@ def test_zoo_forward(name, insize, mrange):
         name, n, lo, hi)
 
 
+@pytest.mark.slow
 def test_stateful_classifier_train_step():
     model = models.get_arch('resnet50', num_classes=10, dtype=jnp.float32)
     x = jnp.ones((2, 64, 64, 3), jnp.float32)  # small spatial for speed

@@ -69,6 +69,7 @@ def _launch(nprocs, outdir):
 
 
 @pytest.mark.parametrize('nprocs', [2, 3])
+@pytest.mark.slow
 def test_multiprocess_end_to_end(tmp_path, nprocs):
     results = _launch(nprocs, tmp_path)
     n_dev = 2 * nprocs

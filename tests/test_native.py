@@ -113,6 +113,7 @@ def _collective_worker(comm_id, n, rank, q):
 
 
 class TestNativeCommunicator:
+    @pytest.mark.slow
     def test_collectives_across_processes(self):
         ctx = mp.get_context('spawn')
         n = 3

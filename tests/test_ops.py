@@ -51,6 +51,7 @@ class TestFlashAttention:
         ref = ops.mha_reference(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
     def test_cross_attention_lengths(self, mode):
         q = _rand((2, 16, 2, 8), 6)
         k = _rand((2, 48, 2, 8), 7)

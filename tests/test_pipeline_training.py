@@ -380,6 +380,7 @@ def test_pipeline_updater_async_metrics(tmp_path):
     assert np.isfinite(log.log[-1]['loss'])
 
 
+@pytest.mark.slow
 def test_1f1b_opt_state_vector_leaf_replicated():
     """An optimizer-state leaf of shape (n_stages,) that does NOT
     mirror the params must be REPLICATED, not sharded over the stage
@@ -698,6 +699,7 @@ def test_pipeline_training_converges():
     assert accs[-1] > 0.85
 
 
+@pytest.mark.slow
 def test_transformer_pipeline_parts():
     """models.pipeline_parts: the pipelined TransformerLM equals the
     plain model with the SAME parameter values -- forward loss exactly

@@ -58,6 +58,7 @@ def test_distributed_sgd_schedule_step_decay():
     assert float(sched(81 * spe)) == pytest.approx(0.0001, rel=1e-3)
 
 
+@pytest.mark.slow
 def test_schedule_drives_optimizer():
     """The schedule plugs into the multi-node optimizer end to end."""
     import jax

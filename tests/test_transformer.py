@@ -40,6 +40,7 @@ class TestTransformerLM:
         assert logits.shape == (2, 32, 64)
         assert bool(jnp.all(jnp.isfinite(logits)))
 
+    @pytest.mark.slow
     def test_loss_and_grads_finite(self, setup):
         model, params, tokens = setup
         targets = jnp.roll(tokens, -1, axis=1)
@@ -93,6 +94,7 @@ class TestSequenceParallel:
         np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
 
     @pytest.mark.parametrize('scheme', ['ring', 'ulysses'])
+    @pytest.mark.slow
     def test_sp_training_step(self, setup, scheme):
         """Differentiate OUTSIDE shard_map (the supported pattern, see
         parallel/__init__ AUTODIFF CAVEAT: grad INSIDE mis-transposes
