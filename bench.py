@@ -299,7 +299,8 @@ def calibrate_matmul_roofline(quick):
 # per-model builders: return dict(updater-free scan maker, items/step,
 # analytic train flops/step, extras)
 
-def _classifier_setup(model, insize, batch, seed=0):
+def _classifier_setup(model, insize, batch, seed=0, comm=None,
+                      n_classes=1000):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -309,7 +310,8 @@ def _classifier_setup(model, insize, batch, seed=0):
     from chainermn_tpu import training
     from chainermn_tpu.models import StatefulClassifier
 
-    comm = chainermn_tpu.create_communicator('xla')
+    if comm is None:
+        comm = chainermn_tpu.create_communicator('xla')
     x0 = jnp.zeros((1, insize, insize, 3), jnp.float32)
     variables = model.init({'params': jax.random.PRNGKey(seed)}, x0,
                            train=False)
@@ -317,7 +319,7 @@ def _classifier_setup(model, insize, batch, seed=0):
     model_state = {k: v for k, v in variables.items() if k != 'params'}
     rng = np.random.RandomState(0)
     x = rng.rand(batch, insize, insize, 3).astype(np.float32)
-    y = rng.randint(0, 1000, batch).astype(np.int32)
+    y = rng.randint(0, n_classes, batch).astype(np.int32)
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.sgd(0.1, momentum=0.9), comm)
     # StatefulClassifier handles BN state AND dropout rngs; models

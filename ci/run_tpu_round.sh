@@ -39,28 +39,36 @@ run() {  # run <name> <timeout_s> <cmd...>
   return $rc
 }
 
-# 1. headline ResNet-50 (full measurement; budget covers the probe's
-#    worst case ~780s plus the 2400s measurement child)
+# Steps are ordered by VALUE-PER-MINUTE, not by headline order: the
+# round-3 tunnel answered for ~10 minutes total, so the series must
+# bank SOMETHING real in the first minutes of a window.  Tier 1 takes
+# ~2-4 min cold and yields the first-ever suspect-gated TPU data
+# points (mlp model line + allreduce datum); tier 2 is the headline
+# ResNet-50; tier 3 widens.
+
+# --- tier 1: fast real data ------------------------------------------
+run bench_mlp 900 python bench.py --model mlp --quick
+run allreduce_tpu 1200 python benchmarks/allreduce_scaling.py --devices 1 --steps 10
+
+# --- tier 2: the headline (compile ~4-6 min/scan-length uncached) ----
 run bench_resnet50 3600 python bench.py
 
-# 2. the other BASELINE workloads (quick scans: still marginal-timed
-#    on-chip, shorter chains)
-for m in vgg16 googlenetbn seq2seq transformer mlp; do
+# --- tier 3: the other BASELINE workloads (quick scans) --------------
+for m in vgg16 googlenetbn seq2seq transformer; do
   run "bench_${m}" 2400 python bench.py --model "$m" --quick
 done
 
-# 3. transformer numerics gate: Pallas kernels vs jnp oracle on-device
+# transformer numerics gate: Pallas kernels vs jnp oracle on-device
 run bench_transformer_check 2400 python bench.py --model transformer --quick --check
 
-# 4. flash-attention kernel vs XLA attention + block-size sweep
+# flash-attention kernel vs XLA attention + block-size sweep
 run flash_attn 3000 python benchmarks/flash_attention_bench.py --sweep
 
-# 5. allreduce single-chip point (mesh=1; the scaling axis comes from
-#    the committed CPU-mesh run, this pins the real-chip datum)
-run allreduce_tpu 1200 python benchmarks/allreduce_scaling.py --devices 1 --steps 10
+# measured strategy comparison + profiler traces (VERDICT r3 item 9)
+run strategy_trace 2400 python benchmarks/strategy_trace.py
 
-# 6. Mosaic kernel gate (fast when compile cache is warm); conftest
-#    forces CPU unless told to keep the live platform
+# Mosaic kernel gate (fast when compile cache is warm); conftest
+# forces CPU unless told to keep the live platform
 run mosaic_gate 1200 env CHAINERMN_TPU_TEST_PLATFORM=axon \
     python -m pytest tests/test_tpu_mosaic.py -v
 
