@@ -56,7 +56,8 @@ watchdogged ``--child`` with a persistent XLA compile cache.
 Flags: ``--model NAME``, ``--quick`` (shorter scans), ``--cpu``
 (8-device virtual CPU mesh, plumbing check), ``--no-cost`` (skip cost
 analysis), ``--check`` (transformer only: pin Pallas kernels against
-the jnp oracle on-device and record ``numerics_vs_oracle_ok``).
+the jnp oracle on-device and record ``numerics_vs_oracle_ok``),
+``--batch N`` (per-device batch override, the MFU-chase lever).
 """
 
 import json
@@ -371,14 +372,15 @@ _CONV_MODELS = {
 }
 
 
-def _build_conv(name, quick, on_cpu):
+def _build_conv(name, quick, on_cpu, per_dev_override=None):
     import jax
 
     import chainermn_tpu.models as zoo
 
     cls_name, fwd_gf, per_dev_tpu, per_dev_cpu = _CONV_MODELS[name]
     insize = 64 if on_cpu else 224
-    per_dev = per_dev_cpu if on_cpu else per_dev_tpu
+    per_dev = per_dev_override or (per_dev_cpu if on_cpu
+                                   else per_dev_tpu)
     batch = per_dev * jax.device_count()
     model = getattr(zoo, cls_name)(num_classes=1000)
     upd, arrays = _classifier_setup(model, insize, batch)
@@ -414,7 +416,7 @@ def _updater_setup(loss, params, examples):
     return upd, upd.shard_batch(examples)
 
 
-def build_seq2seq(quick, on_cpu):
+def build_seq2seq(quick, on_cpu, per_dev_override=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -423,7 +425,7 @@ def build_seq2seq(quick, on_cpu):
 
     layers, units, vocab = (2, 256, 4000) if on_cpu else (2, 512, 8000)
     seq_len = 32 if on_cpu else 64
-    per_dev = 8 if on_cpu else 64
+    per_dev = per_dev_override or (8 if on_cpu else 64)
     batch = per_dev * jax.device_count()
     model = Seq2seq(n_layers=layers, n_source_vocab=vocab,
                     n_target_vocab=vocab, n_units=units)
@@ -452,7 +454,7 @@ def build_seq2seq(quick, on_cpu):
                 'tokens/sec via analytic flops per item')
 
 
-def build_transformer(quick, on_cpu):
+def build_transformer(quick, on_cpu, per_dev_override=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -465,6 +467,7 @@ def build_transformer(quick, on_cpu):
     else:
         d_model, n_heads, n_layers, seq, vocab, per_dev = \
             512, 8, 6, 1024, 32000, 8
+    per_dev = per_dev_override or per_dev
     batch = per_dev * jax.device_count()
     model = TransformerLM(vocab_size=vocab, d_model=d_model,
                           n_heads=n_heads, n_layers=n_layers,
@@ -538,14 +541,14 @@ def _transformer_numerics_check(model, params, toks, tgts):
             'numerics_gnorm_rel_err': round(rel_g, 6)}
 
 
-def build_mlp(quick, on_cpu):
+def build_mlp(quick, on_cpu, per_dev_override=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from chainermn_tpu.models import MLP, classifier_loss
 
-    per_dev = 128
+    per_dev = per_dev_override or 128
     batch = per_dev * jax.device_count()
     model = MLP(n_units=1000, n_out=10)
     rng = np.random.RandomState(0)
@@ -566,7 +569,7 @@ def build_mlp(quick, on_cpu):
 
 
 BUILDERS = dict(
-    {name: (lambda q, c, n=name: _build_conv(n, q, c))
+    {name: (lambda q, c, b=None, n=name: _build_conv(n, q, c, b))
      for name in _CONV_MODELS},
     seq2seq=build_seq2seq, transformer=build_transformer,
     mlp=build_mlp)
@@ -604,8 +607,11 @@ def measure(argv):
         bur_trustworthy = probe_block_until_ready()
         matmul_tflops, roofline_lin = calibrate_matmul_roofline(quick)
 
-    _log('building %s' % model_name)
-    cfg = BUILDERS[model_name](quick, on_cpu)
+    per_dev = parse_batch(argv, model_name)
+    _log('building %s%s' % (model_name,
+                            ' (per-device batch %d)' % per_dev
+                            if per_dev else ''))
+    cfg = BUILDERS[model_name](quick, on_cpu, per_dev)
     make = cfg['make']
 
     if on_cpu:
@@ -645,6 +651,7 @@ def measure(argv):
         sync_method='device_get',
         baseline_derivation=cfg['baseline_derivation'],
         global_batch_items=cfg['items'],
+        per_device_batch_override=per_dev,
     )
     if 'insize' in cfg:
         result['insize'] = cfg['insize']
@@ -718,6 +725,27 @@ def measure(argv):
     print(json.dumps(result), flush=True)
 
 
+def parse_batch(argv, model):
+    """Extract and validate ``--batch N`` (per-device override, the
+    MFU-chase lever -- VERDICT r3 item 3); structured error on a
+    missing/non-positive/non-integer value.  Called in the PARENT
+    before the expensive backend probe, and again in the child."""
+    if '--batch' not in argv:
+        return None
+    i = argv.index('--batch')
+    raw = argv[i + 1] if i + 1 < len(argv) else None
+    try:
+        val = int(raw)
+        if val <= 0:
+            raise ValueError
+    except (TypeError, ValueError):
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+                  error='bad_batch',
+                  detail='--batch needs a positive integer, got %r'
+                  % (raw,)), rc=1)
+    return val
+
+
 def parse_model(argv):
     """Extract and validate --model; emits the standard error line on
     a missing/unknown value (never a raw traceback)."""
@@ -736,6 +764,7 @@ def parse_model(argv):
 def main():
     argv = [a for a in sys.argv[1:]]
     model = parse_model(argv)
+    parse_batch(argv, model)  # fail fast, BEFORE the backend probe
     if '--child' in argv:
         measure([a for a in argv if a != '--child'])
         return

@@ -109,7 +109,9 @@ def main():
         # trace INDIVIDUAL jitted steps (warmed up first), not one
         # compiled scan: the per-step program is what shows the
         # backward/allreduce interleaving on the op timeline
-        tdir = os.path.join(res, 'traces', strategy)
+        # platform-scoped like the jsonl: a TPU run must not overwrite
+        # the CPU plumbing traces (or vice versa)
+        tdir = os.path.join(res, 'traces', platform, strategy)
         os.makedirs(tdir, exist_ok=True)
         from chainermn_tpu.utils.profiling import trace
         devget_sync(upd.update_core(arrays))  # compile + warm
