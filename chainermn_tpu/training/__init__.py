@@ -9,7 +9,8 @@ iterators, extensions, triggers), built around one jitted
 """
 
 from chainermn_tpu.training.iterators import (  # noqa
-    SerialIterator, MultiprocessIterator, PipelineIterator)
+    DevicePrefetchIterator, SerialIterator, MultiprocessIterator,
+    PipelineIterator)
 from chainermn_tpu.training import iterators  # noqa
 from chainermn_tpu.training.trainer import Trainer  # noqa
 from chainermn_tpu.training.updater import StandardUpdater  # noqa

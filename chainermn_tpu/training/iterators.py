@@ -147,7 +147,88 @@ class PipelineIterator:
     next = __next__
 
 
-class MultiprocessIterator:
+class _PrefetchingIterator:
+    """Shared worker/queue machinery for the prefetching iterators.
+
+    A daemon thread repeatedly calls :meth:`_produce` (subclass hook:
+    pull from the inner iterator, optionally transform, snapshot the
+    inner counters) and feeds a bounded queue; the consumer side
+    unpacks items in ``__next__``.  Threading invariants concentrated
+    here ONCE (they are subtle):
+
+    - the worker captures ITS OWN queue/stop event, so a stale worker
+      that outlives a reset (join timeout) keeps observing its
+      original, set stop event and abandoned queue rather than the
+      replacements -- it can never race the new worker on the shared
+      inner iterator once it finishes its in-flight item;
+    - puts are bounded with a stop check, so a producer blocked on a
+      full abandoned queue parks on stop, not forever;
+    - the terminal sentinel (StopIteration or a worker exception) is
+      REMEMBERED: the worker thread exits after sending it, so a
+      second ``next()`` would otherwise block on an empty queue for
+      good.  Post-terminal calls re-raise until :meth:`reset`.
+    """
+
+    def _start_worker(self):
+        self._queue = queue_mod.Queue(maxsize=self._depth)
+        self._stop = threading.Event()
+        self._terminal = None
+        self._thread = threading.Thread(
+            target=self._worker_loop, args=(self._queue, self._stop),
+            daemon=True)
+        self._thread.start()
+
+    def _stop_worker(self):
+        self._stop.set()
+        # drain so a producer blocked on put() can observe the stop flag
+        while self._thread.is_alive():
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue_mod.Empty:
+                pass
+            self._thread.join(timeout=0.2)
+
+    def _worker_loop(self, out_queue, stop):
+        try:
+            while not stop.is_set():
+                try:
+                    item = self._produce()
+                except StopIteration:
+                    out_queue.put(StopIteration)
+                    return
+                while not stop.is_set():
+                    try:
+                        out_queue.put(item, timeout=0.2)
+                        break
+                    except queue_mod.Full:
+                        continue
+        except Exception as e:  # surface worker failures to the consumer
+            out_queue.put(e)
+
+    def _next_item(self):
+        if self._terminal is not None:
+            raise self._terminal
+        item = self._queue.get()
+        if item is StopIteration:
+            self._terminal = StopIteration()
+            raise StopIteration
+        if isinstance(item, Exception):
+            self._terminal = item
+            raise item
+        return item
+
+    def __iter__(self):
+        return self
+
+    def finalize(self):
+        self._stop.set()
+        fin = getattr(self._source, 'finalize', None)
+        if fin is not None:
+            fin()  # the documented composition: stop the inner worker too
+
+
+class MultiprocessIterator(_PrefetchingIterator):
     """Prefetching iterator.
 
     The reference needs real worker *processes* (and ``forkserver``
@@ -164,45 +245,28 @@ class MultiprocessIterator:
                  seed=0, n_prefetch=4, n_processes=None):
         self.dataset = dataset
         self.batch_size = batch_size
-        self._inner = SerialIterator(dataset, batch_size, repeat, shuffle,
-                                     seed)
+        self._source = SerialIterator(dataset, batch_size, repeat,
+                                      shuffle, seed)
+        self._inner = self._source  # kept name: pre-refactor API
         self.epoch = 0
         self.iteration = 0
         self.is_new_epoch = False
         self._consumed_pos = 0
-        self._n_prefetch = n_prefetch
+        self._depth = n_prefetch
         self._start_worker()
 
-    def _start_worker(self):
-        self._queue = queue_mod.Queue(maxsize=self._n_prefetch)
-        self._stop = threading.Event()
-        # the worker captures ITS OWN queue/stop: a worker that
-        # outlives a reset (join timeout) keeps observing its original,
-        # set stop event and abandoned queue rather than the
-        # replacements, so it can never race the new worker on the
-        # shared inner iterator once it finishes its in-flight batch
-        self._thread = threading.Thread(
-            target=self._worker, args=(self._queue, self._stop),
-            daemon=True)
-        self._thread.start()
-
-    def _stop_worker(self):
-        self._stop.set()
-        # drain so a producer blocked on put() can observe the stop flag
-        while self._thread.is_alive():
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue_mod.Empty:
-                pass
-            self._thread.join(timeout=0.2)
+    def _produce(self):
+        inner = self._source
+        batch = next(inner)
+        return (batch, inner.epoch, inner.iteration,
+                inner.is_new_epoch, inner._pos)
 
     def reset(self):
         """Stop the current producer and restart from a fresh pass
         (needed for repeat=False evaluation iterators reused across
         epochs)."""
         self._stop_worker()
-        self._inner.reset()
+        self._source.reset()
         self.epoch = 0
         self.iteration = 0
         self.is_new_epoch = False
@@ -215,43 +279,14 @@ class MultiprocessIterator:
         epoch (plain attribute assignment would be overwritten by the
         next ``__next__``)."""
         self._stop_worker()
-        self._inner.epoch = int(epoch)
+        self._source.epoch = int(epoch)
         self.epoch = int(epoch)
+        self._consumed_pos = 0  # epoch_detail == restored epoch exactly
         self._start_worker()
 
-    def _worker(self, out_queue, stop):
-        inner = self._inner
-        try:
-            while not stop.is_set():
-                try:
-                    batch = next(inner)
-                except StopIteration:
-                    out_queue.put(StopIteration)
-                    return
-                item = (batch, inner.epoch, inner.iteration,
-                        inner.is_new_epoch, inner._pos)
-                # bounded put so a stale worker parks on stop, not on a
-                # full abandoned queue
-                while not stop.is_set():
-                    try:
-                        out_queue.put(item, timeout=0.2)
-                        break
-                    except queue_mod.Full:
-                        continue
-        except Exception as e:  # surface worker failures to the consumer
-            out_queue.put(e)
-
-    def __iter__(self):
-        return self
-
     def __next__(self):
-        item = self._queue.get()
-        if item is StopIteration:
-            raise StopIteration
-        if isinstance(item, Exception):
-            raise item
         batch, self.epoch, self.iteration, self.is_new_epoch, \
-            self._consumed_pos = item
+            self._consumed_pos = self._next_item()
         return batch
 
     next = __next__
@@ -260,5 +295,88 @@ class MultiprocessIterator:
     def epoch_detail(self):
         return self.epoch + self._consumed_pos / max(1, len(self.dataset))
 
-    def finalize(self):
-        self._stop.set()
+
+class DevicePrefetchIterator(_PrefetchingIterator):
+    """Overlap host collation + host->device transfer with the running
+    step: a worker thread pulls batches from ``inner``, runs
+    ``place_fn`` (typically ``StandardUpdater.shard_batch``: collate +
+    sharded ``device_put``) and queues the DEVICE-RESIDENT trees, so
+    ``__next__`` hands the train loop arrays that are already on (or
+    in flight to) the chips while the previous step executes.
+
+    This is the device-side half of the input pipeline
+    (:class:`MultiprocessIterator` is the host-side half; they
+    compose: wrap one in the other -- ``finalize`` propagates).  On
+    TPU the win is hiding the PCIe/ICI transfer and the numpy
+    collation behind the step; ``jax.device_put`` is async and
+    thread-safe, so the worker never blocks on the device.
+
+    Epoch accounting reflects what the CONSUMER has taken, not the
+    producer's read-ahead (same contract as
+    :class:`MultiprocessIterator`): the producer threads its counters
+    through the queue with each batch.
+
+    Used via ``StandardUpdater(..., device_prefetch=N)`` or directly::
+
+        it = DevicePrefetchIterator(SerialIterator(ds, bs),
+                                    upd.shard_batch, depth=2)
+        metrics = upd.update_core(next(it))
+    """
+
+    def __init__(self, inner, place_fn, depth=2):
+        if depth < 1:
+            raise ValueError('depth must be >= 1')
+        self.inner = inner
+        self._source = inner
+        self._place = place_fn
+        self._depth = depth
+        self._rebase_counters()
+        self._start_worker()
+
+    def _rebase_counters(self):
+        inner = self._source
+        self.epoch = getattr(inner, 'epoch', 0)
+        self.iteration = getattr(inner, 'iteration', 0)
+        self.is_new_epoch = False
+        self._consumed_detail = float(getattr(inner, 'epoch_detail',
+                                              0.0))
+
+    def _produce(self):
+        inner = self._source
+        batch = next(inner)
+        placed = self._place(batch)
+        return (placed, getattr(inner, 'epoch', 0),
+                getattr(inner, 'iteration', 0),
+                getattr(inner, 'is_new_epoch', False),
+                float(getattr(inner, 'epoch_detail', 0.0)))
+
+    def __next__(self):
+        placed, self.epoch, self.iteration, self.is_new_epoch, \
+            self._consumed_detail = self._next_item()
+        return placed
+
+    next = __next__
+
+    @property
+    def epoch_detail(self):
+        return self._consumed_detail
+
+    def reset(self):
+        self._stop_worker()
+        if hasattr(self.inner, 'reset'):
+            self.inner.reset()
+        self._rebase_counters()
+        self._start_worker()
+
+    def restore_epoch(self, epoch):
+        self._stop_worker()
+        if hasattr(self.inner, 'restore_epoch'):
+            self.inner.restore_epoch(epoch)
+        else:
+            self.inner.epoch = int(epoch)
+        self._rebase_counters()
+        # consumed-detail rebases to the restored epoch boundary so
+        # epoch/epoch_detail agree in the first post-resume log entry
+        self.epoch = int(epoch)
+        self._consumed_detail = float(int(epoch))
+        self._start_worker()

@@ -35,7 +35,7 @@ class StandardUpdater:
     def __init__(self, iterator, optimizer, loss_fn, params, comm,
                  has_aux=False, donate=True, model_state=None, rng=None,
                  zero=False, accum_steps=1, zero_check=True,
-                 zero_reduce_dtype=None):
+                 zero_reduce_dtype=None, device_prefetch=0):
         """``model_state``: optional non-trainable collections (e.g.
         BatchNorm running stats).  When given, ``loss_fn`` must have
         the extended signature
@@ -80,6 +80,14 @@ class StandardUpdater:
         micro-batches processed by ``lax.scan`` with gradients
         averaged before the (single) optimizer step -- k-times larger
         effective batch at 1/k activation memory.
+
+        ``device_prefetch=N`` (N >= 1) wraps the iterator in a
+        :class:`~chainermn_tpu.training.DevicePrefetchIterator`: a
+        worker thread collates and ``device_put``s up to N batches
+        ahead, so host input work and the host->device transfer
+        overlap the running step instead of serializing between
+        steps (pair with ``update(sync=False)`` /
+        ``Trainer(async_metrics=True)`` for a gap-free device).
         """
         self.iterator = iterator
         self.optimizer = optimizer
@@ -136,6 +144,12 @@ class StandardUpdater:
         self.iteration = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._step = self._build_step(donate)
+        self._device_prefetch = bool(device_prefetch)
+        if device_prefetch:
+            from chainermn_tpu.training.iterators import (
+                DevicePrefetchIterator)
+            self.iterator = DevicePrefetchIterator(
+                iterator, self.shard_batch, depth=device_prefetch)
 
     def _build_step(self, donate):
         comm = self.comm
@@ -330,7 +344,9 @@ class StandardUpdater:
         ahead and the device never idles between steps; convert with
         ``float()`` only where a value is actually consumed (see
         ``Trainer(async_metrics=True)``)."""
-        metrics = self.update_core(self.shard_batch(next(self.iterator)))
+        batch = next(self.iterator)
+        metrics = self.update_core(
+            batch if self._device_prefetch else self.shard_batch(batch))
         if not sync:
             return dict(metrics)
         return {k: float(v) for k, v in metrics.items()}

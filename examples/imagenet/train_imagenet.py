@@ -36,6 +36,9 @@ def main():
     parser.add_argument('--epoch', '-E', type=int, default=10)
     parser.add_argument('--communicator', default='xla')
     parser.add_argument('--loaderjob', '-j', type=int, default=4)
+    parser.add_argument('--device-prefetch', type=int, default=2,
+                        help='batches collated + device_put ahead of '
+                             'the running step (0 disables)')
     parser.add_argument('--pipeline', choices=['thread', 'native'],
                         default='thread',
                         help='input pipeline: per-item prefetch thread '
@@ -152,7 +155,8 @@ def main():
 
     updater = training.StandardUpdater(
         train_iter, optimizer, clf.loss, params, comm,
-        model_state=model_state)
+        model_state=model_state,
+        device_prefetch=args.device_prefetch)
     n_epoch = 1 if args.quick else args.epoch
     # async_metrics: metrics stay on device each iteration (no per-step
     # host round trip); LogReport/PrintReport fetch them lazily at

@@ -338,3 +338,120 @@ def test_batch_pipeline_uint8_store():
     assert iu.dtype == np.float32
     np.testing.assert_allclose(iu, if_, atol=1e-5)
     np.testing.assert_array_equal(lu, lf)
+
+
+def _prefetch_updater(device_prefetch):
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    ds = _toy_dataset(64)
+    model = MLP(n_units=8, n_out=3)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.float32))
+    clf = Classifier(model.apply)
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    it = training.SerialIterator(ds, 32, shuffle=False)
+    return training.StandardUpdater(
+        it, opt, clf, params, comm, has_aux=True,
+        device_prefetch=device_prefetch)
+
+
+def test_device_prefetch_matches_unprefetched():
+    """device_prefetch=N must be a pure latency optimization: same
+    batches in the same order, identical trajectory, and epoch
+    accounting that reflects CONSUMED batches (not the worker's
+    read-ahead)."""
+    upd_ref = _prefetch_updater(0)
+    upd_pre = _prefetch_updater(2)
+    # worker reads ahead immediately; the consumer has taken nothing,
+    # so consumer-visible accounting must still be at zero
+    assert upd_pre.epoch == 0
+    assert upd_pre.epoch_detail == 0.0
+    for i in range(6):  # 2 batches/epoch: crosses epoch boundaries
+        m_ref = upd_ref.update()
+        m_pre = upd_pre.update()
+        assert abs(m_ref['loss'] - m_pre['loss']) < 1e-6, \
+            (i, m_ref, m_pre)
+        assert upd_pre.epoch == upd_ref.epoch, i
+        assert upd_pre.is_new_epoch == upd_ref.is_new_epoch, i
+        assert abs(upd_pre.epoch_detail - upd_ref.epoch_detail) < 1e-9
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(upd_ref.params)),
+            jax.tree_util.tree_leaves(jax.device_get(upd_pre.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_device_prefetch_places_on_mesh():
+    """The prefetched trees are already device-resident with the
+    batch sharding (that is the point: the transfer happened behind
+    the previous step)."""
+    upd = _prefetch_updater(2)
+    arrays = next(upd.iterator)
+    ref = upd.shard_batch([upd.iterator.inner.dataset[i]
+                           for i in range(32)])
+    for got, want in zip(arrays, ref):
+        assert got.sharding == want.sharding
+        assert got.shape == want.shape
+
+
+def test_device_prefetch_propagates_worker_errors():
+    from chainermn_tpu.training import DevicePrefetchIterator
+
+    def boom(_batch):
+        raise RuntimeError('collate failed')
+
+    it = DevicePrefetchIterator(
+        training.SerialIterator(_toy_dataset(8), 4), boom, depth=1)
+    with pytest.raises(RuntimeError, match='collate failed'):
+        next(it)
+    with pytest.raises(ValueError, match='depth'):
+        DevicePrefetchIterator(
+            training.SerialIterator(_toy_dataset(8), 4),
+            lambda b: b, depth=0)
+
+
+def test_prefetch_iterators_reraise_after_exhaustion():
+    """Iterator protocol: next() after the terminal StopIteration (or
+    a worker error) must re-raise, not deadlock on the dead worker's
+    empty queue."""
+    from chainermn_tpu.training import DevicePrefetchIterator
+
+    it = training.iterators.MultiprocessIterator(
+        _toy_dataset(8), 4, repeat=False, shuffle=False)
+    assert len(list(it)) == 2
+    with pytest.raises(StopIteration):
+        next(it)  # second terminal call: must not hang
+    it.reset()
+    assert len(list(it)) == 2
+
+    dit = DevicePrefetchIterator(
+        training.SerialIterator(_toy_dataset(8), 4, repeat=False,
+                                shuffle=False),
+        lambda b: b, depth=1)
+    assert len(list(dit)) == 2
+    with pytest.raises(StopIteration):
+        next(dit)
+
+    def boom(_b):
+        raise RuntimeError('collate failed')
+
+    bad = DevicePrefetchIterator(
+        training.SerialIterator(_toy_dataset(8), 4), boom, depth=1)
+    for _ in range(2):  # error is sticky, not a hang
+        with pytest.raises(RuntimeError, match='collate failed'):
+            next(bad)
+
+
+def test_device_prefetch_finalize_propagates():
+    """The documented composition (device wrapper over the host-side
+    MultiprocessIterator) must not leak the inner worker thread on
+    finalize."""
+    from chainermn_tpu.training import DevicePrefetchIterator
+
+    inner = training.iterators.MultiprocessIterator(
+        _toy_dataset(16), 4, n_prefetch=2)
+    outer = DevicePrefetchIterator(inner, lambda b: b, depth=1)
+    next(outer)
+    outer.finalize()
+    assert inner._stop.is_set()
+    inner._thread.join(timeout=5)
+    assert not inner._thread.is_alive()
