@@ -27,6 +27,7 @@ numerics oracle.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -482,14 +483,42 @@ def _flash_bwd(causal, scale, kv_len, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _env_block(name, default=128):
+    """Validated env-sourced block size: a fleet-wide launcher knob
+    must fail naming itself, not as an opaque int()/ZeroDivision deep
+    inside the model step."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    try:
+        val = int(raw)
+    except ValueError:
+        raise ValueError('%s must be a positive integer, got %r'
+                         % (name, raw)) from None
+    if val <= 0:
+        raise ValueError('%s must be a positive integer, got %r'
+                         % (name, raw))
+    return val
+
+
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=128, block_k=128):
+                    block_q=None, block_k=None):
     """Fused attention. q: (B, Tq, H, D), k/v: (B, Tkv, H, D).
 
     Sequence lengths are padded to kernel block multiples internally
     (padded keys are masked out; padded query rows are dropped); with
     ``causal=True``, Tq must equal Tkv (self-attention).
+
+    Block sizes default to 128x128; ``CHAINERMN_TPU_FA_BLOCK_Q`` /
+    ``CHAINERMN_TPU_FA_BLOCK_K`` override the defaults per process
+    (read at trace time) -- how a winner from the benchmark sweep
+    (``benchmarks/flash_attention_bench.py --sweep``) is adopted for
+    every model without code edits.  Explicit arguments win.
     """
+    if block_q is None:
+        block_q = _env_block('CHAINERMN_TPU_FA_BLOCK_Q')
+    if block_k is None:
+        block_k = _env_block('CHAINERMN_TPU_FA_BLOCK_K')
     b, t_q, h, d = q.shape
     t_kv = k.shape[1]
     if causal and t_q != t_kv:

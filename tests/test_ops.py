@@ -180,3 +180,44 @@ class TestFusedSGD:
         assert all(v.dtype == jnp.float32 for v in vel
                    if hasattr(v, 'dtype') and v.ndim), state
         assert params['w'].dtype == jnp.bfloat16
+
+
+def test_flash_attention_block_env_override(monkeypatch):
+    """CHAINERMN_TPU_FA_BLOCK_Q/_K set the default block sizes (the
+    sweep-adoption path).  Numerics are block-size independent, so the
+    teeth here are CONSUMPTION and PRECEDENCE, proven via the
+    validation error: a poisoned env must fire exactly when (and only
+    when) the env default would be consulted."""
+    import numpy as np
+
+    from chainermn_tpu import ops
+
+    rng = jax.random.PRNGKey(0)
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (1, 64, 2, 16), jnp.float32)
+    k = jax.random.normal(kk, (1, 64, 2, 16), jnp.float32)
+    v = jax.random.normal(kv, (1, 64, 2, 16), jnp.float32)
+    explicit = ops.flash_attention(q, k, v, causal=True,
+                                   block_q=32, block_k=32)
+
+    # a malformed value fails loudly, NAMING the variable -- and only
+    # when the default is actually consulted, which also proves the
+    # env is consumed at all
+    monkeypatch.setenv('CHAINERMN_TPU_FA_BLOCK_Q', 'bogus')
+    with pytest.raises(ValueError, match='CHAINERMN_TPU_FA_BLOCK_Q'):
+        ops.flash_attention(q, k, v, causal=True)
+    with pytest.raises(ValueError, match='CHAINERMN_TPU_FA_BLOCK_Q'):
+        ops.flash_attention(q, k, v, causal=True, block_k=32)
+    monkeypatch.setenv('CHAINERMN_TPU_FA_BLOCK_K', '0')
+    # explicit arguments win: the poisoned env is never consulted
+    wins = ops.flash_attention(q, k, v, causal=True,
+                               block_q=32, block_k=32)
+    np.testing.assert_allclose(np.asarray(wins), np.asarray(explicit),
+                               atol=1e-6)
+
+    # a valid env value is adopted and matches its explicit twin
+    monkeypatch.setenv('CHAINERMN_TPU_FA_BLOCK_Q', '32')
+    monkeypatch.setenv('CHAINERMN_TPU_FA_BLOCK_K', '32')
+    via_env = ops.flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(via_env),
+                               np.asarray(explicit), atol=1e-6)
