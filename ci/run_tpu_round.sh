@@ -75,6 +75,13 @@ run strategy_trace 2400 python benchmarks/strategy_trace.py
 run mosaic_gate 1200 env CHAINERMN_TPU_TEST_PLATFORM=axon \
     python -m pytest tests/test_tpu_mosaic.py -v
 
+# --- tier 4 (only if the window is still open): the MFU direction ---
+# per-device batch sweep on the headline model; each point costs its
+# own scan compiles, so this runs LAST (PERF.md knob 1)
+for B in 64 128; do
+  run "bench_resnet50_b${B}" 2400 python bench.py --quick --batch "$B"
+done
+
 echo "=== series done; JSON lines:" >&2
 for f in "$RES"/bench_*_"$TAG".out; do
   tail -1 "$f"

@@ -188,10 +188,6 @@ def test_flash_attention_block_env_override(monkeypatch):
     teeth here are CONSUMPTION and PRECEDENCE, proven via the
     validation error: a poisoned env must fire exactly when (and only
     when) the env default would be consulted."""
-    import numpy as np
-
-    from chainermn_tpu import ops
-
     rng = jax.random.PRNGKey(0)
     kq, kk, kv = jax.random.split(rng, 3)
     q = jax.random.normal(kq, (1, 64, 2, 16), jnp.float32)
