@@ -111,21 +111,25 @@ def main():
 
             per, _ov, _times, lin = marginal_time(
                 make, (2, 4, 6), reps=3)
-            key = name
-            baseline.setdefault(key, per)
-            eff = baseline[key] / per
             row = {
                 'metric': 'allreduce_time_ms',
                 'strategy': name,
                 'devices': n,
                 'value': round(per * 1e3, 3),
                 'payload_mb': round(args.params * 4 / 1e6, 1),
-                'scaling_efficiency': round(eff, 3),
                 'linearity_rel_err': round(lin, 4),
                 'sync_method': 'device_get',
             }
             if lin > LINEARITY_GATE:
                 row['suspect'] = True
+            # efficiency only against a TRUSTED smallest-mesh row: a
+            # suspect baseline would silently poison every later
+            # row's ratio (suspect data is never published raw)
+            if 'suspect' not in row:
+                baseline.setdefault(name, per)
+            if name in baseline:
+                row['scaling_efficiency'] = round(
+                    baseline[name] / per, 3)
             print(json.dumps(row))
 
 

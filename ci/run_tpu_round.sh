@@ -51,7 +51,7 @@ run() {  # run <name> <timeout_s> <cmd...>
 # flaky tunnel before the quick child even starts; the step is fast
 # when the tunnel is healthy, the bound only caps the worst case)
 run bench_mlp 2400 python bench.py --model mlp --quick
-run allreduce_tpu 1200 python benchmarks/allreduce_scaling.py --devices 1 --steps 10
+run allreduce_tpu 1200 python benchmarks/allreduce_scaling.py --devices 1
 
 # --- tier 2: the headline (compile ~4-6 min/scan-length uncached) ----
 run bench_resnet50 3600 python bench.py
