@@ -57,7 +57,9 @@ Flags: ``--model NAME``, ``--quick`` (shorter scans), ``--cpu``
 (8-device virtual CPU mesh, plumbing check), ``--no-cost`` (skip cost
 analysis), ``--check`` (transformer only: pin Pallas kernels against
 the jnp oracle on-device and record ``numerics_vs_oracle_ok``),
-``--batch N`` (per-device batch override, the MFU-chase lever).
+``--batch N`` (per-device batch override, the MFU-chase lever),
+``--s2d`` (resnet50 only: MXU-friendly space-to-depth stem, exact
+weight-mapped equivalent of the 7x7/2 stem -- ``models/resnet50.py``).
 """
 
 import json
@@ -372,7 +374,8 @@ _CONV_MODELS = {
 }
 
 
-def _build_conv(name, quick, on_cpu, per_dev_override=None):
+def _build_conv(name, quick, on_cpu, per_dev_override=None,
+                s2d=False):
     import jax
 
     import chainermn_tpu.models as zoo
@@ -382,7 +385,15 @@ def _build_conv(name, quick, on_cpu, per_dev_override=None):
     per_dev = per_dev_override or (per_dev_cpu if on_cpu
                                    else per_dev_tpu)
     batch = per_dev * jax.device_count()
-    model = getattr(zoo, cls_name)(num_classes=1000)
+    # analytic_flops deliberately stays the REFERENCE model's useful
+    # work even under --s2d: images/sec is the judged rate and the s2d
+    # stem's extra MACs (4x4x12 vs 7x7x3 per output, ~1.7% of the
+    # model) are layout overhead, not useful work.  XLA's own count
+    # includes them, so flop_count_ratio_xla_over_analytic reads
+    # ~1.017 on s2d rows by design.
+    model = getattr(zoo, cls_name)(
+        num_classes=1000,
+        **({'stem': 'space_to_depth'} if s2d else {}))
     upd, arrays = _classifier_setup(model, insize, batch)
     fwd = fwd_gf * 1e9 * (insize / 224.0) ** 2
     base = BASELINE_IMG_PER_SEC_PER_CHIP * (4.1 / fwd_gf) \
@@ -569,7 +580,8 @@ def build_mlp(quick, on_cpu, per_dev_override=None):
 
 
 BUILDERS = dict(
-    {name: (lambda q, c, b=None, n=name: _build_conv(n, q, c, b))
+    {name: (lambda q, c, b=None, n=name, **kw:
+            _build_conv(n, q, c, b, **kw))
      for name in _CONV_MODELS},
     seq2seq=build_seq2seq, transformer=build_transformer,
     mlp=build_mlp)
@@ -608,10 +620,13 @@ def measure(argv):
         matmul_tflops, roofline_lin = calibrate_matmul_roofline(quick)
 
     per_dev = parse_batch(argv, model_name)
-    _log('building %s%s' % (model_name,
-                            ' (per-device batch %d)' % per_dev
-                            if per_dev else ''))
-    cfg = BUILDERS[model_name](quick, on_cpu, per_dev)
+    s2d = parse_s2d(argv, model_name)
+    _log('building %s%s%s' % (model_name,
+                              ' (per-device batch %d)' % per_dev
+                              if per_dev else '',
+                              ' (s2d stem)' if s2d else ''))
+    cfg = BUILDERS[model_name](quick, on_cpu, per_dev,
+                               **({'s2d': True} if s2d else {}))
     make = cfg['make']
 
     if on_cpu:
@@ -652,6 +667,7 @@ def measure(argv):
         baseline_derivation=cfg['baseline_derivation'],
         global_batch_items=cfg['items'],
         per_device_batch_override=per_dev,
+        stem='space_to_depth' if s2d else None,
     )
     if 'insize' in cfg:
         result['insize'] = cfg['insize']
@@ -746,6 +762,19 @@ def parse_batch(argv, model):
     return val
 
 
+def parse_s2d(argv, model):
+    """``--s2d`` (space-to-depth stem) is resnet50-only; validated in
+    the PARENT before the backend probe, like the other flags."""
+    if '--s2d' not in argv:
+        return False
+    if model != 'resnet50':
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+                  error='bad_flag',
+                  detail='--s2d (space-to-depth stem) applies to '
+                  '--model resnet50 only'), rc=1)
+    return True
+
+
 def parse_model(argv):
     """Extract and validate --model; emits the standard error line on
     a missing/unknown value (never a raw traceback)."""
@@ -764,7 +793,9 @@ def parse_model(argv):
 def main():
     argv = [a for a in sys.argv[1:]]
     model = parse_model(argv)
-    parse_batch(argv, model)  # fail fast, BEFORE the backend probe
+    # fail fast on flag mistakes BEFORE the backend probe
+    parse_batch(argv, model)
+    parse_s2d(argv, model)
     if '--child' in argv:
         measure([a for a in argv if a != '--child'])
         return

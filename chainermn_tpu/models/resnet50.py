@@ -45,17 +45,46 @@ class Bottleneck(nn.Module):
 
 
 class ResNet(nn.Module):
+    """``stem='space_to_depth'`` replaces the 7x7/stride-2 stem conv
+    with a mathematically equivalent 4x4/stride-1 conv over the 2x2
+    space-to-depth rearrangement of the input (the MLPerf TPU ResNet
+    trick): 3-channel 7x7 convs waste the MXU's 128-deep reduction
+    axis and the strided first conv is layout-hostile; the s2d form
+    feeds the MXU 12 input channels at stride 1.  Exact equivalence
+    (a weight mapping turns one stem into the other bit-for-bit in
+    f32) is pinned in ``tests/test_models.py``."""
+
     stage_sizes: Sequence[int]
     num_classes: int = 1000
     width: int = 64
     dtype: Any = jnp.bfloat16
     insize: int = 224  # reference resnet50.py insize=224
+    stem: str = 'standard'
 
     @nn.compact
     def __call__(self, x, train=True):
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), use_bias=False,
-                    dtype=self.dtype, name='conv_init')(x)
+        if self.stem == 'space_to_depth':
+            b, h, w, c = x.shape
+            if h % 2 or w % 2:
+                raise ValueError('space_to_depth stem needs even '
+                                 'spatial dims, got %s' % ((h, w),))
+            x = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            x = x.transpose(0, 1, 3, 2, 4, 5)
+            x = x.reshape(b, h // 2, w // 2, 4 * c)
+            # pad (1,2): the 4 stride-1 taps cover source q in
+            # [p-1, p+2], matching the 7x7/s2 conv's SAME pad (2,3)
+            x = jnp.pad(x, ((0, 0), (1, 2), (1, 2), (0, 0)))
+            x = nn.Conv(self.width, (4, 4), strides=(1, 1),
+                        padding='VALID', use_bias=False,
+                        dtype=self.dtype, name='conv_init_s2d')(x)
+        elif self.stem == 'standard':
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                        use_bias=False, dtype=self.dtype,
+                        name='conv_init')(x)
+        else:
+            raise ValueError("stem must be 'standard' or "
+                             "'space_to_depth', got %r" % (self.stem,))
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype,
                          param_dtype=jnp.float32, name='bn_init')(x)
@@ -72,9 +101,37 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
-def ResNet50(num_classes=1000, dtype=jnp.bfloat16):
+def ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem='standard'):
     return ResNet(stage_sizes=[3, 4, 6, 3], num_classes=num_classes,
-                  dtype=dtype)
+                  dtype=dtype, stem=stem)
+
+
+def s2d_stem_kernel(w7):
+    """Map a standard (7, 7, C, F) stem kernel to the equivalent
+    (4, 4, 4C, F) space-to-depth kernel: tap ``t = 2a + phi`` of the
+    strided 7x7 window lands on s2d tap ``a``, phase channel ``phi``
+    (taps with t == 7 do not exist and stay zero).  With this mapping
+    the two stems compute the SAME function -- the equivalence test
+    pins it, and pretrained standard-stem checkpoints convert
+    losslessly."""
+    import numpy as np
+
+    w7 = np.asarray(w7)
+    c, f = w7.shape[2], w7.shape[3]
+    w4 = np.zeros((4, 4, 4 * c, f), w7.dtype)
+    for ah in range(4):
+        for ph in range(2):
+            th = 2 * ah + ph
+            if th > 6:
+                continue
+            for aw in range(4):
+                for pw in range(2):
+                    tw = 2 * aw + pw
+                    if tw > 6:
+                        continue
+                    ch = (ph * 2 + pw) * c
+                    w4[ah, aw, ch:ch + c, :] = w7[th, tw]
+    return w4
 
 
 def ResNet101(num_classes=1000, dtype=jnp.bfloat16):

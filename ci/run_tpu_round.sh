@@ -81,6 +81,8 @@ run mosaic_gate 1200 env CHAINERMN_TPU_TEST_PLATFORM=axon \
 for B in 64 128; do
   run "bench_resnet50_b${B}" 2400 python bench.py --quick --batch "$B"
 done
+# MXU-friendly space-to-depth stem (exact equivalent; models/resnet50.py)
+run bench_resnet50_s2d 2400 python bench.py --quick --s2d
 
 echo "=== series done; JSON lines:" >&2
 for f in "$RES"/bench_*_"$TAG".out; do

@@ -115,3 +115,49 @@ def test_bucket_batches():
 def test_unknown_arch():
     with pytest.raises(ValueError):
         models.get_arch('resnet9000')
+
+
+def test_resnet_s2d_stem_exactly_equivalent():
+    """The space-to-depth stem computes the SAME function as the
+    standard 7x7/stride-2 stem under the documented weight mapping
+    (s2d_stem_kernel) -- in f32 the outputs must agree to roundoff, so
+    the MXU-friendly stem is a pure layout optimization, not a model
+    change."""
+    import copy
+
+    from chainermn_tpu.models import ResNet
+    from chainermn_tpu.models.resnet50 import s2d_stem_kernel
+
+    kw = dict(stage_sizes=[1], num_classes=5, width=8,
+              dtype=jnp.float32)
+    std = ResNet(stem='standard', **kw)
+    s2d = ResNet(stem='space_to_depth', **kw)
+    x = jnp.asarray(
+        np.random.RandomState(0).rand(2, 32, 32, 3), jnp.float32)
+    v_std = std.init({'params': jax.random.PRNGKey(0)}, x, train=False)
+    v_s2d = s2d.init({'params': jax.random.PRNGKey(1)}, x, train=False)
+
+    # build the s2d variables FROM the standard ones: identical
+    # everywhere except the mapped stem kernel
+    params = copy.deepcopy(jax.device_get(v_std['params']))
+    w7 = params.pop('conv_init')['kernel']
+    params['conv_init_s2d'] = {
+        'kernel': jnp.asarray(s2d_stem_kernel(w7))}
+    assert jax.tree_util.tree_structure(
+        {'params': params, **{k: v for k, v in v_std.items()
+                              if k != 'params'}}) \
+        == jax.tree_util.tree_structure(v_s2d)
+
+    out_std = std.apply(v_std, x, train=False)
+    out_s2d = s2d.apply(
+        {'params': params,
+         **{k: v for k, v in v_std.items() if k != 'params'}},
+        x, train=False)
+    np.testing.assert_allclose(np.asarray(out_s2d),
+                               np.asarray(out_std),
+                               rtol=1e-5, atol=1e-5)
+
+    # odd spatial dims are rejected loudly
+    with pytest.raises(ValueError, match='even'):
+        s2d.init({'params': jax.random.PRNGKey(0)},
+                 jnp.zeros((1, 31, 31, 3)), train=False)
