@@ -34,6 +34,12 @@ def get_arch(name, **kwargs):
         'googlenetbn': GoogLeNetBN,
         'nin': NIN,
         'resnet50': ResNet50,
+        # MXU-friendly space-to-depth stem; exact weight-mapped
+        # equivalent of resnet50 (models/resnet50.py)
+        'resnet50_s2d': (lambda **kw: ResNet50(
+            stem='space_to_depth', **kw)),
+        'resnet101': ResNet101,
+        'resnet152': ResNet152,
         'vgg16': VGG16,
     }
     if name not in archs:
