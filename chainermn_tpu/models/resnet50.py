@@ -106,6 +106,22 @@ def ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem='standard'):
                   dtype=dtype, stem=stem)
 
 
+def convert_stem_variables(variables):
+    """Convert a standard-stem ResNet variable tree to the
+    space-to-depth-stem layout (losslessly: :func:`s2d_stem_kernel`
+    maps the one differing kernel; everything else is shared).  The
+    equivalence tests pin that the converted model computes the same
+    function."""
+    import jax
+
+    params = dict(jax.device_get(variables['params']))
+    w7 = params.pop('conv_init')['kernel']
+    params['conv_init_s2d'] = {
+        'kernel': jnp.asarray(s2d_stem_kernel(w7))}
+    return {'params': params,
+            **{k: v for k, v in variables.items() if k != 'params'}}
+
+
 def s2d_stem_kernel(w7):
     """Map a standard (7, 7, C, F) stem kernel to the equivalent
     (4, 4, 4C, F) space-to-depth kernel: tap ``t = 2a + phi`` of the

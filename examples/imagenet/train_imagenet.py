@@ -30,7 +30,8 @@ def main():
     parser = argparse.ArgumentParser(
         description='ChainerMN-TPU ImageNet')
     parser.add_argument('--arch', '-a', default='resnet50',
-                        help='alex|googlenet|googlenetbn|nin|resnet50|vgg16')
+                        help='alex|googlenet|googlenetbn|nin|resnet50|'
+                             'resnet50_s2d|resnet101|resnet152|vgg16')
     parser.add_argument('--batchsize', '-B', type=int, default=256,
                         help='global batch size')
     parser.add_argument('--epoch', '-E', type=int, default=10)

@@ -17,6 +17,9 @@ ZOO = [
     ('googlenet', 224, (10, 16)),
     ('googlenetbn', 224, (8, 20)),
     ('resnet50', 224, (23, 28)),
+    ('resnet50_s2d', 224, (23, 28)),
+    ('resnet101', 224, (40, 48)),
+    ('resnet152', 224, (55, 65)),
 ]
 
 
@@ -123,10 +126,8 @@ def test_resnet_s2d_stem_exactly_equivalent():
     (s2d_stem_kernel) -- in f32 the outputs must agree to roundoff, so
     the MXU-friendly stem is a pure layout optimization, not a model
     change."""
-    import copy
-
     from chainermn_tpu.models import ResNet
-    from chainermn_tpu.models.resnet50 import s2d_stem_kernel
+    from chainermn_tpu.models.resnet50 import convert_stem_variables
 
     kw = dict(stage_sizes=[1], num_classes=5, width=8,
               dtype=jnp.float32)
@@ -137,22 +138,14 @@ def test_resnet_s2d_stem_exactly_equivalent():
     v_std = std.init({'params': jax.random.PRNGKey(0)}, x, train=False)
     v_s2d = s2d.init({'params': jax.random.PRNGKey(1)}, x, train=False)
 
-    # build the s2d variables FROM the standard ones: identical
-    # everywhere except the mapped stem kernel
-    params = copy.deepcopy(jax.device_get(v_std['params']))
-    w7 = params.pop('conv_init')['kernel']
-    params['conv_init_s2d'] = {
-        'kernel': jnp.asarray(s2d_stem_kernel(w7))}
-    assert jax.tree_util.tree_structure(
-        {'params': params, **{k: v for k, v in v_std.items()
-                              if k != 'params'}}) \
+    # the converter builds the s2d variables FROM the standard ones:
+    # identical everywhere except the mapped stem kernel
+    converted = convert_stem_variables(v_std)
+    assert jax.tree_util.tree_structure(converted) \
         == jax.tree_util.tree_structure(v_s2d)
 
     out_std = std.apply(v_std, x, train=False)
-    out_s2d = s2d.apply(
-        {'params': params,
-         **{k: v for k, v in v_std.items() if k != 'params'}},
-        x, train=False)
+    out_s2d = s2d.apply(converted, x, train=False)
     np.testing.assert_allclose(np.asarray(out_s2d),
                                np.asarray(out_std),
                                rtol=1e-5, atol=1e-5)

@@ -153,3 +153,31 @@ def test_transformer_step_mosaic():
             os.environ['CHAINERMN_TPU_PALLAS'] = prior
     assert abs(l_pallas - l_oracle) / max(abs(l_oracle), 1e-6) < 2e-2
     assert abs(g_pallas - g_oracle) / max(abs(g_oracle), 1e-6) < 5e-2
+
+
+def test_s2d_stem_equivalence_on_tpu():
+    """The space-to-depth stem must stay an exact weight-mapped
+    equivalent of the 7x7/2 stem when XLA:TPU compiles both conv
+    forms (layout/tiling differences must not change the math beyond
+    f32 roundoff)."""
+    from chainermn_tpu.models import ResNet
+    from chainermn_tpu.models.resnet50 import convert_stem_variables
+
+    kw = dict(stage_sizes=[1], num_classes=10, width=16,
+              dtype=jnp.float32)
+    std = ResNet(stem='standard', **kw)
+    s2d = ResNet(stem='space_to_depth', **kw)
+    x = jnp.asarray(
+        np.random.RandomState(0).rand(2, 64, 64, 3), jnp.float32)
+    v_std = std.init({'params': jax.random.PRNGKey(0)}, x,
+                     train=False)
+    # true-f32 conv passes: at DEFAULT precision XLA:TPU uses bf16
+    # multiply passes, and the differently-shaped stems accumulate in
+    # different tap order -- the equivalence claim is about f32 math
+    with jax.default_matmul_precision('float32'):
+        out_std = jax.jit(
+            lambda v, xx: std.apply(v, xx, train=False))(v_std, x)
+        out_s2d = jax.jit(
+            lambda v, xx: s2d.apply(v, xx, train=False))(
+                convert_stem_variables(v_std), x)
+    _close(out_s2d, out_std, rtol=1e-3, name='s2d stem')
