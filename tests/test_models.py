@@ -154,3 +154,37 @@ def test_resnet_s2d_stem_exactly_equivalent():
     with pytest.raises(ValueError, match='even'):
         s2d.init({'params': jax.random.PRNGKey(0)},
                  jnp.zeros((1, 31, 31, 3)), train=False)
+
+
+def test_resnet_s2d_stem_lowering_feeds_wide_channels():
+    """The point of the s2d stem is structural: the first conv the
+    compiler sees consumes 12 input channels at stride 1 instead of 3
+    at stride 2.  Pin it in the lowered HLO so a regression in the
+    rearrangement (e.g. a transpose that XLA folds away differently)
+    breaks loudly."""
+    from chainermn_tpu.models import ResNet
+
+    kw = dict(stage_sizes=[1], num_classes=5, width=8,
+              dtype=jnp.float32)
+    x = jnp.zeros((1, 32, 32, 3), jnp.float32)
+
+    def lowered(stem):
+        model = ResNet(stem=stem, **kw)
+        v = jax.eval_shape(
+            lambda: model.init({'params': jax.random.PRNGKey(0)}, x,
+                               train=False))
+        v = jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), v)
+        return jax.jit(
+            lambda vv: model.apply(vv, x, train=False)).lower(
+                v).as_text()
+
+    s2d = lowered('space_to_depth')
+    std = lowered('standard')
+    # stablehlo convolution ops carry their operand types inline: the
+    # conv must consume the PADDED 12-channel rearrangement
+    # (32x32 -> s2d 16x16x12 -> pad(1,2) -> 19x19x12)
+    assert '1x19x19x12xf32' in s2d, \
+        's2d stem conv does not consume the padded 12-channel input'
+    assert '4x4x12x8xf32' in s2d, 'expected a 4x4x12->8 stem kernel'
+    assert '7x7x3x8xf32' in std, 'expected the standard 7x7x3 stem'
