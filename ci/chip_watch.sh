@@ -56,6 +56,27 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     rc=$?
     log "series done rc=$rc"
     if [ "$rc" -eq 0 ]; then
+      # commit the banked artifacts immediately: a window can open
+      # and close unattended, and these measurements are the round's
+      # most valuable output.  Retry on a transient index lock from
+      # concurrent git use; pathspec-restricted so a concurrently
+      # staged unrelated file can never be swept into this commit.
+      committed=no
+      for _ in 1 2 3 4 5; do
+        if { git add -- "$RES" && git commit -q -m \
+          "TPU measurement series ${TAG}: artifacts from a chip-watch window" \
+          -- "$RES"; } >> "$LOG" 2>&1; then
+          log "artifacts committed"
+          committed=yes
+          break
+        fi
+        log "git add/commit failed; retrying in 10s"
+        sleep 10
+      done
+      if [ "$committed" = no ]; then
+        log "artifact commit FAILED after 5 attempts -- results are" \
+            "UNCOMMITTED in $RES (see git errors above)"
+      fi
       exit 0
     fi
     # Preflight passed but the series died (window closed mid-run):
