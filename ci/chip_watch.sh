@@ -55,18 +55,20 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
     bash ci/run_tpu_round.sh "$TAG" >> "$LOG" 2>&1
     rc=$?
     log "series done rc=$rc"
-    if [ "$rc" -eq 0 ]; then
-      # commit the banked artifacts immediately: a window can open
-      # and close unattended, and these measurements are the round's
-      # most valuable output.  Retry on a transient index lock from
-      # concurrent git use; pathspec-restricted so a concurrently
-      # staged unrelated file can never be swept into this commit.
+    # Commit whatever was banked, SUCCESS OR PARTIAL: a window that
+    # closes mid-run (the round-3 failure mode) must not leave real
+    # TPU data uncommitted for a later partial rerun to clobber.
+    # Retry on transient index locks; pathspec-restricted so a
+    # concurrently staged unrelated file can never be swept in, and
+    # unstaged again on failure so the operator's next commit cannot
+    # sweep the artifacts either.
+    if [ -n "$(git status --porcelain -- "$RES")" ]; then
       committed=no
       for _ in 1 2 3 4 5; do
         if { git add -- "$RES" && git commit -q -m \
-          "TPU measurement series ${TAG}: artifacts from a chip-watch window" \
+          "TPU series ${TAG}: artifacts from a chip-watch window (series rc=$rc)" \
           -- "$RES"; } >> "$LOG" 2>&1; then
-          log "artifacts committed"
+          log "artifacts committed (series rc=$rc)"
           committed=yes
           break
         fi
@@ -74,9 +76,12 @@ while [ "$(date +%s)" -lt "$deadline" ]; do
         sleep 10
       done
       if [ "$committed" = no ]; then
+        git restore --staged -- "$RES" >> "$LOG" 2>&1 || true
         log "artifact commit FAILED after 5 attempts -- results are" \
             "UNCOMMITTED in $RES (see git errors above)"
       fi
+    fi
+    if [ "$rc" -eq 0 ]; then
       exit 0
     fi
     # Preflight passed but the series died (window closed mid-run):

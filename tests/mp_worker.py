@@ -149,6 +149,33 @@ def main():
         out_specs=P(), check_vma=False))(leaf)
     res['param_leafsum'] = float(np.asarray(jax.device_get(leafsum)))
 
+    # ZeRO-1 + mesh-aware global-norm clip across controllers: the
+    # reduce-scatter/all-gather legs and the clip's psum'd squared
+    # norm span REAL process boundaries (gloo), pinned against the
+    # replicated multi-node path with optax's clip on the same data
+    from chainermn_tpu.parallel import zero as zero_mod
+
+    clip_c = 0.05
+    upd_zero = training.StandardUpdater(
+        iter([]),
+        zero_mod.chain(zero_mod.clip_by_global_norm(clip_c),
+                       optax.sgd(0.1, momentum=0.9)),
+        loss_fn, params0, comm, has_aux=True, zero=True)
+    upd_ref = training.StandardUpdater(
+        iter([]),
+        chainermn_tpu.create_multi_node_optimizer(
+            optax.chain(optax.clip_by_global_norm(clip_c),
+                        optax.sgd(0.1, momentum=0.9)), comm),
+        loss_fn, params0, comm, has_aux=True)
+    z_losses, r_losses = [], []
+    for _ in range(3):
+        z_losses.append(float(np.asarray(jax.device_get(
+            upd_zero.update_core((gx, gy))['loss']))))
+        r_losses.append(float(np.asarray(jax.device_get(
+            upd_ref.update_core((gx, gy))['loss']))))
+    res['zero_clip_losses'] = z_losses
+    res['zero_clip_ref_losses'] = r_losses
+
     # PIPELINE training across controllers: the stage axis SPANS
     # processes, so every GPipe boundary ppermute (forward rotation
     # and its backward transpose) crosses the controller boundary --

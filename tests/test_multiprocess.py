@@ -130,3 +130,15 @@ def test_multiprocess_end_to_end(tmp_path, nprocs):
     for res in results:
         assert abs(res['pp_loss'] - res['pp_loss_ref']) < 1e-5, (
             res['pp_loss'], res['pp_loss_ref'])
+
+    # ZeRO-1 + mesh-aware clip across controllers: trajectory equals
+    # the replicated multi-node path with optax's clip, on every rank
+    for res in results:
+        np.testing.assert_allclose(res['zero_clip_losses'],
+                                   res['zero_clip_ref_losses'],
+                                   atol=1e-5)
+        assert res['zero_clip_losses'][-1] < res['zero_clip_losses'][0]
+    for other in results[1:]:
+        np.testing.assert_allclose(results[0]['zero_clip_losses'],
+                                   other['zero_clip_losses'],
+                                   atol=1e-6)
