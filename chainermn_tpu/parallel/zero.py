@@ -43,20 +43,28 @@ _NORM_CTX = threading.local()
 
 
 @contextlib.contextmanager
-def mesh_norm_scope(gnorm_sq):
-    """Provide mesh-aware transforms with the global-sq-norm rule for
+def mesh_norm_scope(gnorm_sq, leaf_sumsq=None):
+    """Provide mesh-aware transforms with the global-norm rules for
     the sharding their ``update`` is being traced under.
 
     ``gnorm_sq(tree) -> scalar`` must return the GLOBAL sum of squares
     of the (sharded) tree -- e.g. ``lambda t: axes_sumsq(t, AXES)``
-    under ZeRO-1.  Trace-time only; nests/restores like any context.
+    under ZeRO-1.  ``leaf_sumsq(leaf) -> scalar``, when the sharding
+    admits one, returns a SINGLE leaf's global sum of squares (under
+    ZeRO every leaf is sharded the same way, so a per-leaf psum rule
+    exists; under 1f1b stage sharding the same-named leaf holds a
+    DIFFERENT layer per device and no such rule is supplied --
+    per-leaf transforms must then refuse, not silently localize).
+    Trace-time only; nests/restores like any context.
     """
-    prev = getattr(_NORM_CTX, 'gnorm_sq', None)
+    prev = (getattr(_NORM_CTX, 'gnorm_sq', None),
+            getattr(_NORM_CTX, 'leaf_sumsq', None))
     _NORM_CTX.gnorm_sq = gnorm_sq
+    _NORM_CTX.leaf_sumsq = leaf_sumsq
     try:
         yield
     finally:
-        _NORM_CTX.gnorm_sq = prev
+        _NORM_CTX.gnorm_sq, _NORM_CTX.leaf_sumsq = prev
 
 
 def tree_sumsq(tree):
@@ -121,6 +129,79 @@ def clip_by_global_norm(max_norm):
     return optax.GradientTransformation(init_fn, update_fn)
 
 
+def scale_by_trust_ratio(min_norm=0.0, trust_coefficient=1.0,
+                         eps=0.0):
+    """Mesh-aware twin of ``optax.scale_by_trust_ratio`` (the
+    LARS/LAMB layer-wise trust ratio): per-LEAF param/update norms are
+    completed over the mesh with the scope's per-leaf rule, so under
+    ZeRO-1 each layer's ratio is computed from its true global norms
+    instead of shard norms.  Same arithmetic as optax's, so the
+    sharded trajectory pins against the replicated one.
+
+    In a sharded context that provides no per-leaf rule (the 1f1b
+    pipeline schedule: one leaf holds a DIFFERENT layer per stage)
+    this refuses at trace time -- a silent fall-back to local norms
+    would diverge from the gpipe stacked-tree trajectory.
+    """
+    import optax
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def leaf_norm(x, min_norm_):
+        gnorm_sq = getattr(_NORM_CTX, 'gnorm_sq', None)
+        leaf_fn = getattr(_NORM_CTX, 'leaf_sumsq', None)
+        if gnorm_sq is not None and leaf_fn is None:
+            raise ValueError(
+                'trust-ratio transform traced in a sharded optimizer '
+                'context without a per-leaf norm rule (the 1f1b '
+                "schedule's stage sharding): per-layer ratios cannot "
+                'be reconstructed there -- use the gpipe schedule, or '
+                'an elementwise / global-norm-clip optimizer')
+        sq = (leaf_fn(x) if leaf_fn is not None
+              else jnp.sum(jnp.square(x.astype(jnp.float32))))
+        return jnp.maximum(jnp.sqrt(sq), min_norm_)
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError('scale_by_trust_ratio needs params')
+
+        def scale(u, p):
+            # same formula as optax.scale_by_trust_ratio
+            p_norm = leaf_norm(p, min_norm)
+            u_norm = leaf_norm(u, min_norm)
+            ratio = trust_coefficient * p_norm / (u_norm + eps)
+            zero_norm = jnp.logical_or(p_norm == 0.0, u_norm == 0.0)
+            safe = jnp.where(zero_norm,
+                             jnp.array(1.0, dtype=p.dtype), ratio)
+            return u * safe.astype(u.dtype)
+
+        return jax.tree_util.tree_map(scale, updates, params), state
+
+    update_fn._cmn_mesh_aware = True
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def lars(learning_rate, weight_decay=0.0, trust_coefficient=0.001,
+         eps=0.0, momentum=0.9, nesterov=False):
+    """Mesh-aware LARS (You et al. 2017), usable under ``zero=True``:
+    ``optax.lars``'s transform chain with the trust ratio replaced by
+    :func:`scale_by_trust_ratio` (all other components are
+    elementwise).  Matches ``optax.lars`` with default masks on the
+    replicated path, and the ZeRO trajectory pins against it
+    (``tests/test_zero.py``)."""
+    import optax
+
+    return chain(
+        optax.add_decayed_weights(weight_decay),
+        scale_by_trust_ratio(trust_coefficient=trust_coefficient,
+                             eps=eps),
+        optax.scale_by_learning_rate(learning_rate),
+        optax.trace(decay=momentum, nesterov=nesterov),
+    )
+
+
 def chain(*transforms):
     """``optax.chain`` accepted under ``zero=True`` and 1F1B: every
     component must be mesh-aware (:func:`clip_by_global_norm`) or pass
@@ -175,13 +256,14 @@ def check_elementwise(optimizer, atol=1e-7):
             'transform is not: %s.  Under ZeRO-1 every leaf becomes a '
             'flat 1-D per-device shard, so such transforms compute '
             'over shards instead of true leaves and the trajectory '
-            'silently diverges from zero=False.  For global-norm '
-            'clipping use the mesh-aware '
-            'zero.chain(zero.clip_by_global_norm(c), <elementwise '
-            'optimizer>) instead of the optax transform; otherwise '
-            'use zero=False for this optimizer, or pass '
-            'zero_check=False if the probe is a false positive for '
-            'your transform.' % reason)
+            'silently diverges from zero=False.  Mesh-aware '
+            'replacements exist for the common cases: '
+            'zero.chain(zero.clip_by_global_norm(c), ...) for '
+            'global-norm clipping, zero.lars(...) / '
+            'zero.scale_by_trust_ratio() for layer-wise trust '
+            'ratios.  Otherwise use zero=False for this optimizer, '
+            'or pass zero_check=False if the probe is a false '
+            'positive for your transform.' % reason)
 
     # probe 1: locality
     probe = {'a': jnp.linspace(0.5, 1.0, 5, dtype=jnp.float32),

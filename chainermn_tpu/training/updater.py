@@ -255,13 +255,15 @@ class StandardUpdater:
                 p_sh = jax.tree_util.tree_map(
                     lambda p: z.param_shard_leaf(p, n, rank), params)
                 opt_local = z.squeeze_state(opt_state)
-                # mesh-aware transforms (zero.clip_by_global_norm)
-                # complete their statistics over the mesh: every
-                # element of the gradient tree lives on exactly one
-                # device along `axes`, so global sq-norm = psum of
+                # mesh-aware transforms (zero.clip_by_global_norm,
+                # zero.scale_by_trust_ratio) complete their statistics
+                # over the mesh: every element of every leaf lives on
+                # exactly one device along `axes`, so both the whole-
+                # tree and the per-leaf global sq-norms are psums of
                 # per-shard sums
                 with z.mesh_norm_scope(
-                        lambda t: z.axes_sumsq(t, axes)):
+                        lambda t: z.axes_sumsq(t, axes),
+                        leaf_sumsq=lambda x: z.axes_sumsq(x, axes)):
                     updates, new_opt = optimizer.update(
                         g_sh, opt_local, p_sh)
                 upd_full = jax.tree_util.tree_map(

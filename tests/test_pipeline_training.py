@@ -141,6 +141,26 @@ def test_1f1b_rejects_remat_and_nonelementwise():
         schedule='1f1b', schedule_check=False, donate=False)
 
 
+def test_1f1b_rejects_mesh_aware_trust_ratio():
+    """zero.lars passes the construction-time probe (its components
+    are marked mesh-aware/safe) but 1f1b's stage sharding cannot
+    provide the per-leaf norm rule trust ratios need -- the transform
+    must refuse at trace time rather than silently computing local
+    per-stage ratios that diverge from gpipe."""
+    from chainermn_tpu.parallel import zero as zero_mod
+
+    mesh = pipeline_mesh(N_STAGES)
+    x, y = _data()
+    upd = PipelineUpdater(
+        iter([]), zero_mod.lars(0.1), stage_fn, loss_on_last,
+        stack_stage_params(make_params()), mesh, n_micro=4,
+        donate=False, schedule='1f1b')
+    with pytest.raises(ValueError, match='per-leaf norm rule'):
+        upd.update_core(upd.shard_batch(
+            [(np.asarray(x[i]), np.asarray(y[i]))
+             for i in range(len(x))]))
+
+
 def test_pipeline_explicit_opt_state_specs():
     """ADVICE r3: exotic optimizers can bypass the opt-state placement
     heuristic with a leaf-exact spec tree (mirroring param_specs).

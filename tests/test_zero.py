@@ -201,6 +201,28 @@ def test_zero_clip_unsharded_matches_optax():
                                        rtol=1e-6)
 
 
+@pytest.mark.slow
+def test_zero_lars_matches_replicated():
+    """Mesh-aware LARS under zero=True: layer-wise trust ratios are
+    computed from per-leaf norms completed over the mesh (psum of
+    shard sums), pinning the trajectory against zero=False +
+    optax.lars with identical hyperparameters."""
+    kwargs = dict(learning_rate=0.5, weight_decay=1e-4,
+                  trust_coefficient=0.1, momentum=0.9)
+    upd_ref = _setup((2, 4), zero=False, opt=optax.lars(**kwargs))
+    upd_zero = _setup((2, 4), zero=True, opt=zero_mod.lars(**kwargs))
+    start = _flat_params(upd_zero)
+    for i in range(4):
+        m_ref = upd_ref.update()
+        m_zero = upd_zero.update()
+        assert abs(m_ref['loss'] - m_zero['loss']) < 1e-5, \
+            (i, m_ref, m_zero)
+    np.testing.assert_allclose(_flat_params(upd_zero),
+                               _flat_params(upd_ref), atol=1e-5)
+    # teeth: the optimizer actually moved the parameters
+    assert np.max(np.abs(_flat_params(upd_zero) - start)) > 1e-3
+
+
 def test_zero_chain_rejects_plain_clip():
     """zero.chain validates components: the NON-mesh-aware optax clip
     must still be rejected (it would compute shard-local norms)."""
