@@ -202,6 +202,23 @@ def lars(learning_rate, weight_decay=0.0, trust_coefficient=0.001,
     )
 
 
+def lamb(learning_rate, b1=0.9, b2=0.999, eps=1e-6, eps_root=0.0,
+         weight_decay=0.0):
+    """Mesh-aware LAMB (You et al. 2020), usable under ``zero=True``:
+    ``optax.lamb``'s chain with the trust ratio replaced by
+    :func:`scale_by_trust_ratio` (adam scaling and weight decay are
+    elementwise).  Pins against ``optax.lamb`` on the replicated
+    path (``tests/test_zero.py``)."""
+    import optax
+
+    return chain(
+        optax.scale_by_adam(b1=b1, b2=b2, eps=eps, eps_root=eps_root),
+        optax.add_decayed_weights(weight_decay=weight_decay),
+        scale_by_trust_ratio(),
+        optax.scale_by_learning_rate(learning_rate),
+    )
+
+
 def chain(*transforms):
     """``optax.chain`` accepted under ``zero=True`` and 1F1B: every
     component must be mesh-aware (:func:`clip_by_global_norm`) or pass

@@ -242,10 +242,13 @@ class PipelineUpdater:
                         'trajectory.  For global-norm clipping use '
                         'zero.chain(zero.clip_by_global_norm(c), ...) '
                         '-- its norm is completed across stages.  '
-                        'Layer-wise trust ratios (LARS/LAMB, incl. '
-                        'zero.lars) are NOT available under 1f1b: '
-                        'stage sharding admits no per-leaf norm rule; '
-                        'use the gpipe schedule for those.  '
+                        'Trust ratios (LARS/LAMB, incl. zero.lars) '
+                        'are NOT available under 1f1b: stage sharding '
+                        'admits no per-leaf norm rule.  The gpipe '
+                        'schedule runs them, with pipeline-native '
+                        'semantics: one ratio per STACKED leaf (all '
+                        'stages sharing a layer name together), not '
+                        'per layer of the unstacked model.  '
                         'Probe result: %s  Pass schedule_check=False '
                         'to bypass.' % e) from e
         self.iterator = iterator

@@ -202,6 +202,23 @@ def test_zero_clip_unsharded_matches_optax():
 
 
 @pytest.mark.slow
+def test_zero_lamb_matches_replicated():
+    """Mesh-aware LAMB under zero=True pins against optax.lamb."""
+    kwargs = dict(learning_rate=1e-2, weight_decay=1e-4)
+    upd_ref = _setup((2, 4), zero=False, opt=optax.lamb(**kwargs))
+    upd_zero = _setup((2, 4), zero=True, opt=zero_mod.lamb(**kwargs))
+    start = _flat_params(upd_zero)
+    for i in range(4):
+        m_ref = upd_ref.update()
+        m_zero = upd_zero.update()
+        assert abs(m_ref['loss'] - m_zero['loss']) < 1e-5, \
+            (i, m_ref, m_zero)
+    np.testing.assert_allclose(_flat_params(upd_zero),
+                               _flat_params(upd_ref), atol=1e-5)
+    assert np.max(np.abs(_flat_params(upd_zero) - start)) > 1e-3
+
+
+@pytest.mark.slow
 def test_zero_lars_matches_replicated():
     """Mesh-aware LARS under zero=True: layer-wise trust ratios are
     computed from per-leaf norms completed over the mesh (psum of
