@@ -276,7 +276,7 @@ def check_elementwise(optimizer, atol=1e-7):
             'silently diverges from zero=False.  Mesh-aware '
             'replacements exist for the common cases: '
             'zero.chain(zero.clip_by_global_norm(c), ...) for '
-            'global-norm clipping, zero.lars(...) / '
+            'global-norm clipping, zero.lars(...) / zero.lamb(...) / '
             'zero.scale_by_trust_ratio() for layer-wise trust '
             'ratios.  Otherwise use zero=False for this optimizer, '
             'or pass zero_check=False if the probe is a false '

@@ -242,7 +242,8 @@ class PipelineUpdater:
                         'trajectory.  For global-norm clipping use '
                         'zero.chain(zero.clip_by_global_norm(c), ...) '
                         '-- its norm is completed across stages.  '
-                        'Trust ratios (LARS/LAMB, incl. zero.lars) '
+                        'Trust ratios (LARS/LAMB, incl. zero.lars and '
+                        'zero.lamb) '
                         'are NOT available under 1f1b: stage sharding '
                         'admits no per-leaf norm rule.  The gpipe '
                         'schedule runs them, with pipeline-native '

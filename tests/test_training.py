@@ -455,3 +455,38 @@ def test_device_prefetch_finalize_propagates():
     assert inner._stop.is_set()
     inner._thread.join(timeout=5)
     assert not inner._thread.is_alive()
+
+
+def test_device_prefetch_reset_reuse():
+    """reset() restarts a repeat=False prefetched pass (the Evaluator
+    usage pattern) with consumer counters rebased."""
+    from chainermn_tpu.training import DevicePrefetchIterator
+
+    it = DevicePrefetchIterator(
+        training.SerialIterator(_toy_dataset(8), 4, repeat=False,
+                                shuffle=False),
+        lambda b: b, depth=1)
+    first = [len(b) for b in it]
+    it.reset()
+    assert it.epoch == 0 and it.epoch_detail == 0.0
+    second = [len(b) for b in it]
+    assert first == second == [4, 4]
+
+
+def test_device_prefetch_composes_with_zero():
+    """device_prefetch and zero=True cross paths in update():
+    prefetched (already-placed) arrays must feed the ZeRO step with
+    its needs_bcast plumbing intact."""
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    ds = _toy_dataset(64)
+    model = MLP(n_units=9, n_out=3)  # odd size: shard padding
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 8), jnp.float32))
+    clf = Classifier(model.apply)
+    it = training.SerialIterator(ds, 32, shuffle=False)
+    upd = training.StandardUpdater(
+        it, optax.adam(1e-2), clf, params, comm, has_aux=True,
+        zero=True, device_prefetch=2)
+    losses = [upd.update()['loss'] for _ in range(4)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
