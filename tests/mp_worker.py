@@ -224,6 +224,34 @@ def main():
     pm = pupd.update_core((gx2, gy2))
     res['pp_loss'] = float(np.asarray(jax.device_get(pm['loss'])))
 
+    # 1F1B across controllers: the hand-propagated cotangent ring
+    # (forward ppermute AND the explicit reverse ppermute of the
+    # backward pass) crosses the process boundary over gloo
+    pupd_1f1b = PipelineUpdater(
+        iter([]), optax.sgd(0.1), pstage, ploss,
+        stack_stage_params(plist), pmesh, n_micro=2, donate=False,
+        schedule='1f1b')
+    pm2 = pupd_1f1b.update_core((gx2, gy2))
+    res['pp_1f1b_loss'] = float(np.asarray(jax.device_get(
+        pm2['loss'])))
+
+    # gradient pin, not just forward: after one identical sgd step
+    # both schedules' params must agree ELEMENTWISE (L1 over every
+    # leaf; a scalar param-sum could mask compensating per-stage
+    # cotangent errors) -- the 1f1b backward ring delivered the same
+    # cotangents autodiff produced for gpipe
+    sched_l1 = 0.0
+    for la, lb in zip(
+            jax.tree_util.tree_leaves(pupd.params),
+            jax.tree_util.tree_leaves(pupd_1f1b.params)):
+        sched_l1 += float(np.asarray(jax.device_get(jax.jit(
+            jax.shard_map(
+                lambda a, b: jax.lax.psum(
+                    jnp.sum(jnp.abs(a - b)), ('data', 'stage')),
+                mesh=pmesh, in_specs=(P('stage'), P('stage')),
+                out_specs=P(), check_vma=False))(la, lb))))
+    res['pp_sched_param_l1'] = sched_l1
+
     def pseq(x, y):
         h = x
         for p in plist:

@@ -130,6 +130,13 @@ def test_multiprocess_end_to_end(tmp_path, nprocs):
     for res in results:
         assert abs(res['pp_loss'] - res['pp_loss_ref']) < 1e-5, (
             res['pp_loss'], res['pp_loss_ref'])
+        # 1f1b's hand-propagated cotangent ring over the process
+        # boundary: same sequential-oracle loss as gpipe, and the
+        # post-step params agree (the backward delivered autodiff's
+        # cotangents)
+        assert abs(res['pp_1f1b_loss'] - res['pp_loss_ref']) < 1e-5, (
+            res['pp_1f1b_loss'], res['pp_loss_ref'])
+        assert res['pp_sched_param_l1'] < 1e-4, res['pp_sched_param_l1']
 
     # ZeRO-1 + mesh-aware clip across controllers: trajectory equals
     # the replicated multi-node path with optax's clip, on every rank
