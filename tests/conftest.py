@@ -60,3 +60,13 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if 'slow' in item.keywords:
             item.add_marker(skip)
+
+
+def flat_params(updater):
+    """Concatenate an updater's device params into one host vector
+    (shared by the ZeRO trajectory suites)."""
+    import numpy as np
+
+    return np.concatenate([
+        np.asarray(leaf).ravel() for leaf in
+        jax.tree_util.tree_leaves(jax.device_get(updater.params))])

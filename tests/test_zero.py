@@ -13,6 +13,7 @@ import optax
 import pytest
 
 import chainermn_tpu
+from conftest import flat_params as _flat_params
 from chainermn_tpu import training
 from chainermn_tpu.models import MLP, classifier_loss
 from chainermn_tpu.parallel import zero as zero_mod
@@ -144,12 +145,6 @@ def test_zero_rejects_non_elementwise(bad_opt):
     }[bad_opt]
     with pytest.raises(ValueError, match='elementwise'):
         _setup((2, 4), zero=True, opt=make())
-
-
-def _flat_params(upd):
-    return np.concatenate([
-        np.asarray(x).ravel() for x in
-        jax.tree_util.tree_leaves(jax.device_get(upd.params))])
 
 
 @pytest.mark.slow
