@@ -365,3 +365,32 @@ def test_zero_lowering_signature_and_reduce_dtype():
     assert 'bf16' not in full
     assert scatter_operand_dtypes(full) == {'f32'}
     assert 'bf16' in scatter_operand_dtypes(narrow)
+
+
+def test_zero_composes_with_accum_steps():
+    """zero=True and accum_steps cross paths in the updater: the
+    micro-batch-averaged gradients feed the reduce-scatter, and the
+    trajectory must still equal the replicated accumulating run."""
+    def build(zero):
+        comm = chainermn_tpu.create_communicator('xla',
+                                                 mesh_shape=(2, 4))
+        rng = np.random.RandomState(0)
+        x = rng.rand(32, 6).astype(np.float32)
+        y = (x.sum(axis=1) > 3.0).astype(np.int32)
+        model = MLP(n_units=17, n_out=2)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 6)))['params']
+        loss_fn = classifier_loss(
+            lambda p, xb: model.apply({'params': p}, xb))
+        opt = (optax.adam(1e-2) if zero
+               else chainermn_tpu.create_multi_node_optimizer(
+                   optax.adam(1e-2), comm))
+        upd = training.StandardUpdater(
+            iter([]), opt, loss_fn, params, comm, has_aux=True,
+            zero=zero, accum_steps=2)
+        arrays = upd.shard_batch([(x[i], y[i]) for i in range(32)])
+        for _ in range(3):
+            upd.update_core(arrays)
+        return _flat_params(upd)
+
+    np.testing.assert_allclose(build(True), build(False), atol=1e-5)
