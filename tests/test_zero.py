@@ -370,27 +370,36 @@ def test_zero_lowering_signature_and_reduce_dtype():
 def test_zero_composes_with_accum_steps():
     """zero=True and accum_steps cross paths in the updater: the
     micro-batch-averaged gradients feed the reduce-scatter, and the
-    trajectory must still equal the replicated accumulating run."""
-    def build(zero):
-        comm = chainermn_tpu.create_communicator('xla',
-                                                 mesh_shape=(2, 4))
-        rng = np.random.RandomState(0)
-        x = rng.rand(32, 6).astype(np.float32)
-        y = (x.sum(axis=1) > 3.0).astype(np.int32)
-        model = MLP(n_units=17, n_out=2)
-        params = model.init(jax.random.PRNGKey(0),
-                            jnp.zeros((1, 6)))['params']
-        loss_fn = classifier_loss(
-            lambda p, xb: model.apply({'params': p}, xb))
+    trajectory must still equal the replicated accumulating run.
+    Trajectory closeness alone cannot catch a silently no-op'd
+    accumulation (mean-of-micro-means == full-batch mean), so the
+    compiled zero step is also pinned to contain the micro-batch scan
+    loop that accum_steps=1 lacks."""
+    def build(zero, accum):
+        comm, params, loss_fn, x, y = _mlp_reduce_dtype_setup()
         opt = (optax.adam(1e-2) if zero
                else chainermn_tpu.create_multi_node_optimizer(
                    optax.adam(1e-2), comm))
         upd = training.StandardUpdater(
             iter([]), opt, loss_fn, params, comm, has_aux=True,
-            zero=zero, accum_steps=2)
+            zero=zero, accum_steps=accum, donate=False)
         arrays = upd.shard_batch([(x[i], y[i]) for i in range(32)])
+        return upd, arrays
+
+    def run(zero):
+        upd, arrays = build(zero, accum=2)
         for _ in range(3):
             upd.update_core(arrays)
         return _flat_params(upd)
 
-    np.testing.assert_allclose(build(True), build(False), atol=1e-5)
+    np.testing.assert_allclose(run(True), run(False), atol=1e-5)
+
+    def n_while(accum):
+        upd, arrays = build(True, accum)
+        txt = upd._step.lower(
+            upd.params, upd.model_state, upd.opt_state, upd._rng,
+            jnp.asarray(False), *arrays).as_text()
+        return txt.count('stablehlo.while')
+
+    assert n_while(2) > n_while(1), \
+        'accum_steps=2 zero step lowered without the micro-batch scan'
