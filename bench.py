@@ -74,6 +74,15 @@ BASELINE_IMG_PER_SEC_PER_CHIP = 63.0
 # deviation of a consecutive-segment slope from the fitted marginal
 # slope); shared with benchmarks/flash_attention_bench.py
 LINEARITY_GATE = 0.25
+# adaptive scan-length escalation (round 4): the tunneled backend's
+# per-device_get RTT jitter (tens of ms) swamps the marginal compute
+# of short scans -- the round-4 series' first mlp line measured a
+# NEGATIVE slope at ks=(2,4,6) because 4 extra 14us steps are
+# invisible under +-40ms of RTT noise.  Escalate the scan span until
+# the fitted signal (slope * span) exceeds SIGNAL_MULT x the measured
+# median-of-reps noise, so the per-step estimate has a ~few-percent
+# error bound instead of being jitter in disguise.
+SIGNAL_MULT = 25.0
 # dense bf16 TFLOP/s per chip, by device_kind substring (table peak;
 # the harness also self-calibrates, see measured_matmul_tflops)
 BF16_PEAK_TFLOPS = {
@@ -147,7 +156,9 @@ def run_child(argv, model):
     """Watchdog wrapper: run the measurement in a child process,
     relaying stderr; on timeout/crash emit diagnostic JSON."""
     quick = '--quick' in argv
-    timeout = 900 if quick else 2400
+    # adaptive scan escalation can add a few compile rounds + up to
+    # ~30s/rep of deliberately-long scans; budget for it
+    timeout = 1800 if quick else 3000
     cmd = [sys.executable, os.path.abspath(__file__), '--child'] + argv
     _log('starting measurement child (timeout %ds)' % timeout)
     try:
@@ -257,14 +268,68 @@ def marginal_time(make_fn, ks, reps):
     lin_err = max(abs(s - slope) for s in segs) / max(abs(slope), 1e-9)
     if slope <= 0:
         # t(K) did not increase with scan length: the sync is lying
-        # outright.  A consistent negative slope would otherwise show
-        # lin_err ~ 0 and the 1e-9 clamp below would publish an absurd
-        # throughput un-gated; poison the diagnostic instead (finite
-        # sentinel so JSON rows stay strict-parseable).
+        # outright, OR the marginal compute is below the noise floor
+        # (adaptive_marginal_time escalates that case).  A consistent
+        # negative slope would otherwise show lin_err ~ 0 and the 1e-9
+        # clamp below would publish an absurd throughput un-gated;
+        # poison the diagnostic instead (finite sentinel so JSON rows
+        # stay strict-parseable).
         lin_err = 99.0
     per_item = max(slope, 1e-9)
     overhead = max(intercept, 0.0)
     return per_item, overhead, times, lin_err
+
+
+def _noise_estimate(times, reps):
+    """Per-median timing noise (seconds): median across scan lengths of
+    the rep stddev, scaled to the error of a median of ``reps`` samples
+    (~1.25/sqrt(n) for a normal), floored so a zero-variance fluke
+    cannot declare infinite precision."""
+    import statistics
+    sds = [statistics.pstdev(v) for v in times.values() if len(v) > 1]
+    sigma = statistics.median(sds) if sds else 0.0
+    return max(sigma * 1.25 / math.sqrt(max(reps, 1)), 1e-4)
+
+
+def adaptive_marginal_time(make_fn, base_ks, reps, per_item_floor=None,
+                           max_rep_s=30.0, max_k=200000, max_tries=4):
+    """``marginal_time`` with scan-span escalation: retry with longer
+    scans until slope * span >= SIGNAL_MULT * noise.
+
+    ``per_item_floor`` is a LOWER bound on the true per-step time
+    (e.g. analytic flops / an optimistic peak); it plans the rescaled
+    span when the observed slope is unusable (<= 0) and caps the span
+    so one rep stays under ``max_rep_s``.  Returns
+    (per_item, overhead, times, lin_err, ks_used, escalations).
+    """
+    ks = tuple(sorted(base_ks))
+    attempt = 0
+    while True:
+        per, ov, times, lin = marginal_time(make_fn, ks, reps)
+        sigma = _noise_estimate(times, reps)
+        slope_raw = per if per > 1e-9 else 0.0
+        signal = slope_raw * (ks[-1] - ks[0])
+        if signal >= SIGNAL_MULT * sigma or attempt + 1 >= max_tries:
+            return per, ov, times, lin, ks, attempt
+        per_est = max(slope_raw, per_item_floor or 0.0)
+        if per_est > 0:
+            span = SIGNAL_MULT * sigma / per_est
+            s = max(int(math.ceil(span / 2.0)), ks[0] * 2)
+            # keep the longest rep inside the wall budget (3s ~= the
+            # longest length; ov is the fixed RTT component)
+            s_cap = max(int((max_rep_s - ov) / (3.0 * per_est)), 1)
+            s = min(s, s_cap, max_k // 3)
+        else:
+            s = min(ks[0] * 8, max_k // 3)  # blind geometric growth
+        new_ks = (s, 2 * s, 3 * s)
+        if new_ks == ks or s <= ks[0]:
+            return per, ov, times, lin, ks, attempt
+        _log('adaptive: signal %.2fms < %.0fx noise %.2fms at ks=%s; '
+             'rescaling to ks=%s'
+             % (signal * 1e3, SIGNAL_MULT, sigma * 1e3, list(ks),
+                list(new_ks)))
+        ks = new_ks
+        attempt += 1
 
 
 def calibrate_matmul_roofline(quick):
@@ -291,10 +356,14 @@ def calibrate_matmul_roofline(quick):
         return run
 
     ks = (4, 8, 12) if quick else (8, 16, 24)
-    per, ov, _, lin = marginal_time(make, ks, reps=3)
+    # floor: no chip sustains 1 PFLOP/s dense bf16 on one core; the
+    # floor only PLANS the escalated span (overshoot = longer scans)
+    per, ov, _, lin, ks_used, esc = adaptive_marginal_time(
+        make, ks, reps=3, per_item_floor=flop / 1e15, max_rep_s=20.0)
     tflops = flop / per / 1e12
     _log('matmul roofline: %d^3 bf16 %.2fms/matmul -> %.1f TFLOP/s '
-         '(linearity %.3f)' % (n, per * 1e3, tflops, lin))
+         '(linearity %.3f, ks=%s, %d escalations)'
+         % (n, per * 1e3, tflops, lin, list(ks_used), esc))
     return tflops, lin
 
 
@@ -640,9 +709,20 @@ def measure(argv):
         ks, reps = (4, 8, 12), 4
     _log('timing: scan lengths %s x%d reps (first compile of a big '
          'model is minutes uncached)' % (list(ks), reps))
-    per_step, overhead, times, lin_err = marginal_time(make, ks, reps)
-    _log('per-step %.2fms, overhead %.1fms' % (per_step * 1e3,
-                                               overhead * 1e3))
+    # per-step floor from analytic flops at an optimistic 2x table
+    # peak: plans the adaptive span escalation when RTT jitter hides
+    # the marginal compute of short scans (see SIGNAL_MULT)
+    kind = jax.devices()[0].device_kind
+    peak_guess = next((v for k, v in BF16_PEAK_TFLOPS.items()
+                       if k in kind.lower()), 500.0)
+    # analytic_flops is the ALL-device total per step; the bound must
+    # be per-step wall time, so divide by the mesh's aggregate peak
+    floor = float(cfg['analytic_flops']) / (
+        n_dev * 2.0 * peak_guess * 1e12)
+    per_step, overhead, times, lin_err, ks, escalations = (
+        adaptive_marginal_time(make, ks, reps, per_item_floor=floor))
+    _log('per-step %.2fms, overhead %.1fms (ks=%s, %d escalations)'
+         % (per_step * 1e3, overhead * 1e3, list(ks), escalations))
 
     items_per_sec = cfg['items'] / per_step
     per_chip = items_per_sec / n_dev
@@ -659,6 +739,8 @@ def measure(argv):
         step_time_ms=round(per_step * 1e3, 3),
         overhead_ms=round(overhead * 1e3, 1),
         scan_lengths=list(ks),
+        adaptive_escalations=escalations,
+        timing_noise_ms=round(_noise_estimate(times, reps) * 1e3, 2),
         linearity_rel_err=round(lin_err, 4),
         rep_times_s={str(k): [round(t, 4) for t in v]
                      for k, v in times.items()},
@@ -712,6 +794,13 @@ def measure(argv):
                 'achieved %.1f TF/s exceeds self-calibrated matmul '
                 'roofline %.1f TF/s' % (achieved / n_dev,
                                         matmul_tflops))
+    noise = _noise_estimate(times, reps)
+    if per_step * (ks[-1] - ks[0]) < SIGNAL_MULT * noise:
+        suspect_reasons.append(
+            'marginal signal %.1fms below %.0fx noise floor %.1fms '
+            'even after adaptive escalation'
+            % (per_step * (ks[-1] - ks[0]) * 1e3, SIGNAL_MULT,
+               noise * 1e3))
     if spread > 0.5:
         suspect_reasons.append(
             'step-time spread %.0f%% across reps' % (spread * 100))

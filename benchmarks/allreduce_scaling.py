@@ -57,7 +57,8 @@ def main():
     from jax.sharding import PartitionSpec as P
 
     import chainermn_tpu
-    from bench import LINEARITY_GATE, marginal_time
+    from bench import LINEARITY_GATE, SIGNAL_MULT, _noise_estimate, \
+        adaptive_marginal_time
 
     n_all = jax.device_count()
     if args.devices:
@@ -109,19 +110,36 @@ def main():
                 # the tunnel per measurement
                 return lambda: fn(grads)['tail'][:1]
 
-            per, _ov, _times, lin = marginal_time(
-                make, (2, 4, 6), reps=3)
+            # planning floor: one allreduce moves >= payload bytes
+            # through HBM; no chip beats 2 TB/s, so this bounds the
+            # adaptive span when RTT jitter hides short scans (a
+            # 1-device "allreduce" can be legitimately ~free -- the
+            # signal gate below marks that row unmeasurable instead
+            # of publishing jitter)
+            floor = args.params * 4 / 2e12
+            per, _ov, times, lin, ks_used, esc = adaptive_marginal_time(
+                make, (2, 4, 6), reps=3, per_item_floor=floor,
+                max_rep_s=20.0, max_tries=3)
+            noise = _noise_estimate(times, 3)
             row = {
                 'metric': 'allreduce_time_ms',
                 'strategy': name,
                 'devices': n,
                 'value': round(per * 1e3, 3),
                 'payload_mb': round(args.params * 4 / 1e6, 1),
+                'scan_lengths': list(ks_used),
+                'adaptive_escalations': esc,
+                'timing_noise_ms': round(noise * 1e3, 2),
                 'linearity_rel_err': round(lin, 4),
                 'sync_method': 'device_get',
             }
             if lin > LINEARITY_GATE:
                 row['suspect'] = True
+            if per * (ks_used[-1] - ks_used[0]) < SIGNAL_MULT * noise:
+                row['suspect'] = True
+                row['unmeasurable'] = (
+                    'marginal signal below noise floor (the op may '
+                    'be legitimately near-free at this mesh size)')
             # efficiency only against a TRUSTED smallest-mesh row: a
             # suspect baseline would silently poison every later
             # row's ratio (suspect data is never published raw)

@@ -36,7 +36,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from bench import (  # noqa: E402 (needs the sys.path insert above)
-    LINEARITY_GATE, marginal_time)
+    BF16_PEAK_TFLOPS, LINEARITY_GATE, SIGNAL_MULT, _noise_estimate,
+    adaptive_marginal_time)
 
 
 def attn_flops(b, t, h, d, causal, bwd):
@@ -106,10 +107,24 @@ def bench_config(b, t, h, d, causal, dtype, use_pallas, bwd,
     # returns a compiled thunk; marginal slope fit over three chain
     # lengths, median-of-reps, devget-synced)
     # no length-1 even in quick mode: XLA special-cases a scan of 1
-    # and its time sits off the k>=2 line (see bench.py's cpu path)
+    # and its time sits off the k>=2 line (see bench.py's cpu path).
+    # Adaptive escalation (bench.py SIGNAL_MULT): a ~0.1ms attention
+    # step is invisible under the tunnel's tens-of-ms RTT jitter at
+    # short scans; the floor (a LOWER bound on per-step time: analytic
+    # flops at 2x this chip's table peak) plans the span so the
+    # escalated scan is long enough on the first retry
     ks = (2, 3, 4) if quick else (2, 4, 6)
-    per, _overhead, _times, lin = marginal_time(make, ks, reps=3)
-    return per, lin
+    kind = jax.devices()[0].device_kind
+    peak = next((v for kk_n, v in BF16_PEAK_TFLOPS.items()
+                 if kk_n in kind.lower()), 500.0)
+    floor = attn_flops(b, t, h, d, causal, bwd) / (2 * peak * 1e12)
+    per, _overhead, times, lin, ks_used, _esc = adaptive_marginal_time(
+        make, ks, reps=3, per_item_floor=floor, max_rep_s=15.0)
+    # below-signal result: positive-but-jitter slope must not be
+    # published as a real kernel time (same gate as bench.measure)
+    weak = (per * (ks_used[-1] - ks_used[0])
+            < SIGNAL_MULT * _noise_estimate(times, 3))
+    return per, lin, weak
 
 
 def main():
@@ -191,7 +206,7 @@ def _run_all(configs, seqs_note, dtype, cpu, sweep, quick, platform,
                 try:
                     for name, use_pallas in (('pallas', True),
                                              ('xla', False)):
-                        per, lin = bench_config(
+                        per, lin, weak = bench_config(
                             b, t, h, d, causal, dtype, use_pallas,
                             bwd, quick=quick)
                         row[name + '_ms'] = per * 1e3
@@ -205,6 +220,12 @@ def _run_all(configs, seqs_note, dtype, cpu, sweep, quick, platform,
                                 row.get('suspect_reason', '') +
                                 '%s arm timing nonlinear (%.0f%%); '
                                 % (name, lin * 100))
+                        if weak:
+                            row['suspect'] = True
+                            row['suspect_reason'] = (
+                                row.get('suspect_reason', '') +
+                                '%s arm signal below noise floor; '
+                                % name)
                     row['speedup'] = row['xla_ms'] / row['pallas_ms']
                 except Exception as e:  # keep earlier rows (OOM etc.)
                     row['error'] = str(e)[-300:]
@@ -215,7 +236,7 @@ def _run_all(configs, seqs_note, dtype, cpu, sweep, quick, platform,
         for bq in (128, 256, 512):
             for bk in (128, 256, 512):
                 try:
-                    per, lin = bench_config(
+                    per, lin, weak = bench_config(
                         b, t, h, d, True, dtype, True, True,
                         block_q=bq, block_k=bk, quick=quick)
                     row = {'sweep': True, 'block_q': bq, 'block_k': bk,
@@ -228,6 +249,11 @@ def _run_all(configs, seqs_note, dtype, cpu, sweep, quick, platform,
                         row['suspect'] = True
                         row['suspect_reason'] = (
                             'timing nonlinear (%.0f%%)' % (lin * 100))
+                    if weak:
+                        row['suspect'] = True
+                        row['suspect_reason'] = (
+                            row.get('suspect_reason', '') +
+                            '; signal below noise floor').lstrip('; ')
                 except Exception as e:  # Mosaic lowering limits
                     row = {'sweep': True, 'block_q': bq, 'block_k': bk,
                            'error': str(e)[-300:], 'platform': platform}

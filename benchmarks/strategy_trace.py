@@ -37,8 +37,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 from bench import (  # noqa: E402
-    LINEARITY_GATE, _classifier_setup, _scan_maker, devget_sync,
-    marginal_time)
+    LINEARITY_GATE, SIGNAL_MULT, _classifier_setup, _noise_estimate,
+    _scan_maker, adaptive_marginal_time, devget_sync)
 
 STRATEGIES = ('xla', 'bucketed', 'hierarchical')
 
@@ -98,14 +98,25 @@ def main():
         upd, arrays = build_step(strategy, cpu)
         make = _scan_maker(upd, arrays)
         ks, reps = ((2, 3, 4), 2) if cpu else ((2, 4, 6), 3)
-        per, ov, _, lin = marginal_time(make, ks, reps)
+        # adaptive escalation vs tunnel RTT jitter (bench.py); the
+        # strategies are COMPARED against each other, so all three
+        # must clear the same signal gate or the comparison is noise
+        per, ov, times, lin, ks_used, esc = adaptive_marginal_time(
+            make, ks, reps, max_rep_s=20.0, max_tries=5)
+        noise = _noise_estimate(times, reps)
         row = {'strategy': strategy, 'platform': platform,
                'step_time_ms': round(per * 1e3, 3),
                'overhead_ms': round(ov * 1e3, 1),
+               'scan_lengths': list(ks_used),
+               'adaptive_escalations': esc,
+               'timing_noise_ms': round(noise * 1e3, 2),
                'linearity_rel_err': round(lin, 4),
                'n_devices': jax.device_count()}
         if lin > LINEARITY_GATE:
             row['suspect'] = True
+        if per * (ks_used[-1] - ks_used[0]) < SIGNAL_MULT * noise:
+            row['suspect'] = True
+            row['suspect_reason'] = 'marginal signal below noise floor'
         # trace INDIVIDUAL jitted steps (warmed up first), not one
         # compiled scan: the per-step program is what shows the
         # backward/allreduce interleaving on the op timeline

@@ -1,0 +1,51 @@
+#!/bin/bash
+# Babysit an already-running run_tpu_round.sh series (started outside
+# chip_watch.sh because the tunnel happened to be up at round start).
+#
+# Waits for the series pid, commits whatever artifacts were banked
+# (success OR partial -- the round-3 lesson: a window that closes
+# mid-run must not leave real TPU data uncommitted), then re-arms
+# chip_watch.sh if the series did not complete, so a later window can
+# finish the job without a human watching.
+#
+# Usage: bash ci/series_babysit.sh <pid> [round_tag]
+set -u
+cd "$(dirname "$0")/.."
+PID=$1
+TAG=${2:-r4}
+RES=benchmarks/results
+LOG="$RES/chip_watch_${TAG}.log"
+
+log() { echo "$(date -u +%Y-%m-%dT%H:%M:%SZ) [babysit] $*" >> "$LOG"; }
+
+log "watching series pid=$PID tag=$TAG"
+while kill -0 "$PID" 2>/dev/null; do
+  sleep 30
+done
+# series pid is gone; rc is unknowable from here, so infer completion
+# from the sentinel the series prints at the end of its log
+if grep -q "series done" "$RES/tpu_round_${TAG}.log" 2>/dev/null; then
+  rc=0
+else
+  rc=1
+fi
+log "series pid=$PID exited (complete=$((1 - rc)))"
+
+if [ -n "$(git status --porcelain -- "$RES")" ]; then
+  for _ in 1 2 3 4 5; do
+    if { git add -- "$RES" && git commit -q -m \
+      "TPU series ${TAG}: artifacts from round-start window" \
+      -- "$RES"; } >> "$LOG" 2>&1; then
+      log "artifacts committed"
+      break
+    fi
+    log "git add/commit failed; retrying in 10s"
+    sleep 10
+  done
+fi
+
+if [ "$rc" -ne 0 ]; then
+  log "series incomplete; arming chip_watch"
+  exec bash ci/chip_watch.sh "$TAG" 300 10
+fi
+log "series complete; no watcher needed"
