@@ -41,8 +41,11 @@ verified:
    a big bf16 matmul chain on the same chip
    (``measured_matmul_tflops``); no table peak is trusted blind.
 4. **FLOP cross-check**: XLA's cost analysis AND an analytic estimate
-   are both reported; ``achieved_tflops_per_chip`` uses XLA's count
-   (analytic as fallback).
+   are both reported; the HEADLINE ``achieved_tflops_per_chip`` /
+   ``pct_of_bf16_peak`` use the conservative analytic (model-flops)
+   convention, with XLA's executed-flop count as the ``_xla`` sidecar
+   fields (round 5; XLA counts ResNet convs ~2x the model-flops
+   convention and would overstate MFU by the same factor).
 5. **Suspect gating**: a result claiming more than the self-calibrated
    matmul roofline (or >100% of the device's table peak, or wildly
    unstable step times) is emitted with ``"suspect": true`` and a
@@ -771,13 +774,24 @@ def measure(argv):
         except Exception as e:
             _log('cost analysis failed: %r' % e)
         analytic = float(cfg['analytic_flops'])
-        flops = xla_flops if xla_flops > 0 else analytic
-        achieved = flops / per_step / 1e12
+        # HEADLINE accounting is the conservative model-flops (analytic)
+        # convention -- XLA counts ResNet conv flops ~2x the standard
+        # model-flops convention, which round 4 showed can overstate MFU
+        # by the same factor (VERDICT r4 weak #1).  XLA's count (the
+        # flops the chip actually executed) is kept as a sidecar AND
+        # used for the impossible-claim suspect gates, where the HIGHER
+        # count is the sensitive one.
+        achieved = analytic / per_step / 1e12      # model-flops TF/s
+        achieved_xla = (xla_flops / per_step / 1e12) if xla_flops \
+            else None
         result['xla_flops_per_step'] = round(xla_flops / 1e9, 2)
         result['analytic_flops_per_step'] = round(analytic / 1e9, 2)
         result['flop_count_ratio_xla_over_analytic'] = round(
             xla_flops / analytic, 3) if xla_flops else None
         result['achieved_tflops_per_chip'] = round(achieved / n_dev, 3)
+        if achieved_xla is not None:
+            result['achieved_tflops_per_chip_xla'] = round(
+                achieved_xla / n_dev, 3)
         kind = jax.devices()[0].device_kind
         peak = next((v for k, v in BF16_PEAK_TFLOPS.items()
                      if k in kind.lower()), None)
@@ -786,14 +800,19 @@ def measure(argv):
             result['table_peak_bf16_tflops'] = peak
             pct = 100.0 * achieved / n_dev / peak
             result['pct_of_bf16_peak'] = round(pct, 1)
-            if pct > 100.0:
+            if achieved_xla is not None:
+                result['pct_of_bf16_peak_xla'] = round(
+                    100.0 * achieved_xla / n_dev / peak, 1)
+            gate_pct = 100.0 * max(achieved, achieved_xla or 0.0) \
+                / n_dev / peak
+            if gate_pct > 100.0:
                 suspect_reasons.append(
-                    'achieved %.1f%% of table bf16 peak' % pct)
-        if matmul_tflops and achieved / n_dev > matmul_tflops:
+                    'achieved %.1f%% of table bf16 peak' % gate_pct)
+        gate_tf = max(achieved, achieved_xla or 0.0) / n_dev
+        if matmul_tflops and gate_tf > matmul_tflops:
             suspect_reasons.append(
                 'achieved %.1f TF/s exceeds self-calibrated matmul '
-                'roofline %.1f TF/s' % (achieved / n_dev,
-                                        matmul_tflops))
+                'roofline %.1f TF/s' % (gate_tf, matmul_tflops))
     noise = _noise_estimate(times, reps)
     if per_step * (ks[-1] - ks[0]) < SIGNAL_MULT * noise:
         suspect_reasons.append(

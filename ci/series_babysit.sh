@@ -23,8 +23,13 @@ while kill -0 "$PID" 2>/dev/null; do
   sleep 30
 done
 # series pid is gone; rc is unknowable from here, so infer completion
-# from the sentinel the series prints at the end of its log
-if grep -q "series done" "$RES/tpu_round_${TAG}.log" 2>/dev/null; then
+# from the per-run marker the series writes at its very end.  The
+# marker is removed at series start, so a stale one from a PRIOR
+# same-tag run cannot fake completion (ADVICE r4 #2); existence alone
+# is therefore the right test -- an mtime-vs-babysit-start guard
+# would misread a series that finished before the babysitter attached
+# (cheap with banking) as incomplete and arm a pointless watcher.
+if [ -f "$RES/series_${TAG}.done" ]; then
   rc=0
 else
   rc=1
@@ -32,16 +37,25 @@ fi
 log "series pid=$PID exited (complete=$((1 - rc)))"
 
 if [ -n "$(git status --porcelain -- "$RES")" ]; then
+  committed=no
   for _ in 1 2 3 4 5; do
     if { git add -- "$RES" && git commit -q -m \
       "TPU series ${TAG}: artifacts from round-start window" \
       -- "$RES"; } >> "$LOG" 2>&1; then
       log "artifacts committed"
+      committed=yes
       break
     fi
     log "git add/commit failed; retrying in 10s"
     sleep 10
   done
+  if [ "$committed" = no ]; then
+    # unstage so the operator's next unrelated commit cannot silently
+    # sweep the artifacts in (ADVICE r4 #3; mirrors chip_watch.sh)
+    git restore --staged -- "$RES" >> "$LOG" 2>&1 || true
+    log "artifact commit FAILED after 5 attempts -- results are" \
+        "UNCOMMITTED in $RES (see git errors above)"
+  fi
 fi
 
 if [ "$rc" -ne 0 ]; then
