@@ -75,7 +75,13 @@ def test_stateful_classifier_train_step():
     assert not np.allclose(np.asarray(before), np.asarray(after))
 
 
+@pytest.mark.slow
 def test_googlenet_aux_heads():
+    # slow tail (VERDICT r4 next #7): the many-branch inception trace
+    # costs ~40s of COMPILE regardless of spatial size; the default
+    # suite keeps googlenetbn coverage via the device-matrix and
+    # bench plumbing, and the zoo forward test covers both variants
+    # under --runslow.
     model = models.GoogLeNet(num_classes=10, dtype=jnp.float32)
     x = jnp.ones((2, 224, 224, 3), jnp.float32)
     variables = model.init(
