@@ -55,7 +55,11 @@ ok = (not row.get('error') and not row.get('suspect')
 sys.exit(0 if ok else 1)
 EOF
 }
-pred_jsonl() {  # >=1 JSON row, none suspect/error (trailer lines ok)
+pred_jsonl() {  # sweep banked: substantial row count, no error rows,
+  # majority non-suspect.  Individual suspect rows are a DESIGNED-FOR
+  # outcome on a noisy tunnel (emitted, not retried) -- requiring
+  # zero of them would permanently un-bank the step and burn a
+  # multi-minute rerun every resume.
   python - "$1" <<'EOF'
 import json, sys
 rows = []
@@ -64,8 +68,9 @@ for ln in open(sys.argv[1]).read().splitlines():
         rows.append(json.loads(ln))
     except ValueError:
         pass
-ok = bool(rows) and all(
-    not r.get('error') and not r.get('suspect') for r in rows)
+good = sum(1 for r in rows if not r.get('suspect'))
+ok = (len(rows) >= 10 and 2 * good > len(rows)
+      and not any(r.get('error') for r in rows))
 sys.exit(0 if ok else 1)
 EOF
 }
@@ -113,13 +118,28 @@ run_with pred_jsonl allreduce_tpu 1800 \
 # --- tier 2: the headline (compile ~4-6 min/scan-length uncached) ----
 run bench_resnet50 3900 python bench.py
 
-# --- tier 3: the other BASELINE workloads (quick scans) --------------
-for m in vgg16 googlenetbn seq2seq transformer; do
-  run "bench_${m}" $QT python bench.py --model "$m" --quick
+# --- tier 3: the MFU chase (VERDICT r4 next #2) ----------------------
+# Promoted ABOVE the remaining workloads after the first r5 window:
+# the big cold compiles (vgg16, googlenetbn) repeatedly KILL the
+# tunnel's compile service, and anything ordered after them never
+# runs.  ResNet-50 variants reuse a proven-compilable graph family,
+# so the MFU sweep is cheap-risk, high-value (VERDICT ranks it #2).
+for B in 64 128 256; do
+  run "bench_resnet50_b${B}" $QT python bench.py --quick --batch "$B"
 done
+# MXU-friendly space-to-depth stem (exact equivalent; models/resnet50.py)
+run bench_resnet50_s2d $QT python bench.py --quick --s2d
+run bench_resnet50_s2d_b128 $QT python bench.py --quick --s2d --batch 128
 
-# transformer numerics gate: Pallas kernels vs jnp oracle on-device
+# --- tier 4: the remaining BASELINE workloads ------------------------
+# moderate compiles first; the two tunnel-killers LAST, with a
+# smaller-batch vgg16 attempt (smaller program) before the standard
+# one so SOME vgg16 datum banks even if the full config kills the
+# compile service again (per_device_batch_override is recorded in
+# the row, so the config is honest)
+run bench_transformer $QT python bench.py --model transformer --quick
 run bench_transformer_check $QT python bench.py --model transformer --quick --check
+run bench_seq2seq $QT python bench.py --model seq2seq --quick
 
 # flash-attention kernel vs XLA attention + block-size sweep
 run_with pred_wrote flash_attn 3000 \
@@ -135,15 +155,10 @@ run_with pred_pytest_green mosaic_gate 1200 \
     env CHAINERMN_TPU_TEST_PLATFORM=axon \
     python -m pytest tests/test_tpu_mosaic.py -v
 
-# --- tier 4: the MFU chase (VERDICT r4 next #2) ----------------------
-# per-device batch sweep on the headline model; each point costs its
-# own scan compiles (PERF.md knob 1)
-for B in 64 128 256; do
-  run "bench_resnet50_b${B}" $QT python bench.py --quick --batch "$B"
-done
-# MXU-friendly space-to-depth stem (exact equivalent; models/resnet50.py)
-run bench_resnet50_s2d $QT python bench.py --quick --s2d
-run bench_resnet50_s2d_b128 $QT python bench.py --quick --s2d --batch 128
+# --- tier 5: the tunnel-killer compiles, LAST ------------------------
+run bench_googlenetbn $QT python bench.py --model googlenetbn --quick
+run bench_vgg16_b16 $QT python bench.py --model vgg16 --quick --batch 16
+run bench_vgg16 $QT python bench.py --model vgg16 --quick
 
 echo "=== series done; JSON lines:" >&2
 for f in "$RES"/bench_*_"$TAG".out; do
