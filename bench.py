@@ -196,6 +196,39 @@ def devget_sync(x):
     return jax.device_get(leaves[-1])
 
 
+def init_on_host(fn, *args, **kwargs):
+    """Run a throwaway init computation on the host CPU backend and
+    ``device_put`` the result to the default backend.
+
+    The tunnel's remote-compile service has crashed on giant INIT
+    programs twice (googlenetbn r4/r5: ``model.init`` hung for 30
+    minutes; vgg16: broken pipe) -- and init is not what the bench
+    measures, so those compiles are pure risk.  ``measure()`` appends
+    ``cpu`` to ``jax_platforms`` so the host backend exists alongside
+    axon; if it still does not, fall back to the default device."""
+    import jax
+    dev = None
+    if jax.default_backend() != 'cpu':
+        try:
+            dev = jax.local_devices(backend='cpu')[0]
+        except Exception as e:
+            # no host backend available on this platform config --
+            # init falls back to the accelerator like before; LOUDLY,
+            # so a recurrence of the tunnel-killer init hang is
+            # attributable to this degraded mode
+            _log('init_on_host: no cpu backend (%r); initializing on '
+                 '%s' % (e, jax.default_backend()))
+            dev = None
+    if dev is None:
+        return fn(*args, **kwargs)
+    with jax.default_device(dev):
+        out = fn(*args, **kwargs)
+    # explicit target: device_put(x) without a device can leave the
+    # host-committed arrays on the CPU backend, and the measurement
+    # would then time host<->device transfers inside every step
+    return jax.device_put(out, jax.devices()[0])
+
+
 def probe_block_until_ready():
     """Is block_until_ready a real sync here?  Times a dependent chain
     of matmuls under both sync methods; records the verdict instead of
@@ -388,8 +421,9 @@ def _classifier_setup(model, insize, batch, seed=0, comm=None,
     if comm is None:
         comm = chainermn_tpu.create_communicator('xla')
     x0 = jnp.zeros((1, insize, insize, 3), jnp.float32)
-    variables = model.init({'params': jax.random.PRNGKey(seed)}, x0,
-                           train=False)
+    variables = init_on_host(
+        model.init, {'params': jax.random.PRNGKey(seed)}, x0,
+        train=False)
     params = variables['params']
     model_state = {k: v for k, v in variables.items() if k != 'params'}
     rng = np.random.RandomState(0)
@@ -516,9 +550,10 @@ def build_seq2seq(quick, on_cpu, per_dev_override=None):
     xs = rng.randint(1, vocab, (batch, seq_len)).astype(np.int32)
     ys_in = rng.randint(1, vocab, (batch, seq_len)).astype(np.int32)
     ys_out = rng.randint(1, vocab, (batch, seq_len)).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.zeros((1, seq_len), jnp.int32),
-                        jnp.zeros((1, seq_len), jnp.int32))['params']
+    params = init_on_host(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, seq_len), jnp.int32),
+        jnp.zeros((1, seq_len), jnp.int32))['params']
     loss = seq2seq_loss(
         lambda p, a, b: model.apply({'params': p}, a, b))
     upd, arrays = _updater_setup(
@@ -558,8 +593,9 @@ def build_transformer(quick, on_cpu, per_dev_override=None):
     rng = np.random.RandomState(0)
     toks = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
     tgts = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.zeros((1, seq), jnp.int32))['params']
+    params = init_on_host(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, seq), jnp.int32))['params']
     loss = lm_loss(lambda p, t: model.apply({'params': p}, t))
     upd, arrays = _updater_setup(
         loss, params, [(toks[i], tgts[i]) for i in range(batch)])
@@ -637,8 +673,9 @@ def build_mlp(quick, on_cpu, per_dev_override=None):
     rng = np.random.RandomState(0)
     x = rng.rand(batch, 784).astype(np.float32)
     y = rng.randint(0, 10, batch).astype(np.int32)
-    params = model.init(jax.random.PRNGKey(0),
-                        jnp.zeros((1, 784), jnp.float32))['params']
+    params = init_on_host(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, 784), jnp.float32))['params']
     loss = classifier_loss(lambda p, xx: model.apply({'params': p}, xx))
     upd, arrays = _updater_setup(
         loss, params, [(x[i], y[i]) for i in range(batch)])
@@ -674,6 +711,11 @@ def measure(argv):
     jax.config.update('jax_compilation_cache_dir', cache)
     jax.config.update('jax_persistent_cache_min_compile_time_secs', 1.0)
     jax.config.update('jax_persistent_cache_min_entry_size_bytes', -1)
+    # expose the host CPU backend ALONGSIDE the pinned accelerator
+    # platform (first entry stays the default backend) so throwaway
+    # init computations can run locally -- see init_on_host
+    from chainermn_tpu.utils.platform import enable_host_cpu_backend
+    enable_host_cpu_backend()
 
     if '--cpu' in argv:
         from chainermn_tpu.utils import force_host_devices

@@ -76,6 +76,12 @@ def main():
     if cpu:
         from chainermn_tpu.utils import force_host_devices
         force_host_devices(8, require=True)
+    else:
+        # host backend for throwaway model.init compiles -- the
+        # tunnel's remote-compile service has crashed on giant init
+        # programs (bench.py:init_on_host)
+        from chainermn_tpu.utils.platform import enable_host_cpu_backend
+        enable_host_cpu_backend()
 
     # same persistent compile cache as bench.py: a tunnel drop and
     # rerun must not pay 9 ResNet-50 scan compiles again

@@ -1,6 +1,7 @@
 """Runtime utilities: platform setup, profiling, failure detection,
 distributed LR recipes."""
 
+from chainermn_tpu.utils.platform import enable_host_cpu_backend  # noqa
 from chainermn_tpu.utils.platform import force_host_devices  # noqa
 from chainermn_tpu.utils import profiling  # noqa
 from chainermn_tpu.utils.failure import (  # noqa

@@ -25,6 +25,23 @@ def ensure_host_device_flag(n=8):
     return m
 
 
+def enable_host_cpu_backend():
+    """Expose the host CPU backend ALONGSIDE a pinned accelerator
+    platform (e.g. ``JAX_PLATFORMS=axon``), keeping the accelerator
+    first -- and therefore the default backend.
+
+    Lets throwaway work (parameter init) run locally instead of
+    stressing a tunneled remote-compile service with giant programs
+    it has crashed on (``bench.py:init_on_host``).  Must run before
+    first backend use; a no-op when no platform pin is set or cpu is
+    already listed.  Every tunnel-facing entry point that builds
+    models should call this, not just ``bench.py``."""
+    plats = os.environ.get('JAX_PLATFORMS', '')
+    names = [p.strip() for p in plats.split(',') if p.strip()]
+    if names and 'cpu' not in names:
+        jax.config.update('jax_platforms', ','.join(names + ['cpu']))
+
+
 def force_host_devices(n=8, require=False):
     """Switch this process to the CPU backend with ``n`` virtual
     devices and return the live CPU device count.
