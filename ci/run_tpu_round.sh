@@ -160,6 +160,12 @@ run bench_googlenetbn $QT python bench.py --model googlenetbn --quick
 run bench_vgg16_b16 $QT python bench.py --model vgg16 --quick --batch 16
 run bench_vgg16 $QT python bench.py --model vgg16 --quick
 
+# regenerate the 8->256 scaling projection from whatever this series
+# banked (pure host-side arithmetic; always cheap, never banked-skipped
+# so it reflects the freshest measured inputs)
+python benchmarks/scaling_projection.py --tag "$TAG" \
+  > "$RES/scaling_projection_${TAG}.log" 2>&1 || true
+
 echo "=== series done; JSON lines:" >&2
 for f in "$RES"/bench_*_"$TAG".out; do
   tail -1 "$f"
