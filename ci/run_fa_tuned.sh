@@ -1,0 +1,38 @@
+#!/bin/bash
+# Bench the transformer with the flash-attention block sizes the
+# on-chip sweep just crowned (PERF.md window playbook, automated):
+# reads the sweep rows from benchmarks/results/flash_attention_tpu.jsonl,
+# picks the fastest non-suspect (block_q, block_k), and execs bench.py
+# with the CHAINERMN_TPU_FA_BLOCK_Q/K overrides.  Exits 3 when no
+# usable sweep row exists (step stays un-banked and retries next
+# window, after the sweep has run).
+set -u
+cd "$(dirname "$0")/.."
+
+PICK=$(python - <<'EOF'
+import json, os
+path = 'benchmarks/results/flash_attention_tpu.jsonl'
+best = None
+if os.path.exists(path):
+    for ln in open(path):
+        try:
+            r = json.loads(ln)
+        except ValueError:
+            continue
+        if (r.get('sweep') and not r.get('suspect')
+                and not r.get('error') and r.get('pallas_ms')):
+            if best is None or r['pallas_ms'] < best['pallas_ms']:
+                best = r
+if best:
+    print('%d %d' % (best['block_q'], best['block_k']))
+EOF
+)
+if [ -z "$PICK" ]; then
+  echo "no usable sweep row in flash_attention_tpu.jsonl; run the" \
+       "flash_attn sweep first" >&2
+  exit 3
+fi
+set -- $PICK
+echo "adopting sweep winner: block_q=$1 block_k=$2" >&2
+exec env CHAINERMN_TPU_FA_BLOCK_Q="$1" CHAINERMN_TPU_FA_BLOCK_K="$2" \
+  python bench.py --model transformer --quick

@@ -145,6 +145,10 @@ run bench_seq2seq $QT python bench.py --model seq2seq --quick
 run_with pred_wrote flash_attn 3000 \
     python benchmarks/flash_attention_bench.py --sweep
 
+# transformer re-bench with the sweep's crowned block sizes (adopts
+# the winner automatically; exits un-banked when no sweep row yet)
+run bench_transformer_fatuned $QT bash ci/run_fa_tuned.sh
+
 # measured strategy comparison + profiler traces (VERDICT r3 item 9)
 run_with pred_wrote strategy_trace $QT \
     python benchmarks/strategy_trace.py
