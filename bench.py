@@ -798,6 +798,12 @@ def measure(argv):
     )
     if 'insize' in cfg:
         result['insize'] = cfg['insize']
+    # flash-attention block overrides (ci/run_fa_tuned.sh adoption
+    # path): the row must record the kernel config it measured
+    if os.environ.get('CHAINERMN_TPU_FA_BLOCK_Q'):
+        result['fa_block_q'] = os.environ['CHAINERMN_TPU_FA_BLOCK_Q']
+    if os.environ.get('CHAINERMN_TPU_FA_BLOCK_K'):
+        result['fa_block_k'] = os.environ['CHAINERMN_TPU_FA_BLOCK_K']
     if bur_trustworthy is not None:
         result['block_until_ready_trustworthy'] = bool(bur_trustworthy)
     if matmul_tflops is not None:
