@@ -126,6 +126,10 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument('--tag', default='r5')
     parser.add_argument('--params', type=int, default=RESNET50_PARAMS)
+    parser.add_argument('--results-dir', default=RES,
+                        help='where the jsonl lands (tests point this '
+                             'at a tmp dir; measured inputs are still '
+                             'read from the repo results dir)')
     args = parser.parse_args()
 
     got = measured_inputs(args.tag)
@@ -203,8 +207,9 @@ def main():
         'dcn_wire_ms': round(dcn_ms, 3)})
     print(json.dumps(emitted[-1]))
 
-    out_path = os.path.join(RES, 'scaling_projection_%s.jsonl'
-                            % args.tag)
+    os.makedirs(args.results_dir, exist_ok=True)
+    out_path = os.path.join(args.results_dir,
+                            'scaling_projection_%s.jsonl' % args.tag)
     with open(out_path, 'w') as f:
         for row in emitted:
             f.write(json.dumps(row) + '\n')

@@ -84,7 +84,7 @@ def test_projection_rows_are_labeled_and_monotone(tmp_path):
     out = subprocess.run(
         [sys.executable,
          os.path.join(REPO, 'benchmarks', 'scaling_projection.py'),
-         '--tag', 'nonexistent_tag'],
+         '--tag', 'nonexistent_tag', '--results-dir', str(tmp_path)],
         capture_output=True, text=True, cwd=REPO, timeout=120)
     assert out.returncode == 0, out.stderr
     rows = [json.loads(ln) for ln in out.stdout.splitlines()
