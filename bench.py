@@ -62,12 +62,16 @@ analysis), ``--check`` (transformer only: pin Pallas kernels against
 the jnp oracle on-device and record ``numerics_vs_oracle_ok``),
 ``--batch N`` (per-device batch override, the MFU-chase lever),
 ``--s2d`` (resnet50 only: MXU-friendly space-to-depth stem, exact
-weight-mapped equivalent of the 7x7/2 stem -- ``models/resnet50.py``).
+weight-mapped equivalent of the 7x7/2 stem -- ``models/resnet50.py``),
+``--no-adopt`` (resnet50 only: keep the default batch-32 config even
+when a banked MFU-sweep artifact crowns a faster one; see
+``adopt_tuned_config``).
 """
 
 import json
 import math
 import os
+import re
 import subprocess
 import sys
 import time
@@ -804,6 +808,11 @@ def measure(argv):
         result['fa_block_q'] = os.environ['CHAINERMN_TPU_FA_BLOCK_Q']
     if os.environ.get('CHAINERMN_TPU_FA_BLOCK_K'):
         result['fa_block_k'] = os.environ['CHAINERMN_TPU_FA_BLOCK_K']
+    # headline-tuning adoption provenance (set by adopt_tuned_config
+    # in the parent; inherited by this child via the environment)
+    if os.environ.get('CHAINERMN_TPU_ADOPTED_FROM'):
+        result['adopted_config_from'] = \
+            os.environ['CHAINERMN_TPU_ADOPTED_FROM']
     if bur_trustworthy is not None:
         result['block_until_ready_trustworthy'] = bool(bur_trustworthy)
     if matmul_tflops is not None:
@@ -938,6 +947,133 @@ def parse_s2d(argv, model):
     return True
 
 
+def _last_json_row(path):
+    """Parse the last non-blank line of a bench artifact as JSON (the
+    one-JSON-line-last contract every ``bench_*.out`` follows; the
+    same contract ci/run_tpu_round.sh's pred_json_row checks).
+    Returns None on any read/parse failure."""
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f.read().splitlines() if ln.strip()]
+        row = json.loads(lines[-1])
+    except (OSError, ValueError, IndexError):
+        return None
+    return row if isinstance(row, dict) else None
+
+
+def _trustworthy_value(row):
+    """The row's value when it is a trustworthy resnet50 measurement
+    (real-TPU, error-free, suspect-free, finite positive value), else
+    None.  ONE filter shared by the winner pick and the newest-tag
+    search so the two can never disagree on what counts."""
+    if (not isinstance(row, dict)
+            or not str(row.get('metric', '')).startswith('resnet50')
+            or row.get('backend') != 'tpu' or row.get('error')
+            or row.get('suspect')):
+        return None
+    try:
+        value = float(row.get('value', 0.0))
+    except (TypeError, ValueError):
+        return None
+    if not math.isfinite(value) or value <= 0:
+        return None
+    return value
+
+
+def pick_tuned_resnet50(rows):
+    """Choose the best banked resnet50 tuning from bench JSON rows.
+
+    Returns ``(flags, source, value)`` where ``flags`` is the argv
+    suffix reproducing the winning config (``['--batch', '128']``,
+    optionally ``'--s2d'``), or ``(None, None, None)`` when the
+    default config is (still) the best or no trustworthy tuned row
+    exists.  A row is trustworthy when it is a real-TPU, error-free,
+    suspect-free measurement with a finite positive value; the
+    incumbent is the best such row measured at the default config.
+    Pure function so the adoption policy is unit-testable off-chip.
+    """
+    best, incumbent = None, None
+    for row in rows:
+        value = _trustworthy_value(row)
+        if value is None:
+            continue
+        tuned = bool(row.get('per_device_batch_override')
+                     or row.get('stem'))
+        if tuned and (best is None or value > best[0]):
+            best = (value, row)
+        if not tuned and (incumbent is None or value > incumbent[0]):
+            incumbent = (value, row)
+    if best is None or (incumbent is not None
+                        and best[0] <= incumbent[0]):
+        return None, None, None
+    value, row = best
+    flags = []
+    if row.get('per_device_batch_override'):
+        flags += ['--batch', str(int(row['per_device_batch_override']))]
+    if row.get('stem'):
+        flags.append('--s2d')
+    return flags, row.get('_source', '(unknown artifact)'), value
+
+
+def adopt_tuned_config(argv, model):
+    """Parent-side headline tuning adoption (round 5; VERDICT r4 next
+    #2): a plain ``python bench.py`` consults the banked MFU-sweep
+    artifacts (``benchmarks/results/bench_resnet50*_*.out``, written
+    by ``ci/run_tpu_round.sh`` tier 3) and adopts the winning batch /
+    stem config, so the driver's end-of-round run (and the series'
+    own ``bench_resnet50_best`` step, which runs AFTER the sweep)
+    measures the best *measured* configuration rather than the
+    batch-32 floor.  The row stays honest:
+    ``per_device_batch_override`` / ``stem`` record the config and
+    ``adopted_config_from`` records the artifact that crowned it.
+    Explicit ``--batch`` / ``--s2d`` / ``--cpu`` / ``--no-adopt``
+    disable adoption.
+
+    Only artifacts from the NEWEST round tag with a trustworthy row
+    are considered (``bench_resnet50*_rN.out``): a winner crowned in
+    an earlier round -- possibly under a different chip allocation or
+    a since-fixed harness -- must not silently steer today's headline
+    config; within one tag all rows came from the same chip.
+    """
+    # cleared unconditionally so a value inherited from a wrapper's
+    # environment can never fabricate provenance on a run where
+    # adoption was disabled or declined
+    os.environ.pop('CHAINERMN_TPU_ADOPTED_FROM', None)
+    if (model != 'resnet50' or '--batch' in argv or '--s2d' in argv
+            or '--cpu' in argv or '--no-adopt' in argv):
+        return argv
+    res = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       'benchmarks', 'results')
+    by_tag = {}
+    try:
+        names = sorted(os.listdir(res))
+    except OSError:
+        return argv
+    for name in names:
+        if not (name.startswith('bench_resnet50')
+                and name.endswith('.out')):
+            continue
+        m = re.search(r'_r(\d+)\.out$', name)
+        if not m:
+            continue
+        row = _last_json_row(os.path.join(res, name))
+        if row is not None:
+            row['_source'] = name
+            by_tag.setdefault(int(m.group(1)), []).append(row)
+    flags = source = value = None
+    for tag in sorted(by_tag, reverse=True):
+        flags, source, value = pick_tuned_resnet50(by_tag[tag])
+        if any(_trustworthy_value(r) is not None
+               for r in by_tag[tag]):
+            break  # newest tag with any trustworthy row decides
+    if not flags:
+        return argv
+    _log('adopting tuned resnet50 config %s from %s '
+         '(banked %.1f items/s/chip)' % (' '.join(flags), source, value))
+    os.environ['CHAINERMN_TPU_ADOPTED_FROM'] = source
+    return argv + flags
+
+
 def parse_model(argv):
     """Extract and validate --model; emits the standard error line on
     a missing/unknown value (never a raw traceback)."""
@@ -962,6 +1098,7 @@ def main():
     if '--child' in argv:
         measure([a for a in argv if a != '--child'])
         return
+    argv = adopt_tuned_config(argv, model)
     if '--cpu' not in argv:
         ok = probe_backend()
         if ok is not True:

@@ -116,7 +116,13 @@ run_with pred_jsonl allreduce_tpu 1800 \
     python benchmarks/allreduce_payload_sweep.py
 
 # --- tier 2: the headline (compile ~4-6 min/scan-length uncached) ----
-run bench_resnet50 3900 python bench.py
+# --no-adopt: this artifact IS the default-config (batch 32) row that
+# PERF.md and scaling_projection.py consume, and the incumbent the
+# adoption policy compares sweep winners against -- letting a prior
+# round's winner steer it would make adoption sticky forever (the
+# default could never be re-crowned).  bench_resnet50_best below is
+# the adoption consumer.
+run bench_resnet50 3900 python bench.py --no-adopt
 
 # --- tier 3: the MFU chase (VERDICT r4 next #2) ----------------------
 # Promoted ABOVE the remaining workloads after the first r5 window:
@@ -130,6 +136,13 @@ done
 # MXU-friendly space-to-depth stem (exact equivalent; models/resnet50.py)
 run bench_resnet50_s2d $QT python bench.py --quick --s2d
 run bench_resnet50_s2d_b128 $QT python bench.py --quick --s2d --batch 128
+
+# end-of-sweep headline rerun: a PLAIN bench.py invocation adopts the
+# sweep winner just banked above (bench.py:adopt_tuned_config), so the
+# official-config artifact reflects THIS round's best measured config
+# and the exact compile cache the driver's end-of-round BENCH run will
+# hit is warmed here.  Runs non-quick (the driver's scan lengths).
+run bench_resnet50_best 3900 python bench.py
 
 # --- tier 4: the remaining BASELINE workloads ------------------------
 # moderate compiles first; the two tunnel-killers LAST, with a

@@ -129,6 +129,130 @@ def test_digits_npz_build_shapes_and_determinism():
 # ----------------------------------------------------------------------
 # host-init helpers
 
+def _rs_row(value, override=None, stem=None, **kw):
+    row = {'metric': 'resnet50_train_images_per_sec_per_chip',
+           'backend': 'tpu', 'value': value,
+           'per_device_batch_override': override, 'stem': stem}
+    row.update(kw)
+    return row
+
+
+def test_pick_tuned_resnet50_crowns_best_trustworthy_tuned_row():
+    from bench import pick_tuned_resnet50
+    flags, source, value = pick_tuned_resnet50([
+        _rs_row(2588.0, _source='bench_resnet50_r5.out'),
+        _rs_row(4100.0, override=128, _source='bench_resnet50_b128_r5.out'),
+        # higher but suspect -> must not win
+        _rs_row(9000.0, override=256, suspect=True,
+                _source='bench_resnet50_b256_r5.out'),
+        # higher but error row -> must not win
+        _rs_row(9500.0, override=256, error='bench_timeout',
+                _source='bench_resnet50_b256_r4.out'),
+        # higher but CPU backend -> must not win
+        dict(_rs_row(9999.0, override=256), backend='cpu'),
+        _rs_row(3900.0, override=64, stem='space_to_depth',
+                _source='bench_resnet50_s2d_r5.out'),
+    ])
+    assert flags == ['--batch', '128']
+    assert source == 'bench_resnet50_b128_r5.out'
+    assert value == 4100.0
+
+
+def test_pick_tuned_resnet50_keeps_default_when_it_wins():
+    from bench import pick_tuned_resnet50
+    flags, source, value = pick_tuned_resnet50([
+        _rs_row(2588.0),
+        _rs_row(2100.0, override=64),
+    ])
+    assert flags is None and source is None and value is None
+
+
+def test_pick_tuned_resnet50_stem_only_and_combined_flags():
+    from bench import pick_tuned_resnet50
+    flags, _, _ = pick_tuned_resnet50([
+        _rs_row(2588.0),
+        _rs_row(3000.0, stem='space_to_depth'),
+    ])
+    assert flags == ['--s2d']
+    flags, _, _ = pick_tuned_resnet50([
+        _rs_row(2588.0),
+        _rs_row(3000.0, override=128, stem='space_to_depth'),
+    ])
+    assert flags == ['--batch', '128', '--s2d']
+
+
+def test_pick_tuned_resnet50_no_rows_and_garbage_rows():
+    from bench import pick_tuned_resnet50
+    assert pick_tuned_resnet50([]) == (None, None, None)
+    assert pick_tuned_resnet50(
+        [{'metric': 'mlp_train_images_per_sec_per_chip',
+          'backend': 'tpu', 'value': 1.0,
+          'per_device_batch_override': 64},
+         'not-a-dict', {'value': 'nan-ish'}]) == (None, None, None)
+
+
+def test_adopt_tuned_config_reads_artifacts_and_sets_env(tmp_path,
+                                                         monkeypatch):
+    import bench
+    res = tmp_path / 'benchmarks' / 'results'
+    res.mkdir(parents=True)
+    (res / 'bench_resnet50_r5.out').write_text(
+        json.dumps(_rs_row(2588.0)) + '\n')
+    (res / 'bench_resnet50_b128_r5.out').write_text(
+        '[bench] stray log line\n' + json.dumps(_rs_row(4100.0,
+                                                        override=128)))
+    monkeypatch.setattr(
+        bench.os.path, 'dirname',
+        lambda p, _real=bench.os.path.dirname:
+            str(tmp_path) if p == bench.os.path.abspath(bench.__file__)
+            else _real(p))
+    # setenv FIRST so monkeypatch records the pre-test state and
+    # teardown restores it even though the code under test mutates
+    # the variable (delenv(raising=False) on an absent var records
+    # nothing and would leak fabricated provenance after the test)
+    monkeypatch.setenv('CHAINERMN_TPU_ADOPTED_FROM', 'sentinel')
+    os.environ.pop('CHAINERMN_TPU_ADOPTED_FROM')
+    argv = bench.adopt_tuned_config(['--quick'], 'resnet50')
+    assert argv == ['--quick', '--batch', '128']
+    assert os.environ['CHAINERMN_TPU_ADOPTED_FROM'] == \
+        'bench_resnet50_b128_r5.out'
+    # explicit flags disable adoption AND clear inherited provenance
+    # (a wrapper-exported stale value must not fabricate a row field)
+    os.environ['CHAINERMN_TPU_ADOPTED_FROM'] = 'stale.out'
+    assert bench.adopt_tuned_config(['--batch', '64'], 'resnet50') == \
+        ['--batch', '64']
+    assert 'CHAINERMN_TPU_ADOPTED_FROM' not in os.environ
+    assert bench.adopt_tuned_config(['--no-adopt'], 'resnet50') == \
+        ['--no-adopt']
+    assert bench.adopt_tuned_config([], 'vgg16') == []
+    # a stale tuned winner from an OLDER round is ignored once the
+    # newest tag has any trustworthy row: r6's default-config row
+    # becomes the deciding tag even though r5 crowned --batch 128
+    (res / 'bench_resnet50_r6.out').write_text(
+        json.dumps(_rs_row(2600.0)) + '\n')
+    assert bench.adopt_tuned_config(['--quick'], 'resnet50') == \
+        ['--quick']
+    assert 'CHAINERMN_TPU_ADOPTED_FROM' not in os.environ
+    # ...but a newest tag holding ONLY suspect rows defers to the
+    # last tag that produced trustworthy data
+    (res / 'bench_resnet50_r6.out').write_text(
+        json.dumps(_rs_row(2600.0, suspect=True)) + '\n')
+    argv = bench.adopt_tuned_config(['--quick'], 'resnet50')
+    assert argv == ['--quick', '--batch', '128']
+    # untagged artifacts (no _rN suffix) are ignored entirely
+    (res / 'bench_resnet50_custom.out').write_text(
+        json.dumps(_rs_row(99999.0, override=512)) + '\n')
+    argv = bench.adopt_tuned_config(['--quick'], 'resnet50')
+    assert argv == ['--quick', '--batch', '128']
+    # a newest tag holding only value-less rows (no error field, but
+    # value 0/NaN) must NOT terminate the tag search
+    (res / 'bench_resnet50_r6.out').write_text(
+        json.dumps(_rs_row(0.0)) + '\n'
+        + json.dumps(_rs_row(float('nan'), override=256)))
+    argv = bench.adopt_tuned_config(['--quick'], 'resnet50')
+    assert argv == ['--quick', '--batch', '128']
+
+
 def test_init_on_host_passthrough_on_cpu():
     # under the CPU test platform there is no separate host backend to
     # route to: init_on_host must behave exactly like calling fn
