@@ -31,6 +31,7 @@ measured) and writes traces under ``benchmarks/results/traces/``.
 
 import json
 import os
+import shutil
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
@@ -129,6 +130,11 @@ def main():
         # platform-scoped like the jsonl: a TPU run must not overwrite
         # the CPU plumbing traces (or vice versa)
         tdir = os.path.join(res, 'traces', platform, strategy)
+        # fresh dir per capture: accumulated profiler sessions would
+        # make any whole-dir analysis double-count self-times (prior
+        # rounds' traces stay available in git history -- chip_watch
+        # commits banked artifacts each window)
+        shutil.rmtree(tdir, ignore_errors=True)
         os.makedirs(tdir, exist_ok=True)
         from chainermn_tpu.utils.profiling import trace
         devget_sync(upd.update_core(arrays))  # compile + warm
@@ -140,6 +146,16 @@ def main():
         with open(out_path, 'a') as f:
             f.write(json.dumps(row) + '\n')
         print(json.dumps(row), flush=True)
+    # auto-render the step-time breakdown from the traces just
+    # captured (benchmarks/trace_report.py); best-effort so a
+    # converter failure cannot cost the timing rows above
+    try:
+        sys.path.insert(0, here)
+        import trace_report
+        trace_report.main(['--latest'])
+    except Exception as e:
+        print('[strategy_trace] trace_report failed: %r' % e,
+              file=sys.stderr, flush=True)
     print('wrote %s' % out_path)
 
 

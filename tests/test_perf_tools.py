@@ -253,6 +253,134 @@ def test_adopt_tuned_config_reads_artifacts_and_sets_env(tmp_path,
     assert argv == ['--quick', '--batch', '128']
 
 
+# ----------------------------------------------------------------------
+# trace report (benchmarks/trace_report.py)
+
+def _datatable(cols, rows):
+    return {'cols': [{'id': c} for c in cols],
+            'rows': [{'c': [{'v': v} for v in r]} for r in rows]}
+
+
+def test_trace_report_buckets_and_top_ops(tmp_path, monkeypatch):
+    from benchmarks import trace_report as tr
+    table = _datatable(
+        ['category', 'hlo_op_name', 'occurrences', 'total_self_time',
+         'model_flop_rate', 'measured_memory_bw', 'dma_stall_percent'],
+        [
+            ['convolution', '%conv.1', 3, 5000.0, 120.0, 300.0, 2.0],
+            ['convolution fusion', '%conv.2', 3, 3000.0, 90.0, 250.0,
+             0.0],
+            ['loop fusion', '%fused.bn', 49, 2500.0, None, 400.0, 10.0],
+            ['copy', '%copy.3', 7, 1000.0, None, 500.0, 0.0],
+            ['all-reduce', '%ar.1', 1, 500.0, None, None, 0.0],
+            ['weird-new-category', '%x.1', 1, 100.0, None, None, None],
+            ['convolution', '%conv.zero', 1, 0.0, None, None, None],
+        ])
+    d = tmp_path / 'trace'
+    d.mkdir()
+    (d / 'host.xplane.pb').write_bytes(b'\x00')  # existence only
+    monkeypatch.setattr(tr, '_tool_tables',
+                        lambda paths, tool: [table])
+    rep = tr.analyze_trace(str(d))
+    assert rep['source'] == 'hlo_stats'
+    assert rep['total_self_time_us'] == 12100.0
+    b = rep['buckets']
+    assert b['conv/matmul']['self_time_us'] == 8000.0
+    assert b['conv/matmul']['pct'] == 66.1
+    assert b['fusion/elementwise']['self_time_us'] == 2500.0
+    assert b['copy/transpose']['self_time_us'] == 1000.0
+    assert b['collective']['self_time_us'] == 500.0
+    assert b['other']['self_time_us'] == 100.0
+    # buckets ordered by descending self time
+    assert list(b) == ['conv/matmul', 'fusion/elementwise',
+                       'copy/transpose', 'collective', 'other']
+    assert rep['top_ops'][0]['op'] == '%conv.1'
+    # zero-self-time rows are dropped entirely
+    assert all(o['op'] != '%conv.zero' for o in rep['top_ops'])
+    text = tr.render(rep)
+    assert 'conv/matmul' in text and '%fused.bn' in text
+
+
+def test_trace_report_host_fallback_and_degradation(tmp_path,
+                                                    monkeypatch):
+    from benchmarks import trace_report as tr
+    d = tmp_path / 'trace'
+    d.mkdir()
+    (d / 'host.xplane.pb').write_bytes(b'\x00')
+    host = _datatable(
+        ['host_or_device', 'type', 'operation', 'occurrences',
+         'total_self_time'],
+        [['Host', 'matmul', 'jit(f)/dot_general', 8, 900.0]])
+    calls = []
+
+    def fake_tables(paths, tool):
+        calls.append(tool)
+        return [] if tool == 'hlo_stats' else [host]
+
+    monkeypatch.setattr(tr, '_tool_tables', fake_tables)
+    rep = tr.analyze_trace(str(d))
+    assert calls == ['hlo_stats', 'framework_op_stats']
+    assert rep['source'].startswith('framework_op_stats')
+    assert rep['top_ops'][0]['op'] == 'jit(f)/dot_general'
+    # missing traces and empty tables degrade to explanatory stubs
+    assert 'error' in tr.analyze_trace(str(tmp_path / 'nope'))
+    monkeypatch.setattr(tr, '_tool_tables', lambda p, t: [])
+    assert 'rows' in tr.analyze_trace(str(d))['error']
+    monkeypatch.setattr(
+        tr, '_tool_tables',
+        lambda p, t: (_ for _ in ()).throw(RuntimeError('boom')))
+    assert 'conversion failed' in tr.analyze_trace(str(d))['error']
+
+
+def test_trace_report_analyzes_only_newest_session(tmp_path,
+                                                   monkeypatch):
+    from benchmarks import trace_report as tr
+    d = tmp_path / 'trace'
+    old = d / 'plugins' / 'profile' / '2026_07_30_01_00_00'
+    new = d / 'plugins' / 'profile' / '2026_07_31_02_00_00'
+    for s in (old, new):
+        s.mkdir(parents=True)
+        (s / 'vm.xplane.pb').write_bytes(b'\x00')
+    seen = []
+
+    def fake_tables(paths, tool):
+        seen.extend(paths)
+        return [_datatable(['category', 'hlo_op_name',
+                            'total_self_time'],
+                           [['convolution', '%c', 10.0]])]
+
+    monkeypatch.setattr(tr, '_tool_tables', fake_tables)
+    rep = tr.analyze_trace(str(d))
+    # only the newest timestamped session contributes (no
+    # double-counting of prior rounds' captures left in the dir)
+    assert all('2026_07_31_02_00_00' in p for p in seen) and seen
+    assert rep['session'].endswith('2026_07_31_02_00_00')
+    assert rep['older_sessions_ignored'] == 1
+    assert rep['total_self_time_us'] == 10.0
+
+
+def test_trace_report_main_writes_jsonl(tmp_path, monkeypatch,
+                                        capsys):
+    from benchmarks import trace_report as tr
+    d = tmp_path / 'traces' / 'axon' / 'xla'
+    d.mkdir(parents=True)
+    (d / 'vm.xplane.pb').write_bytes(b'\x00')
+    monkeypatch.setattr(tr, 'RES', str(tmp_path))
+    monkeypatch.setattr(tr, '_tool_tables', lambda paths, tool: [
+        _datatable(['category', 'hlo_op_name', 'total_self_time'],
+                   [['convolution', '%c', 10.0]])])
+    assert tr.main(['--latest']) == 0
+    out = capsys.readouterr().out
+    assert 'conv/matmul' in out and 'wrote' in out
+    rows = [json.loads(ln) for ln in
+            open(str(tmp_path / 'trace_report.json'))]
+    assert len(rows) == 1 and rows[0]['source'] == 'hlo_stats'
+    # empty tree: says so, still exits 0 (safe to wire into CI)
+    monkeypatch.setattr(tr, 'RES', str(tmp_path / 'empty'))
+    assert tr.main(['--latest']) == 0
+    assert 'no trace dirs' in capsys.readouterr().out
+
+
 def test_init_on_host_passthrough_on_cpu():
     # under the CPU test platform there is no separate host backend to
     # route to: init_on_host must behave exactly like calling fn
