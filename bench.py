@@ -100,6 +100,16 @@ BF16_PEAK_TFLOPS = {
     'v6e': 918.0,
     'v6 lite': 918.0,
 }
+# HBM bandwidth spec GB/s per chip, by device_kind substring (the
+# allreduce sweep also measures a touch rate on the same chip)
+HBM_SPEC_GBS = {
+    'v4': 1228.0,
+    'v5e': 819.0,
+    'v5 lite': 819.0,
+    'v5p': 2765.0,
+    'v6e': 1640.0,
+    'v6 lite': 1640.0,
+}
 MODELS = ('resnet50', 'vgg16', 'googlenetbn', 'seq2seq', 'transformer',
           'mlp')
 
@@ -823,11 +833,13 @@ def measure(argv):
     if want_cost:
         _log('cost analysis')
         xla_flops = 0.0
+        xla_bytes = 0.0
         try:
             cost = cfg['upd'].compiled_cost_analysis(cfg['arrays'])
             # XLA cost analysis reports the LOCAL executable's flops,
             # i.e. per participating device of the SPMD program
             xla_flops = float(cost.get('flops', 0.0)) * n_dev
+            xla_bytes = float(cost.get('bytes accessed', 0.0))
         except Exception as e:
             _log('cost analysis failed: %r' % e)
         analytic = float(cfg['analytic_flops'])
@@ -852,6 +864,24 @@ def measure(argv):
         kind = jax.devices()[0].device_kind
         peak = next((v for k, v in BF16_PEAK_TFLOPS.items()
                      if k in kind.lower()), None)
+        if xla_bytes:
+            # post-fusion op-level bytes of the PER-DEVICE executable:
+            # an estimate of the step's HBM traffic (VMEM-resident
+            # reuse is still counted, so boundedness reads high).
+            # hbm_roofline_ms = the floor a perfectly-streamed step of
+            # this traffic could reach; hbm_explained_pct ~ how much
+            # of the measured step the HBM spec rate accounts for --
+            # the direct test of the HBM-bound hypothesis (PERF.md,
+            # "What the batch sweep's first point says").
+            result['xla_bytes_accessed_per_step_gb'] = round(
+                xla_bytes / 1e9, 3)
+            hbm = next((v for k, v in HBM_SPEC_GBS.items()
+                        if k in kind.lower()), None)
+            if not on_cpu and hbm:
+                hbm_ms = xla_bytes / (hbm * 1e9) * 1e3
+                result['hbm_roofline_ms'] = round(hbm_ms, 3)
+                result['hbm_explained_pct'] = round(
+                    100.0 * hbm_ms / (per_step * 1e3), 1)
         if not on_cpu and peak:
             result['device_kind'] = kind
             result['table_peak_bf16_tflops'] = peak
