@@ -113,6 +113,15 @@ HBM_SPEC_GBS = {
 MODELS = ('resnet50', 'vgg16', 'googlenetbn', 'seq2seq', 'transformer',
           'mlp')
 
+
+def spec_lookup(table, device_kind, default=None):
+    """Device-kind-substring lookup shared by every spec table (peak
+    TFLOP/s, HBM GB/s): ONE matching rule, so a new chip generation
+    added to one table cannot silently miss the idiom elsewhere."""
+    kind = device_kind.lower()
+    return next((v for k, v in table.items() if k in kind), default)
+
+
 PROBE_SRC = """
 import jax, jax.numpy as jnp
 d = jax.devices()
@@ -772,8 +781,7 @@ def measure(argv):
     # peak: plans the adaptive span escalation when RTT jitter hides
     # the marginal compute of short scans (see SIGNAL_MULT)
     kind = jax.devices()[0].device_kind
-    peak_guess = next((v for k, v in BF16_PEAK_TFLOPS.items()
-                       if k in kind.lower()), 500.0)
+    peak_guess = spec_lookup(BF16_PEAK_TFLOPS, kind, 500.0)
     # analytic_flops is the ALL-device total per step; the bound must
     # be per-step wall time, so divide by the mesh's aggregate peak
     floor = float(cfg['analytic_flops']) / (
@@ -862,8 +870,7 @@ def measure(argv):
             result['achieved_tflops_per_chip_xla'] = round(
                 achieved_xla / n_dev, 3)
         kind = jax.devices()[0].device_kind
-        peak = next((v for k, v in BF16_PEAK_TFLOPS.items()
-                     if k in kind.lower()), None)
+        peak = spec_lookup(BF16_PEAK_TFLOPS, kind)
         if xla_bytes:
             # post-fusion op-level bytes of the PER-DEVICE executable:
             # an estimate of the step's HBM traffic (VMEM-resident
@@ -875,8 +882,7 @@ def measure(argv):
             # "What the batch sweep's first point says").
             result['xla_bytes_accessed_per_step_gb'] = round(
                 xla_bytes / 1e9, 3)
-            hbm = next((v for k, v in HBM_SPEC_GBS.items()
-                        if k in kind.lower()), None)
+            hbm = spec_lookup(HBM_SPEC_GBS, kind)
             if not on_cpu and hbm:
                 hbm_ms = xla_bytes / (hbm * 1e9) * 1e3
                 result['hbm_roofline_ms'] = round(hbm_ms, 3)

@@ -105,6 +105,50 @@ pred_wrote() {  # completion trailer from sweep/trace scripts
   grep -q '^wrote ' "$1"
 }
 
+# Dead-tunnel circuit breaker: when the backend dies mid-window, each
+# remaining bench step burns ~13 min of probe retries before writing
+# its backend_unavailable row -- a dozen queued steps would waste
+# hours of window-less probing at the series' own glacial cadence.
+# After TWO consecutive dead-looking steps the series aborts (exit 4);
+# chip_watch then resumes its 5-minute probes and re-fires the
+# resumable series at the first un-banked step on next contact.
+DEAD=0
+note_outcome() {  # note_outcome <rc> <outfile>
+  local rc=$1 out=$2 err
+  if [ "$rc" -eq 0 ]; then
+    DEAD=0
+    return 0
+  fi
+  # last-JSON-line error field (same one-JSON-line-last contract as
+  # pred_json_row; this extracts the error string, that one judges
+  # bankability)
+  err=$(python - "$out" <<'EOF'
+import json, sys
+try:
+    lines = [ln for ln in open(sys.argv[1]).read().splitlines()
+             if ln.strip()]
+    print(json.loads(lines[-1]).get('error', ''))
+except Exception:
+    print('')
+EOF
+)
+  if [ "$err" = backend_unavailable ] || [ "$err" = bench_timeout ] \
+      || { [ "$rc" -eq 124 ] && [ -z "$err" ]; }; then
+    DEAD=$((DEAD + 1))
+    if [ "$DEAD" -ge 2 ]; then
+      echo "=== backend dead for $DEAD consecutive steps; aborting" \
+           "series (chip_watch resumes the remainder next contact)" >&2
+      exit 4
+    fi
+  else
+    # the step FAILED but not in a dead-tunnel way (the backend
+    # answered and produced a real error row): that breaks the
+    # consecutive-dead run, otherwise two dead steps separated by a
+    # live failure would abort a live window
+    DEAD=0
+  fi
+}
+
 run_with() {  # run_with <pred> <name> <timeout_s> <cmd...>
   local pred=$1 name=$2 tmo=$3; shift 3
   local out="$RES/${name}_${TAG}.out"
@@ -117,6 +161,7 @@ run_with() {  # run_with <pred> <name> <timeout_s> <cmd...>
   local rc=$?
   echo "=== [$name] rc=$rc" >&2
   tail -2 "$out" >&2 || true
+  note_outcome "$rc" "$out"
   return $rc
 }
 run() { run_with pred_json_row "$@"; }
