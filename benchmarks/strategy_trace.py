@@ -131,9 +131,10 @@ def main():
         # the CPU plumbing traces (or vice versa)
         tdir = os.path.join(res, 'traces', platform, strategy)
         # fresh dir per capture: accumulated profiler sessions would
-        # make any whole-dir analysis double-count self-times (prior
-        # rounds' traces stay available in git history -- chip_watch
-        # commits banked artifacts each window)
+        # make any whole-dir analysis double-count self-times.  The
+        # raw traces are local-only (.gitignore'd -- multi-MB
+        # binaries); the durable artifact is trace_report.json, which
+        # IS committed with the results
         shutil.rmtree(tdir, ignore_errors=True)
         os.makedirs(tdir, exist_ok=True)
         from chainermn_tpu.utils.profiling import trace
