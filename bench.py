@@ -1081,6 +1081,7 @@ def adopt_tuned_config(argv, model):
     res = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        'benchmarks', 'results')
     by_tag = {}
+    tag_mtime = {}
     try:
         names = sorted(os.listdir(res))
     except OSError:
@@ -1089,15 +1090,32 @@ def adopt_tuned_config(argv, model):
         if not (name.startswith('bench_resnet50')
                 and name.endswith('.out')):
             continue
-        m = re.search(r'_r(\d+)\.out$', name)
+        # any r-prefixed tag participates (r5, r5hotfix, ...); other
+        # suffixes are not round artifacts.  No underscore in the
+        # class: \w would swallow '..._b128_r5' into one bogus tag
+        m = re.search(r'_(r[a-zA-Z0-9]+)\.out$', name)
         if not m:
             continue
         row = _last_json_row(os.path.join(res, name))
         if row is not None:
+            tag = m.group(1)
             row['_source'] = name
-            by_tag.setdefault(int(m.group(1)), []).append(row)
+            by_tag.setdefault(tag, []).append(row)
+            try:
+                mt = os.path.getmtime(os.path.join(res, name))
+            except OSError:
+                mt = 0.0
+            tag_mtime[tag] = max(tag_mtime.get(tag, 0.0), mt)
+
+    def tag_key(tag):
+        # newest measurement wall-time first; numeric round as the
+        # tiebreak for equal mtimes (e.g. a fresh git checkout)
+        m2 = re.match(r'r(\d+)', tag)
+        return (tag_mtime.get(tag, 0.0),
+                int(m2.group(1)) if m2 else -1, tag)
+
     flags = source = value = None
-    for tag in sorted(by_tag, reverse=True):
+    for tag in sorted(by_tag, key=tag_key, reverse=True):
         flags, source, value = pick_tuned_resnet50(by_tag[tag])
         if any(_trustworthy_value(r) is not None
                for r in by_tag[tag]):

@@ -166,16 +166,12 @@ def analyze_trace(trace_dir):
 
 
 def latest_trace_dirs():
-    """Newest trace dir per (platform, strategy) under results/traces."""
-    found = {}
-    for p in glob.glob(os.path.join(RES, 'traces', '*', '*')):
-        if not os.path.isdir(p):
-            continue
-        key = tuple(p.split(os.sep)[-2:])
-        if key not in found or os.path.getmtime(p) > \
-                os.path.getmtime(found[key]):
-            found[key] = p
-    return [found[k] for k in sorted(found)]
+    """All (platform, strategy) trace dirs under results/traces.
+    Each dir holds exactly one strategy's captures; session selection
+    (newest capture within a dir) happens in analyze_trace."""
+    return sorted(p for p in
+                  glob.glob(os.path.join(RES, 'traces', '*', '*'))
+                  if os.path.isdir(p))
 
 
 def render(report):

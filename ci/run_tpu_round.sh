@@ -112,6 +112,15 @@ pred_wrote() {  # completion trailer from sweep/trace scripts
 # After TWO consecutive dead-looking steps the series aborts (exit 4);
 # chip_watch then resumes its 5-minute probes and re-fires the
 # resumable series at the first un-banked step on next contact.
+# The projection regen is pure host-side arithmetic over whatever is
+# banked; run it on EVERY exit path (including the circuit-breaker
+# abort below) so the freshest measured inputs are always reflected.
+regen_projection() {
+  python benchmarks/scaling_projection.py --tag "$TAG" \
+    > "$RES/scaling_projection_${TAG}.log" 2>&1 || true
+}
+trap regen_projection EXIT
+
 DEAD=0
 note_outcome() {  # note_outcome <rc> <outfile>
   local rc=$1 out=$2 err
@@ -211,7 +220,20 @@ run bench_resnet50_s2d_b128 $QT python bench.py --quick --s2d --batch 128
 # official-config artifact reflects THIS round's best measured config
 # and the exact compile cache the driver's end-of-round BENCH run will
 # hit is warmed here.  Runs non-quick (the driver's scan lengths).
-run_with pred_best_row bench_resnet50_best 3900 python bench.py
+# Short-circuited when adoption crowns nothing: the step would only
+# duplicate tier-2's default-config measurement at full non-quick
+# cost (the tier-2 run already warmed that cache).
+if python -c "
+import sys
+sys.path.insert(0, '.')
+import bench
+sys.exit(0 if bench.adopt_tuned_config([], 'resnet50') else 1)
+" 2>/dev/null; then
+  run_with pred_best_row bench_resnet50_best 3900 python bench.py
+else
+  echo "=== [bench_resnet50_best] no tuned winner beats the default;" \
+       "tier-2's --no-adopt row IS the best measured config" >&2
+fi
 
 # --- tier 4: the remaining BASELINE workloads ------------------------
 # moderate compiles first; the two tunnel-killers LAST, with a
@@ -246,11 +268,8 @@ run bench_googlenetbn $QT python bench.py --model googlenetbn --quick
 run bench_vgg16_b16 $QT python bench.py --model vgg16 --quick --batch 16
 run bench_vgg16 $QT python bench.py --model vgg16 --quick
 
-# regenerate the 8->256 scaling projection from whatever this series
-# banked (pure host-side arithmetic; always cheap, never banked-skipped
-# so it reflects the freshest measured inputs)
-python benchmarks/scaling_projection.py --tag "$TAG" \
-  > "$RES/scaling_projection_${TAG}.log" 2>&1 || true
+# (the 8->256 scaling projection regen runs in the EXIT trap above,
+# so it also covers the circuit-breaker abort path)
 
 echo "=== series done; JSON lines:" >&2
 for f in "$RES"/bench_*_"$TAG".out; do

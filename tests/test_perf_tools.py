@@ -244,6 +244,19 @@ def test_adopt_tuned_config_reads_artifacts_and_sets_env(tmp_path,
         json.dumps(_rs_row(99999.0, override=512)) + '\n')
     argv = bench.adopt_tuned_config(['--quick'], 'resnet50')
     assert argv == ['--quick', '--batch', '128']
+    # multi-underscore sweep filenames must group into the SAME tag
+    # as the plain headline artifact (a \w-style tag regex once
+    # swallowed '..._b64_r5' whole, splitting every artifact into its
+    # own tag and crowning a tuned row that LOSES to the incumbent)
+    (res / 'bench_resnet50_s2d_b96_r6.out').write_text(
+        json.dumps(_rs_row(1000.0, override=96,
+                           stem='space_to_depth')) + '\n')
+    (res / 'bench_resnet50_r6.out').write_text(
+        json.dumps(_rs_row(2600.0)) + '\n')
+    assert bench.adopt_tuned_config(['--quick'], 'resnet50') == \
+        ['--quick']
+    for f in ('bench_resnet50_s2d_b96_r6.out', 'bench_resnet50_r6.out'):
+        (res / f).unlink()
     # a newest tag holding only value-less rows (no error field, but
     # value 0/NaN) must NOT terminate the tag search
     (res / 'bench_resnet50_r6.out').write_text(
@@ -251,6 +264,50 @@ def test_adopt_tuned_config_reads_artifacts_and_sets_env(tmp_path,
         + json.dumps(_rs_row(float('nan'), override=256)))
     argv = bench.adopt_tuned_config(['--quick'], 'resnet50')
     assert argv == ['--quick', '--batch', '128']
+
+
+# ----------------------------------------------------------------------
+# series dead-tunnel circuit breaker (ci/run_tpu_round.sh)
+
+def _drive_breaker(tmp_path, outcomes):
+    """Source note_outcome from the series script and feed it a
+    sequence of (rc, row-or-None); returns the shell's exit code and
+    stdout (DEAD counter printed after each call)."""
+    files = []
+    for i, (_, row) in enumerate(outcomes):
+        p = tmp_path / ('o%d.out' % i)
+        p.write_text('' if row is None else json.dumps(row) + '\n')
+        files.append(str(p))
+    calls = '\n'.join(
+        'note_outcome %d %s; echo "DEAD=$DEAD"' % (rc, f)
+        for (rc, _), f in zip(outcomes, files))
+    script = (
+        'source <(sed -n "/^DEAD=0/,/^}/p" %s)\n%s\n'
+        % (os.path.join(REPO, 'ci', 'run_tpu_round.sh'), calls))
+    p = subprocess.run(['bash', '-c', script], capture_output=True,
+                       text=True, cwd=REPO)
+    return p.returncode, p.stdout
+
+
+def test_series_breaker_trips_on_two_consecutive_dead_steps(tmp_path):
+    dead = {'metric': 'x', 'value': 0.0, 'error': 'backend_unavailable'}
+    rc, out = _drive_breaker(tmp_path, [(1, dead), (1, dead)])
+    assert rc == 4
+    assert out.splitlines() == ['DEAD=1']  # second call exits
+
+
+def test_series_breaker_resets_on_success_and_live_failure(tmp_path):
+    dead = {'metric': 'x', 'value': 0.0, 'error': 'bench_timeout'}
+    ok = {'metric': 'x', 'value': 5.0}
+    live = {'metric': 'x', 'value': 0.0, 'error': 'bench_failed'}
+    rc, out = _drive_breaker(
+        tmp_path,
+        [(1, dead), (0, ok), (1, dead), (1, live), (124, None)])
+    # success and a live (backend-answered) failure both break the
+    # consecutive-dead run; the bare timeout then only reaches DEAD=1
+    assert rc == 0
+    assert out.splitlines() == ['DEAD=1', 'DEAD=0', 'DEAD=1',
+                                'DEAD=0', 'DEAD=1']
 
 
 # ----------------------------------------------------------------------
