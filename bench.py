@@ -1108,11 +1108,13 @@ def adopt_tuned_config(argv, model):
             tag_mtime[tag] = max(tag_mtime.get(tag, 0.0), mt)
 
     def tag_key(tag):
-        # newest measurement wall-time first; numeric round as the
-        # tiebreak for equal mtimes (e.g. a fresh git checkout)
+        # numeric round FIRST (git checkouts do not preserve mtimes,
+        # so r10 must beat r5 regardless of file timestamps); artifact
+        # mtime breaks ties between same-number tags (r5 vs a later
+        # r5hotfix), then the tag string for full determinism
         m2 = re.match(r'r(\d+)', tag)
-        return (tag_mtime.get(tag, 0.0),
-                int(m2.group(1)) if m2 else -1, tag)
+        return (int(m2.group(1)) if m2 else -1,
+                tag_mtime.get(tag, 0.0), tag)
 
     flags = source = value = None
     for tag in sorted(by_tag, key=tag_key, reverse=True):

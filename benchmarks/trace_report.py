@@ -116,6 +116,33 @@ def _collect_ops(paths, tool):
     return buckets, ops
 
 
+# overview_page property keys worth surfacing (TPU traces populate
+# these; host-only traces report zeros, which analyze_trace's caller
+# sees only alongside real op rows anyway)
+UTIL_KEYS = (
+    'device_duty_cycle_percent',
+    'mxu_utilization_percent',
+    'hbm_utilization_percent',
+    'flop_rate_utilization_relative_to_roofline',
+    'device_idle_time_percent',
+)
+
+
+def device_utilization(paths):
+    """Device-level utilization summary from the overview_page tool
+    (best-effort; {} when unavailable)."""
+    try:
+        out = {}
+        for table in _tool_tables(paths, 'overview_page'):
+            props = table.get('p') or {}
+            for key in UTIL_KEYS:
+                if key in props and key not in out:
+                    out[key] = props[key]
+        return out
+    except Exception:
+        return {}
+
+
 def analyze_trace(trace_dir):
     """One report object for one trace dir (or an explanatory stub)."""
     paths = sorted(glob.glob(
@@ -152,6 +179,9 @@ def analyze_trace(trace_dir):
         out['error'] = ('trace has neither device-op nor framework-op '
                         'rows')
         return out
+    util = device_utilization(paths)
+    if util:
+        out['device_utilization'] = util
     total = sum(b['self_time_us'] for b in buckets.values())
     out['total_self_time_us'] = round(total, 1)
     out['buckets'] = {
@@ -181,6 +211,8 @@ def render(report):
         return '\n'.join(lines)
     lines.append('  total device self time: %.1f us'
                  % report['total_self_time_us'])
+    for key, val in (report.get('device_utilization') or {}).items():
+        lines.append('  %s: %s' % (key, val))
     for name, b in report['buckets'].items():
         lines.append('  %-20s %8.1f us  %5.1f%%  (%d ops)'
                      % (name, b['self_time_us'], b['pct'], b['ops']))

@@ -222,17 +222,31 @@ run bench_resnet50_s2d_b128 $QT python bench.py --quick --s2d --batch 128
 # hit is warmed here.  Runs non-quick (the driver's scan lengths).
 # Short-circuited when adoption crowns nothing: the step would only
 # duplicate tier-2's default-config measurement at full non-quick
-# cost (the tier-2 run already warmed that cache).
-if python -c "
+# cost (the tier-2 run already warmed that cache).  Exit codes keep
+# a crashed gate distinct from a legitimate no-winner (a crash falls
+# through to MEASURING, the conservative default).
+python -c "
 import sys
 sys.path.insert(0, '.')
 import bench
-sys.exit(0 if bench.adopt_tuned_config([], 'resnet50') else 1)
-" 2>/dev/null; then
-  run_with pred_best_row bench_resnet50_best 3900 python bench.py
-else
+sys.exit(0 if bench.adopt_tuned_config([], 'resnet50') else 3)
+"
+gate_rc=$?
+if [ "$gate_rc" -eq 3 ]; then
   echo "=== [bench_resnet50_best] no tuned winner beats the default;" \
        "tier-2's --no-adopt row IS the best measured config" >&2
+  # a best row banked EARLIER in the round under a since-dethroned
+  # winner must not survive as the official artifact (it matches the
+  # adoption glob and would be committed as if current)
+  stale="$RES/bench_resnet50_best_${TAG}.out"
+  if [ -s "$stale" ] && ! pred_best_row "$stale"; then
+    echo "=== [bench_resnet50_best] removing stale dethroned row" >&2
+    rm -f "$stale" "$RES/bench_resnet50_best_${TAG}.err"
+  fi
+else
+  [ "$gate_rc" -ne 0 ] && echo "=== [bench_resnet50_best] adoption" \
+    "gate crashed (rc=$gate_rc); measuring anyway" >&2
+  run_with pred_best_row bench_resnet50_best 3900 python bench.py
 fi
 
 # --- tier 4: the remaining BASELINE workloads ------------------------

@@ -336,10 +336,19 @@ def test_trace_report_buckets_and_top_ops(tmp_path, monkeypatch):
     d = tmp_path / 'trace'
     d.mkdir()
     (d / 'host.xplane.pb').write_bytes(b'\x00')  # existence only
-    monkeypatch.setattr(tr, '_tool_tables',
-                        lambda paths, tool: [table])
+    overview = {'cols': [], 'rows': [],
+                'p': {'device_duty_cycle_percent': '41.0%',
+                      'mxu_utilization_percent': '18.2%',
+                      'not_a_surfaced_key': 'x'}}
+    monkeypatch.setattr(
+        tr, '_tool_tables',
+        lambda paths, tool: ([overview] if tool == 'overview_page'
+                             else [table]))
     rep = tr.analyze_trace(str(d))
     assert rep['source'] == 'hlo_stats'
+    assert rep['device_utilization'] == {
+        'device_duty_cycle_percent': '41.0%',
+        'mxu_utilization_percent': '18.2%'}
     assert rep['total_self_time_us'] == 12100.0
     b = rep['buckets']
     assert b['conv/matmul']['self_time_us'] == 8000.0
@@ -376,7 +385,9 @@ def test_trace_report_host_fallback_and_degradation(tmp_path,
 
     monkeypatch.setattr(tr, '_tool_tables', fake_tables)
     rep = tr.analyze_trace(str(d))
-    assert calls == ['hlo_stats', 'framework_op_stats']
+    # hlo first, host fallback second; overview_page utilization is
+    # queried only after ops were found
+    assert calls[:2] == ['hlo_stats', 'framework_op_stats']
     assert rep['source'].startswith('framework_op_stats')
     assert rep['top_ops'][0]['op'] == 'jit(f)/dot_general'
     # missing traces and empty tables degrade to explanatory stubs
