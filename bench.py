@@ -997,15 +997,16 @@ def _last_json_row(path):
     return row if isinstance(row, dict) else None
 
 
-def _trustworthy_value(row):
-    """The row's value when it is a trustworthy resnet50 measurement
-    (real-TPU, error-free, suspect-free, finite positive value), else
-    None.  ONE filter shared by the winner pick and the newest-tag
-    search so the two can never disagree on what counts."""
+def _trustworthy_value(row, model='resnet50'):
+    """The row's value when it is a trustworthy ``model`` measurement
+    (real-TPU, error-free, suspect-free, retraction-free, finite
+    positive value), else None.  ONE filter shared by the winner
+    pick, the newest-tag search and the banked-last-good lookup so
+    they can never disagree on what counts."""
     if (not isinstance(row, dict)
-            or not str(row.get('metric', '')).startswith('resnet50')
+            or not str(row.get('metric', '')).startswith(model)
             or row.get('backend') != 'tpu' or row.get('error')
-            or row.get('suspect')):
+            or row.get('suspect') or row.get('retracted')):
         return None
     try:
         value = float(row.get('value', 0.0))
@@ -1049,6 +1050,50 @@ def pick_tuned_resnet50(rows):
     if row.get('stem'):
         flags.append('--s2d')
     return flags, row.get('_source', '(unknown artifact)'), value
+
+
+def banked_last_good(model):
+    """Newest banked trustworthy measurement for ``model`` from the
+    committed round artifacts (``benchmarks/results/bench_<model>*_
+    rN.out``): ``(value, round_tag, source_name)``, or
+    ``(None, None, None)`` when no trustworthy row is banked.
+
+    Consumed by the ``backend_unavailable`` path (VERDICT r5 "What's
+    weak" #1): a dead tunnel must degrade to a 0.0 row that still
+    CARRIES the last-good measurement, labeled as banked, instead of
+    erasing the trajectory for the window.
+    """
+    res = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       'benchmarks', 'results')
+    try:
+        names = sorted(os.listdir(res))
+    except OSError:
+        return None, None, None
+    best_by_tag = {}
+    for name in names:
+        if not (name.startswith('bench_' + model)
+                and name.endswith('.out')):
+            continue
+        m = re.search(r'_(r[a-zA-Z0-9]+)\.out$', name)
+        if not m:
+            continue
+        value = _trustworthy_value(
+            _last_json_row(os.path.join(res, name)), model)
+        if value is None:
+            continue
+        tag = m.group(1)
+        if tag not in best_by_tag or value > best_by_tag[tag][0]:
+            best_by_tag[tag] = (value, name)
+    if not best_by_tag:
+        return None, None, None
+
+    def tag_key(tag):
+        m2 = re.match(r'r(\d+)', tag)
+        return (int(m2.group(1)) if m2 else -1, tag)
+
+    tag = max(best_by_tag, key=tag_key)
+    value, name = best_by_tag[tag]
+    return value, tag, name
 
 
 def adopt_tuned_config(argv, model):
@@ -1158,8 +1203,17 @@ def main():
     if '--cpu' not in argv:
         ok = probe_backend()
         if ok is not True:
-            emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
-                      error='backend_unavailable', detail=ok), rc=1)
+            row = dict(metric_stub(model), value=0.0,
+                       vs_baseline=0.0,
+                       error='backend_unavailable', detail=ok)
+            # a dead tunnel still reports the banked last-good
+            # measurement, clearly labeled (never as `value`: a
+            # banked number is not a measurement of THIS window)
+            banked, tag, src = banked_last_good(model)
+            if banked is not None:
+                row.update(banked_value=banked, banked_round=tag,
+                           banked_source=src)
+            emit(row, rc=1)
     run_child(argv, model)
 
 

@@ -1,0 +1,92 @@
+"""``python -m chainermn_tpu.analysis``: the shardlint CLI.
+
+Sweeps every registered communicator strategy plus the example train
+steps, prints findings (text or ``--json``), exits non-zero when any
+ERROR-severity finding fires.  Static analysis never needs the
+accelerator: the backend is pinned to an 8-device virtual CPU mesh
+before first backend use (override the platform with
+``CHAINERMN_TPU_ANALYSIS_PLATFORM`` for debugging only).
+"""
+
+import argparse
+import os
+import sys
+import time
+
+# Pin the backend BEFORE any jax device use (backends are created
+# lazily, so setting config here -- after the package import chain has
+# merely imported jax -- still takes effect; same pattern as
+# tests/conftest.py).
+_platform = os.environ.get('CHAINERMN_TPU_ANALYSIS_PLATFORM', 'cpu')
+os.environ['JAX_PLATFORMS'] = _platform
+
+from chainermn_tpu.utils.platform import ensure_host_device_flag  # noqa: E402
+
+ensure_host_device_flag(8)
+
+import jax  # noqa: E402
+
+jax.config.update('jax_platforms', _platform)
+
+
+def main(argv=None):
+    from chainermn_tpu import analysis
+    from chainermn_tpu.analysis import rules as rules_mod
+
+    parser = argparse.ArgumentParser(
+        prog='python -m chainermn_tpu.analysis',
+        description='shardlint: jaxpr-level static analysis of '
+                    'collectives, donation and recompilation hazards')
+    parser.add_argument('--json', action='store_true',
+                        help='emit one JSON report on stdout')
+    parser.add_argument('--list-rules', action='store_true',
+                        help='print the rule catalogue and exit')
+    parser.add_argument('--strategy', action='append', default=None,
+                        help='lint only this strategy (repeatable); '
+                             'default: all registered strategies')
+    parser.add_argument('--rules', default=None,
+                        help='comma-separated rule ids to run '
+                             '(default: all)')
+    parser.add_argument('--no-steps', action='store_true',
+                        help='skip the train-step targets (strategy '
+                             'sweep only; much faster)')
+    parser.add_argument('--no-resnet50', action='store_true',
+                        help='skip the resnet50 example step (the '
+                             'slowest trace)')
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule_id, (_fn, desc) in sorted(rules_mod.RULES.items()):
+            print('%s  %s' % (rule_id, desc))
+        return 0
+
+    only = None
+    if args.rules:
+        only = {r.strip() for r in args.rules.split(',') if r.strip()}
+        unknown = only - set(rules_mod.RULES)
+        if unknown:
+            parser.error('unknown rule id(s): %s (see --list-rules)'
+                         % ', '.join(sorted(unknown)))
+
+    t0 = time.monotonic()
+
+    def progress(name):
+        print('[shardlint %.1fs] %s' % (time.monotonic() - t0, name),
+              file=sys.stderr, flush=True)
+
+    targets = analysis.default_targets(
+        strategies=args.strategy,
+        include_steps=not args.no_steps,
+        include_resnet50=not args.no_resnet50)
+    report = analysis.build_report(targets, only=only,
+                                   progress=progress)
+
+    if args.json:
+        print(report.to_json())
+    else:
+        print(report.render_text())
+    return 0 if report.ok() else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

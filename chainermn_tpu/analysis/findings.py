@@ -1,0 +1,91 @@
+"""Finding/report datatypes for shardlint (``chainermn_tpu.analysis``).
+
+A :class:`Finding` is one rule hit: rule id, severity, message, the
+lint target it fired on, and a best-effort source location recovered
+from the jaxpr's ``source_info`` (``jax.source_info_util``).  A
+:class:`Report` aggregates findings across targets and renders the
+text / JSON outputs the CLI and the CI gate consume.
+"""
+
+import json
+
+SEV_ERROR = 'error'
+SEV_WARNING = 'warning'
+SEVERITIES = (SEV_ERROR, SEV_WARNING)
+
+
+class Finding:
+    """One rule violation (or analyzer-level failure) on one target."""
+
+    def __init__(self, rule_id, severity, message, target='',
+                 where=None):
+        if severity not in SEVERITIES:
+            raise ValueError('severity must be one of %r, got %r'
+                             % (SEVERITIES, severity))
+        self.rule_id = rule_id
+        self.severity = severity
+        self.message = message
+        self.target = target
+        self.where = where  # "file.py:line" or None
+
+    def as_dict(self):
+        return {'rule': self.rule_id, 'severity': self.severity,
+                'target': self.target, 'message': self.message,
+                'where': self.where}
+
+    def __repr__(self):
+        loc = ' (%s)' % self.where if self.where else ''
+        return '%s: %s %s: %s%s' % (self.target, self.severity,
+                                    self.rule_id, self.message, loc)
+
+
+class Report:
+    """Findings across a lint sweep, plus per-target bookkeeping."""
+
+    def __init__(self):
+        self.findings = []
+        self.targets = []  # names, in lint order
+
+    def add(self, finding):
+        self.findings.append(finding)
+
+    def extend(self, findings):
+        self.findings.extend(findings)
+
+    def add_target(self, name):
+        self.targets.append(name)
+
+    @property
+    def errors(self):
+        return [f for f in self.findings if f.severity == SEV_ERROR]
+
+    @property
+    def warnings(self):
+        return [f for f in self.findings if f.severity == SEV_WARNING]
+
+    def ok(self):
+        return not self.errors
+
+    def as_dict(self):
+        return {
+            'tool': 'shardlint',
+            'targets': list(self.targets),
+            'n_targets': len(self.targets),
+            'n_errors': len(self.errors),
+            'n_warnings': len(self.warnings),
+            'ok': self.ok(),
+            'findings': [f.as_dict() for f in self.findings],
+        }
+
+    def to_json(self, indent=None):
+        return json.dumps(self.as_dict(), indent=indent, sort_keys=False)
+
+    def render_text(self):
+        lines = []
+        for f in self.findings:
+            lines.append(repr(f))
+        lines.append('shardlint: %d target(s), %d error(s), '
+                     '%d warning(s)' % (len(self.targets),
+                                        len(self.errors),
+                                        len(self.warnings)))
+        return '\n'.join(lines)

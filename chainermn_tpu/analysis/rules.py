@@ -1,0 +1,334 @@
+"""shardlint rules.
+
+Each rule is a function ``rule(ctx) -> [Finding, ...]`` over a
+:class:`RuleContext` holding the target's traced jaxpr and its
+declared topology.  Rule IDs are stable (``SL0xx``); see
+``docs/static_analysis.md`` for the catalogue.  The ChainerMN
+reference proved these invariants dynamically by running the suite
+under ``mpiexec -n {1,2,3}``; here the sharding decisions live in
+traced code, so the same invariants are PROVEN per strategy from the
+jaxpr on CPU.
+"""
+
+import numpy as np
+
+from chainermn_tpu.analysis import walker
+from chainermn_tpu.analysis.findings import (
+    Finding, SEV_ERROR, SEV_WARNING)
+
+
+class RuleContext:
+    """Everything a rule may inspect for one lint target.
+
+    Attributes:
+      target_name: display name (``"strategy:xla:allreduce_grad"``).
+      jaxpr: the target's ``ClosedJaxpr`` (None when tracing failed).
+      mesh_axes: ``{axis_name: size}`` of the target's mesh.
+      reduction_axes: declared reduce topology (tuple of axis names)
+        for gradient-reduction targets, else None -- the
+        communicator's ``reduction_axes`` introspection hook.
+      signatures: list of abstract signatures of two synthetic
+        consecutive steps (None for single-shot targets).
+      trace_error: exception raised while tracing, if any.
+    """
+
+    def __init__(self, target_name, jaxpr=None, mesh_axes=None,
+                 reduction_axes=None, signatures=None,
+                 trace_error=None):
+        self.target_name = target_name
+        self.jaxpr = jaxpr
+        self.mesh_axes = dict(mesh_axes or {})
+        self.reduction_axes = reduction_axes
+        self.signatures = signatures
+        self.trace_error = trace_error
+
+    def finding(self, rule_id, severity, message, eqn=None):
+        return Finding(rule_id, severity, message,
+                       target=self.target_name,
+                       where=walker.eqn_source(eqn)
+                       if eqn is not None else None)
+
+
+# ---------------------------------------------------------------------
+# SL001: collective axis names exist in the mesh and, for gradient
+# reductions, their union matches the strategy's declared topology.
+def rule_axis_topology(ctx):
+    out = []
+    if ctx.trace_error is not None:
+        # an unknown axis name cannot even trace: JAX aborts with
+        # "unbound axis name".  Claim that failure as this rule's
+        # finding; other trace failures stay SL000 (see runner).
+        msg = str(ctx.trace_error)
+        if 'unbound axis name' in msg:
+            out.append(ctx.finding(
+                'SL001', SEV_ERROR,
+                'collective references an axis the mesh does not '
+                'bind: %s' % msg.splitlines()[0]))
+        return out
+    if ctx.jaxpr is None:
+        return out
+    known = set(ctx.mesh_axes)
+    reduce_axes_seen = set()
+    for eqn, _path in walker.iter_eqns(ctx.jaxpr):
+        name = eqn.primitive.name
+        if name not in walker.COLLECTIVE_PRIMS:
+            continue
+        axes = walker.eqn_axes(eqn)
+        for ax in axes:
+            if ax not in known:
+                out.append(ctx.finding(
+                    'SL001', SEV_ERROR,
+                    '%s over unknown mesh axis %r (mesh axes: %s)'
+                    % (name, ax, sorted(known)), eqn))
+        if name in walker.REDUCE_PRIMS:
+            reduce_axes_seen.update(a for a in axes if a in known)
+    if ctx.reduction_axes is not None:
+        declared = set(ctx.reduction_axes)
+        if reduce_axes_seen != declared:
+            out.append(ctx.finding(
+                'SL001', SEV_ERROR,
+                'reduce collectives cover axes %s but the strategy '
+                'declares reduction_axes=%s'
+                % (sorted(reduce_axes_seen), sorted(declared))))
+    return out
+
+
+# ---------------------------------------------------------------------
+# SL002: every ppermute permutation is a bijection on its axis.
+def rule_ppermute_bijective(ctx):
+    out = []
+    if ctx.jaxpr is None:
+        return out
+    for eqn, _path in walker.iter_eqns(ctx.jaxpr):
+        if eqn.primitive.name != 'ppermute':
+            continue
+        perm = [tuple(int(v) for v in pair)
+                for pair in eqn.params.get('perm', ())]
+        axes = walker.eqn_axes(eqn)
+        size = int(np.prod([ctx.mesh_axes.get(a, 1) for a in axes])) \
+            if axes else 0
+        srcs = [s for s, _ in perm]
+        dsts = [d for _, d in perm]
+        if len(set(srcs)) != len(srcs) or len(set(dsts)) != len(dsts):
+            out.append(ctx.finding(
+                'SL002', SEV_ERROR,
+                'ppermute permutation is not a bijection (duplicate '
+                'source or destination): %r' % (perm,), eqn))
+            continue
+        if size and any(not (0 <= v < size) for v in srcs + dsts):
+            out.append(ctx.finding(
+                'SL002', SEV_ERROR,
+                'ppermute index out of range for axis size %d: %r'
+                % (size, perm), eqn))
+            continue
+        if size and len(perm) not in (0, size):
+            out.append(ctx.finding(
+                'SL002', SEV_WARNING,
+                'ppermute covers %d of %d ranks: uncovered '
+                'destinations receive zeros' % (len(perm), size),
+                eqn))
+    return out
+
+
+# ---------------------------------------------------------------------
+# SL003: redundant collective chains (psum-of-psum over overlapping
+# axes, all_gather-of-all_gather over the same axis).
+def rule_redundant_collectives(ctx):
+    out = []
+    if ctx.jaxpr is None:
+        return out
+    reduce_set = set(walker.REDUCE_PRIMS) - {
+        'reduce_scatter', 'psum_scatter'}
+    for jx, _path in walker.iter_jaxprs(ctx.jaxpr):
+        producers = walker.producer_map(jx)
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name not in walker.COLLECTIVE_PRIMS:
+                continue
+            axes = set(walker.eqn_axes(eqn))
+            for invar in eqn.invars:
+                prev = producers.get(invar)
+                if prev is None:
+                    continue
+                pname = prev.primitive.name
+                paxes = set(walker.eqn_axes(prev))
+                if (name in reduce_set and pname in reduce_set
+                        and axes & paxes):
+                    out.append(ctx.finding(
+                        'SL003', SEV_WARNING,
+                        '%s over %s consumes the output of %s over '
+                        '%s: the value is already reduced over the '
+                        'shared axis (re-reducing multiplies by axis '
+                        'size or wastes a collective)'
+                        % (name, sorted(axes), pname, sorted(paxes)),
+                        eqn))
+                elif (name == 'all_gather' and pname == 'all_gather'
+                        and axes == paxes):
+                    out.append(ctx.finding(
+                        'SL003', SEV_WARNING,
+                        'all_gather of an all_gather over the same '
+                        'axis %s: the operand is already replicated '
+                        'along it' % sorted(axes), eqn))
+    return out
+
+
+# ---------------------------------------------------------------------
+# SL004: a reduction must not execute in a narrower dtype than its
+# input (e.g. bf16 psum of f32 gradients loses mantissa on the wire).
+def rule_reduction_dtype(ctx):
+    out = []
+    if ctx.jaxpr is None:
+        return out
+    for jx, _path in walker.iter_jaxprs(ctx.jaxpr):
+        producers = walker.producer_map(jx)
+        for eqn in jx.eqns:
+            if eqn.primitive.name not in walker.REDUCE_PRIMS:
+                continue
+            for invar in eqn.invars:
+                prev = producers.get(invar)
+                if (prev is None
+                        or prev.primitive.name
+                        != 'convert_element_type'):
+                    continue
+                src = prev.invars[0].aval
+                dst = prev.outvars[0].aval
+                try:
+                    narrow = (np.dtype(src.dtype).itemsize
+                              > np.dtype(dst.dtype).itemsize)
+                except TypeError:
+                    continue
+                if narrow:
+                    out.append(ctx.finding(
+                        'SL004', SEV_ERROR,
+                        '%s executes in %s on a value narrowed from '
+                        '%s immediately before the collective: the '
+                        'reduction loses precision on the wire'
+                        % (eqn.primitive.name, dst.dtype, src.dtype),
+                        eqn))
+    return out
+
+
+# ---------------------------------------------------------------------
+# SL005: donated buffers are consumed and can alias an output.
+def rule_donation(ctx):
+    out = []
+    if ctx.jaxpr is None:
+        return out
+    for eqn, _path in walker.iter_eqns(ctx.jaxpr):
+        if eqn.primitive.name != 'pjit':
+            continue
+        donated = eqn.params.get('donated_invars')
+        if not donated or not any(donated):
+            continue
+        sub = walker.raw_jaxpr(eqn.params['jaxpr'])
+        used = set()
+        for inner, _p in walker.iter_eqns(sub):
+            used.update(id(v) for v in inner.invars)
+        used.update(id(v) for v in sub.outvars)
+        out_avals = [v.aval for v in sub.outvars]
+        free_outputs = [(tuple(a.shape), str(a.dtype))
+                        for a in out_avals]
+        for i, (var, don) in enumerate(zip(sub.invars, donated)):
+            if not don:
+                continue
+            aval = var.aval
+            if id(var) not in used:
+                out.append(ctx.finding(
+                    'SL005', SEV_ERROR,
+                    'donated argument %d (%s%s) is never consumed by '
+                    'the jitted computation: the donation frees '
+                    'nothing and jit only warns at run time'
+                    % (i, aval.dtype, list(aval.shape)), eqn))
+                continue
+            sig = (tuple(aval.shape), str(aval.dtype))
+            if sig in free_outputs:
+                # claim one matching output slot: two donated inputs
+                # cannot alias the same output buffer
+                free_outputs.remove(sig)
+            else:
+                out.append(ctx.finding(
+                    'SL005', SEV_ERROR,
+                    'donated argument %d (%s%s) matches no output '
+                    'buffer shape/dtype: XLA cannot alias it, the '
+                    'donation is wasted and HBM holds both copies'
+                    % (i, aval.dtype, list(aval.shape)), eqn))
+    return out
+
+
+# ---------------------------------------------------------------------
+# SL006: no host round-trips inside the step.
+def rule_host_callbacks(ctx):
+    out = []
+    if ctx.jaxpr is None:
+        return out
+    for eqn, path in walker.iter_eqns(ctx.jaxpr):
+        if eqn.primitive.name in walker.CALLBACK_PRIMS:
+            out.append(ctx.finding(
+                'SL006', SEV_ERROR,
+                '%s inside the compiled step: every call stalls the '
+                'device on a host round-trip (enclosing scope: %s)'
+                % (eqn.primitive.name, '/'.join(path) or 'top level'),
+                eqn))
+    return out
+
+
+# ---------------------------------------------------------------------
+# SL007: abstract signature stable across consecutive synthetic steps
+# (weak-type / python-scalar / shape drift recompiles every call).
+def rule_recompilation(ctx):
+    out = []
+    sigs = ctx.signatures
+    if not sigs or len(sigs) < 2:
+        return out
+    first = sigs[0]
+    for step, sig in enumerate(sigs[1:], start=1):
+        if sig == first:
+            continue
+        detail = 'argument count changed (%d vs %d)' % (len(first),
+                                                        len(sig))
+        for i, (a, b) in enumerate(zip(first, sig)):
+            if a != b:
+                detail = ('argument leaf %d changed: '
+                          '%s/%s/weak=%s vs %s/%s/weak=%s'
+                          % (i, a[0], a[1], a[2], b[0], b[1], b[2]))
+                break
+        out.append(ctx.finding(
+            'SL007', SEV_ERROR,
+            'abstract step signature differs between synthetic '
+            'iterations 1 and %d -- jit recompiles every step '
+            '(%s)' % (step + 1, detail)))
+        break
+    return out
+
+
+#: rule id -> (callable, one-line description)
+RULES = {
+    'SL001': (rule_axis_topology,
+              'collective axis names exist in the mesh and reduce '
+              'collectives match the declared reduction topology'),
+    'SL002': (rule_ppermute_bijective,
+              'ppermute permutations are bijections on their axis'),
+    'SL003': (rule_redundant_collectives,
+              'no redundant collective chains (psum-of-psum, '
+              'gather-of-gather)'),
+    'SL004': (rule_reduction_dtype,
+              'reductions do not execute in a narrower dtype than '
+              'their inputs'),
+    'SL005': (rule_donation,
+              'donated buffers are consumed and can alias an output'),
+    'SL006': (rule_host_callbacks,
+              'no host round-trips (callbacks) inside the step'),
+    'SL007': (rule_recompilation,
+              'abstract step signature is stable across iterations '
+              '(no recompilation leak)'),
+}
+
+
+def run_rules(ctx, only=None):
+    """Run every rule (or the ``only`` subset) over one context."""
+    findings = []
+    for rule_id, (fn, _desc) in sorted(RULES.items()):
+        if only is not None and rule_id not in only:
+            continue
+        findings.extend(fn(ctx))
+    return findings

@@ -1,0 +1,66 @@
+"""shardlint driver: trace targets, run rules, build the report."""
+
+import jax
+
+from chainermn_tpu.analysis import rules as rules_mod
+from chainermn_tpu.analysis import walker
+from chainermn_tpu.analysis.findings import Finding, Report, SEV_ERROR
+
+
+def trace_target(target):
+    """``(jaxpr, error)``: the target's ClosedJaxpr, or the exception
+    tracing raised (abstract evaluation only -- nothing executes)."""
+    try:
+        return jax.make_jaxpr(target.fn)(*target.args), None
+    except Exception as e:
+        return None, e
+
+
+def lint_target(target, only=None):
+    """All findings for one target."""
+    jaxpr, err = trace_target(target)
+    signatures = None
+    sig_err = None
+    if target.make_args is not None:
+        try:
+            signatures = [
+                walker.abstract_signature(target.make_args(it))
+                for it in (1, 2)]
+        except Exception as e:
+            sig_err = e
+    ctx = rules_mod.RuleContext(
+        target.name, jaxpr=jaxpr, mesh_axes=target.mesh_axes,
+        reduction_axes=target.reduction_axes, signatures=signatures,
+        trace_error=err)
+    findings = rules_mod.run_rules(ctx, only=only)
+    # a trace failure no rule claimed (SL001 claims unbound-axis
+    # aborts) is itself a lint error: the production step cannot
+    # compile
+    if err is not None and not any(f.rule_id == 'SL001'
+                                   for f in findings):
+        findings.append(Finding(
+            'SL000', SEV_ERROR,
+            'tracing failed: %s: %s'
+            % (type(err).__name__, str(err).splitlines()[0]
+               if str(err) else ''), target=target.name))
+    if sig_err is not None:
+        findings.append(Finding(
+            'SL000', SEV_ERROR,
+            'signature probe failed: %s: %s'
+            % (type(sig_err).__name__,
+               str(sig_err).splitlines()[0] if str(sig_err) else ''),
+            target=target.name))
+    return findings
+
+
+def build_report(targets, only=None, progress=None):
+    """Lint every target into one :class:`Report`.  ``progress`` is an
+    optional ``callable(target_name)`` invoked before each target (the
+    CLI uses it for stderr liveness)."""
+    report = Report()
+    for target in targets:
+        if progress is not None:
+            progress(target.name)
+        report.add_target(target.name)
+        report.extend(lint_target(target, only=only))
+    return report

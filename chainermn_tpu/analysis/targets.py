@@ -1,0 +1,256 @@
+"""Lint-target construction for shardlint.
+
+A :class:`LintTarget` pairs a traceable callable with the topology
+metadata the rules check against.  :func:`strategy_targets` covers
+every registered communicator strategy's collective surface
+(``allreduce_grad`` / ``broadcast_data`` / ``send_recv``);
+:func:`step_targets` covers the real train steps -- the standard
+updater (mlp example parity), the ZeRO-1 core and full step, the
+pipeline updater, and the resnet50 stateful step (imagenet example
+parity).  Everything traces abstractly via ``jax.make_jaxpr`` -- no
+collective actually runs, so the whole sweep is CPU-only.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class LintTarget:
+    """One analyzable callable plus the metadata rules need.
+
+    Attributes:
+      name: stable display name (``strategy:xla:allreduce_grad``).
+      fn / args: ``jax.make_jaxpr(fn)(*args)`` yields the jaxpr.
+      mesh_axes: ``{axis_name: size}``.
+      reduction_axes: declared reduce topology for gradient-reduction
+        targets (the communicator's introspection hook), else None.
+      make_args: ``make_args(iteration) -> args`` for targets with an
+        iteration-dependent signature (recompilation rule); None
+        disables that rule.
+    """
+
+    def __init__(self, name, fn, args, mesh_axes, reduction_axes=None,
+                 make_args=None):
+        self.name = name
+        self.fn = fn
+        self.args = tuple(args)
+        self.mesh_axes = dict(mesh_axes)
+        self.reduction_axes = reduction_axes
+        self.make_args = make_args
+
+    def __repr__(self):
+        return 'LintTarget(%s)' % self.name
+
+
+def _strategy_mesh_shape(name, n):
+    from chainermn_tpu.communicators import mesh_utility
+    if name == 'single_node':
+        return (1, n)
+    return mesh_utility.balanced_2d(n)
+
+
+def _mapped(comm, method):
+    """Wrap a communicator collective method for tracing inside a
+    shard_map over the strategy's own mesh (the canonical calling
+    convention, ``base.py`` docstring)."""
+    def run(tree):
+        return jax.shard_map(
+            method, mesh=comm.mesh, in_specs=P(), out_specs=P(),
+            check_vma=False)(tree)
+    return run
+
+
+def _synthetic_grads():
+    """Small mixed-shape f32 pytree standing in for model grads."""
+    return {'w': jnp.zeros((13, 3), jnp.float32),
+            'b': jnp.zeros((5,), jnp.float32)}
+
+
+def strategy_targets(names=None, comm_factory=None):
+    """Lint targets for each registered strategy (default: all 9).
+
+    ``comm_factory(name) -> communicator`` overrides construction --
+    the fixture tests inject known-bad strategies through it.
+    """
+    from chainermn_tpu import communicators
+
+    if names is None:
+        names = sorted(communicators._COMMUNICATORS)
+    n = len(jax.devices())
+    out = []
+    for name in names:
+        if comm_factory is not None:
+            comm = comm_factory(name)
+        else:
+            comm = communicators.create_communicator(
+                name, mesh_shape=_strategy_mesh_shape(name, n))
+        mesh_axes = dict(comm.mesh.shape)
+        grads = _synthetic_grads()
+        out.append(LintTarget(
+            'strategy:%s:allreduce_grad' % name,
+            _mapped(comm, comm.allreduce_grad), (grads,), mesh_axes,
+            reduction_axes=tuple(comm.reduction_axes)))
+        out.append(LintTarget(
+            'strategy:%s:broadcast_data' % name,
+            _mapped(comm, comm.broadcast_data), (grads,), mesh_axes))
+        size = comm.size
+        perm = [(i, (i + 1) % size) for i in range(size)]
+        out.append(LintTarget(
+            'strategy:%s:send_recv' % name,
+            _mapped(comm, lambda x, _p=perm, _c=comm:
+                    _c.send_recv(x, _p)),
+            (jnp.zeros((4, 4), jnp.float32),), mesh_axes))
+    return out
+
+
+# ---------------------------------------------------------------------
+# train-step targets
+
+def _data_comm():
+    from chainermn_tpu import communicators
+    n = len(jax.devices())
+    from chainermn_tpu.communicators import mesh_utility
+    return communicators.create_communicator(
+        'xla', mesh_shape=mesh_utility.balanced_2d(n))
+
+
+def _updater_target(name, updater, batch, mesh_axes):
+    fn, args = updater.traceable_step(batch, iteration=1)
+    return LintTarget(
+        name, fn, args, mesh_axes,
+        make_args=lambda it: updater.traceable_step(
+            batch, iteration=it)[1])
+
+
+def mlp_step_target(comm=None):
+    """The mnist example's train step (``examples/mnist``): MLP +
+    multi-node optimizer + donation, standard updater."""
+    import optax
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, Classifier
+
+    comm = comm or _data_comm()
+    model = MLP(n_units=16, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))
+    clf = Classifier(model.apply)
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    updater = training.StandardUpdater(
+        iter([]), optimizer, clf, params, comm, has_aux=True)
+    batch = (jnp.zeros((16, 784), jnp.float32),
+             jnp.zeros((16,), jnp.int32))
+    return _updater_target('step:mlp_example', updater, batch,
+                           dict(comm.mesh.shape))
+
+
+def zero_step_target(comm=None):
+    """The full ZeRO-1 train step (``StandardUpdater(zero=True)``)."""
+    import optax
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, Classifier
+
+    comm = comm or _data_comm()
+    model = MLP(n_units=16, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))
+    clf = Classifier(model.apply)
+    updater = training.StandardUpdater(
+        iter([]), optax.adam(1e-3), clf, params, comm, has_aux=True,
+        zero=True)
+    batch = (jnp.zeros((16, 784), jnp.float32),
+             jnp.zeros((16,), jnp.int32))
+    return _updater_target('step:zero', updater, batch,
+                           dict(comm.mesh.shape))
+
+
+def zero_core_target(comm=None):
+    """The bare ZeRO-1 scatter/update/gather cycle
+    (:func:`chainermn_tpu.parallel.zero.traceable_shard_update`)."""
+    import optax
+    from chainermn_tpu.parallel import zero
+
+    comm = comm or _data_comm()
+    params = _synthetic_grads()
+    fn, args = zero.traceable_shard_update(
+        optax.adam(1e-3), params, comm)
+    return LintTarget('step:zero_core', fn, args,
+                      dict(comm.mesh.shape))
+
+
+def pipeline_step_target():
+    """The pipeline updater's gpipe train step on a (data, stage)
+    mesh."""
+    import optax
+    from chainermn_tpu.training.pipeline_updater import (
+        PipelineUpdater, pipeline_mesh)
+
+    mesh = pipeline_mesh(2)
+    d = 8
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p['w'] + p['b'])
+
+    def loss_on_last(outs, y_micro):
+        loss = jnp.mean((outs - y_micro) ** 2)
+        return loss, {'mse': loss}
+
+    params_stacked = {
+        'w': jnp.zeros((2, d, d), jnp.float32),
+        'b': jnp.zeros((2, d), jnp.float32)}
+    updater = PipelineUpdater(
+        iter([]), optax.sgd(1e-2), stage_fn, loss_on_last,
+        params_stacked, mesh, n_micro=2)
+    n_data = mesh.shape['data']
+    batch = (jnp.zeros((4 * n_data, d), jnp.float32),
+             jnp.zeros((4 * n_data, d), jnp.float32))
+    return _updater_target('step:pipeline', updater, batch,
+                           dict(mesh.shape))
+
+
+def resnet50_step_target(comm=None, insize=32, batch=8):
+    """The imagenet example's train step (``examples/imagenet``):
+    ResNet-50 with BatchNorm state, dropout RNG plumbing and
+    cross-replica statistics sync."""
+    import optax
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models.classifier import StatefulClassifier
+    from chainermn_tpu.models.resnet50 import ResNet50
+
+    comm = comm or _data_comm()
+    model = ResNet50(num_classes=10)
+    x0 = jnp.zeros((1, insize, insize, 3), jnp.float32)
+    variables = model.init({'params': jax.random.PRNGKey(0)}, x0,
+                           train=False)
+    params = variables['params']
+    model_state = {k: v for k, v in variables.items()
+                   if k != 'params'}
+    clf = StatefulClassifier(model)
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    updater = training.StandardUpdater(
+        iter([]), optimizer, clf.loss, params, comm,
+        model_state=model_state)
+    arrays = (jnp.zeros((batch, insize, insize, 3), jnp.float32),
+              jnp.zeros((batch,), jnp.int32))
+    return _updater_target('step:resnet50_example', updater, arrays,
+                           dict(comm.mesh.shape))
+
+
+def step_targets(include_resnet50=True):
+    out = [mlp_step_target(), zero_core_target(), zero_step_target(),
+           pipeline_step_target()]
+    if include_resnet50:
+        out.append(resnet50_step_target())
+    return out
+
+
+def default_targets(strategies=None, include_steps=True,
+                    include_resnet50=True):
+    out = strategy_targets(strategies)
+    if include_steps:
+        out.extend(step_targets(include_resnet50=include_resnet50))
+    return out

@@ -97,6 +97,14 @@ class CommunicatorBase:
     implement the reduction strategy in :meth:`_allreduce_impl`.
     """
 
+    #: Declared reduction topology -- the mesh axes a full gradient
+    #: allreduce covers.  Introspection hook for the static analyzer
+    #: (:mod:`chainermn_tpu.analysis`): the union of reduce axes
+    #: observed in ``allreduce_grad``'s jaxpr must equal this set.
+    #: Strategies reducing over a subset (``single_node``) or nothing
+    #: (``dummy``) override it.
+    reduction_axes = AXES
+
     def __init__(self, mesh=None, mesh_shape=None, devices=None):
         if mesh is None:
             mesh = mesh_utility.build_mesh(devices, mesh_shape)

@@ -13,5 +13,7 @@ from chainermn_tpu.communicators.base import CommunicatorBase
 
 class DummyCommunicator(CommunicatorBase):
 
+    reduction_axes = ()
+
     def _allreduce_impl(self, grads):
         return memory_utility.fused_reduce(grads, lambda buf: buf)

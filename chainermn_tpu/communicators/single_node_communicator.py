@@ -15,6 +15,8 @@ from chainermn_tpu.communicators.mesh_utility import AXIS_INTRA
 
 class SingleNodeCommunicator(CommunicatorBase):
 
+    reduction_axes = (AXIS_INTRA,)
+
     def __init__(self, mesh=None, mesh_shape=None, devices=None):
         super().__init__(mesh, mesh_shape, devices)
         if self.inter_size != 1:

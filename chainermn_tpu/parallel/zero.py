@@ -290,8 +290,8 @@ def check_elementwise(optimizer, atol=1e-7):
     u1, _ = optimizer.update(g1, optimizer.init(probe), probe)
     u2, _ = optimizer.update(g2, optimizer.init(probe), probe)
     others = np.concatenate([
-        np.abs(np.asarray(u1['a'] - u2['a']))[1:],
-        np.abs(np.asarray(u1['b'] - u2['b']))])
+        np.abs(np.asarray(u1['a'] - u2['a']))[1:],  # noqa: shardlint
+        np.abs(np.asarray(u1['b'] - u2['b']))])  # noqa: shardlint
     if np.any(others > atol):
         fail('perturbing one gradient element moved updates at %d '
              'other position(s) (max %.3g)'
@@ -307,8 +307,9 @@ def check_elementwise(optimizer, atol=1e-7):
     p1d, g1d = {'w': w}, {'w': g}
     u2d, _ = optimizer.update(g2d, optimizer.init(p2d), p2d)
     u1d, _ = optimizer.update(g1d, optimizer.init(p1d), p1d)
-    diff = np.abs(np.asarray(u2d['w']).reshape(-1)
-                  - np.asarray(u1d['w']))
+    diff = np.abs(np.asarray(u2d['w'])  # noqa: shardlint - probe
+                  .reshape(-1)
+                  - np.asarray(u1d['w']))  # noqa: shardlint
     if np.any(diff > atol):
         fail('a 2-D leaf and its flattened 1-D twin produce different '
              'updates (max diff %.3g) -- the transform reads leaf '
@@ -389,3 +390,50 @@ def squeeze_state(state):
 def unsqueeze_state(state):
     return jax.tree_util.tree_map(
         lambda x: x[None] if getattr(x, 'ndim', 0) >= 1 else x, state)
+
+
+def traceable_shard_update(optimizer, params, comm):
+    """``(fn, args)``: the bare ZeRO-1 scatter -> sharded-update ->
+    gather cycle as a traceable ``shard_map`` over ``comm.mesh``.
+
+    Step factory for jaxpr-level static analysis
+    (:mod:`chainermn_tpu.analysis`): it exposes exactly the collective
+    pattern ``StandardUpdater(zero=True)`` runs per iteration --
+    mean-reduce-scatter of every gradient leaf, optimizer update on
+    the local shard (mesh-aware norms in scope), all-gather of the
+    parameter delta -- without requiring a model, loss or iterator.
+    ``jax.make_jaxpr(fn)(*args)`` performs no device computation.
+    """
+    import optax
+    from jax.sharding import PartitionSpec as P
+    from chainermn_tpu.communicators.mesh_utility import AXES
+
+    n = comm.size
+    local_state = optimizer.init(shard_templates(params, n))
+    specs = state_specs(local_state, AXES)
+    stacked = expand_state(local_state, n)
+    grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def device_update(params, opt_state, grads):
+        rank = comm.axis_rank()
+        g_sh = jax.tree_util.tree_map(
+            lambda g: scatter_grad_leaf(g, n, AXES), grads)
+        p_sh = jax.tree_util.tree_map(
+            lambda p: param_shard_leaf(p, n, rank), params)
+        opt_local = squeeze_state(opt_state)
+        with mesh_norm_scope(lambda t: axes_sumsq(t, AXES),
+                             leaf_sumsq=lambda x: axes_sumsq(x, AXES)):
+            updates, new_opt = optimizer.update(g_sh, opt_local, p_sh)
+        upd_full = jax.tree_util.tree_map(
+            lambda u, p: gather_update_leaf(u, p, AXES), updates,
+            params)
+        return (optax.apply_updates(params, upd_full),
+                unsqueeze_state(new_opt))
+
+    def fn(params, opt_state, grads):
+        return jax.shard_map(
+            device_update, mesh=comm.mesh,
+            in_specs=(P(), specs, P()), out_specs=(P(), specs),
+            check_vma=False)(params, opt_state, grads)
+
+    return fn, (params, stacked, grads)

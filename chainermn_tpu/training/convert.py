@@ -26,13 +26,19 @@ def concat_examples(batch, padding=None):
                              'examples, not pre-collated arrays')
         return batch
     if isinstance(first, tuple):
-        cols = tuple(np.stack([np.asarray(b[i]) for b in batch])
-                     for i in range(len(first)))
+        cols = tuple(
+            np.stack([np.asarray(b[i])  # noqa: shardlint - collate
+                      for b in batch])
+            for i in range(len(first)))
     elif isinstance(first, dict):
-        cols = {k: np.stack([np.asarray(b[k]) for b in batch])
-                for k in first}
+        cols = {
+            k: np.stack([np.asarray(b[k])  # noqa: shardlint - collate
+                         for b in batch])
+            for k in first}
     else:
-        cols = (np.stack([np.asarray(b) for b in batch]),)
+        cols = (
+            np.stack([np.asarray(b)  # noqa: shardlint - collate
+                      for b in batch]),)
     if padding is None:
         return cols
     pad_to, fill = padding

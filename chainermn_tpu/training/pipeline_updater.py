@@ -91,7 +91,7 @@ def pipeline_mesh(n_stages, devices=None, n_tp=1):
     if n % (n_stages * n_tp):
         raise ValueError('%d devices not divisible into %d stages x '
                          '%d tp' % (n, n_stages, n_tp))
-    arr = np.asarray(devices, dtype=object)
+    arr = np.asarray(devices, dtype=object)  # noqa: shardlint
     if n_tp > 1:
         return Mesh(arr.reshape(n // (n_stages * n_tp), n_stages,
                                 n_tp),
@@ -603,6 +603,17 @@ class PipelineUpdater:
             arrays = tuple(arrays.values())
         data_sharding = NamedSharding(self.mesh, P(AXIS_DATA))
         return tuple(jax.device_put(a, data_sharding) for a in arrays)
+
+    def traceable_step(self, arrays, iteration=None):
+        """``(fn, args)`` of the jitted pipeline train step for
+        jaxpr-level static analysis (:mod:`chainermn_tpu.analysis`)
+        -- same contract as ``StandardUpdater.traceable_step``.  The
+        pipeline step's signature carries no iteration-dependent
+        arguments, so ``iteration`` only exists for interface
+        uniformity."""
+        del iteration
+        return self._step, (self.params, self.extra,
+                            self.opt_state) + tuple(arrays)
 
     def update_core(self, arrays):
         self.params, self.extra, self.opt_state, metrics = self._step(

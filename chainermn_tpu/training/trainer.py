@@ -67,7 +67,7 @@ class Trainer:
                     # to this step (params chain), bounding run-ahead
                     import jax
                     for v in self.observation.values():
-                        jax.device_get(v)
+                        jax.device_get(v)  # noqa: shardlint
                         break
             else:
                 self.observation = self.updater.update()

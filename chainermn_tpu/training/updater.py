@@ -318,23 +318,38 @@ class StandardUpdater:
                 % (n, self.comm.size, self._accum_steps))
         return self.comm.shard_batch(arrays)
 
+    def _step_args(self, arrays, iteration=None):
+        """The exact argument tuple one train-step call receives at
+        the given iteration (default: the next real one).  Single
+        source of truth for ``update_core``,
+        ``compiled_cost_analysis`` and ``traceable_step`` -- the
+        static analyzer must see the very signature the hot loop
+        compiles under."""
+        it = self.iteration if iteration is None else iteration
+        # stateless path reuses the cached key (the step ignores it)
+        step_rng = (jax.random.fold_in(self._rng, it)
+                    if self._has_state else self._rng)
+        args = (self.params, self.model_state, self.opt_state,
+                step_rng)
+        if self._zero:
+            args += (jnp.asarray(it == 0),)
+        return args + tuple(arrays)
+
+    def traceable_step(self, arrays, iteration=None):
+        """``(fn, args)`` of the jitted train step for jaxpr-level
+        static analysis (:mod:`chainermn_tpu.analysis`): ``fn`` is the
+        compiled-step callable (donation marks intact) and ``args``
+        the concrete argument tuple iteration ``iteration`` would
+        pass.  Tracing ``jax.make_jaxpr(fn)(*args)`` performs no
+        device computation."""
+        return self._step, self._step_args(arrays, iteration)
+
     def update_core(self, arrays):
         """Advance one iteration on already-sharded device arrays;
         returns device-resident metrics (no host sync -- steps can
         overlap)."""
-        # stateless path reuses the cached key (the step ignores it)
-        step_rng = (jax.random.fold_in(self._rng, self.iteration)
-                    if self._has_state else self._rng)
-        if self._zero:
-            needs_bcast = jnp.asarray(self.iteration == 0)
-            self.params, self.model_state, self.opt_state, metrics = \
-                self._step(self.params, self.model_state,
-                           self.opt_state, step_rng, needs_bcast,
-                           *arrays)
-        else:
-            self.params, self.model_state, self.opt_state, metrics = \
-                self._step(self.params, self.model_state,
-                           self.opt_state, step_rng, *arrays)
+        self.params, self.model_state, self.opt_state, metrics = \
+            self._step(*self._step_args(arrays))
         self.iteration += 1
         return metrics
 
@@ -356,17 +371,7 @@ class StandardUpdater:
     def compiled_cost_analysis(self, arrays):
         """XLA cost analysis (flops etc.) of the compiled train step
         for the given sharded batch."""
-        step_rng = (jax.random.fold_in(self._rng, self.iteration)
-                    if self._has_state else self._rng)
-        if self._zero:
-            # mirror update_core's signature: needs_bcast sits between
-            # step_rng and the batch arrays
-            lowered = self._step.lower(
-                self.params, self.model_state, self.opt_state, step_rng,
-                jnp.asarray(self.iteration == 0), *arrays)
-        else:
-            lowered = self._step.lower(self.params, self.model_state,
-                                       self.opt_state, step_rng, *arrays)
+        lowered = self._step.lower(*self._step_args(arrays))
         cost = lowered.compile().cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}

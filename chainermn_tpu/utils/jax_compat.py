@@ -1,0 +1,49 @@
+"""JAX API compatibility layer.
+
+The codebase is written against the modern surface (``jax.shard_map``
+with ``check_vma=...``); older installed runtimes (jax <= 0.4.x) only
+ship ``jax.experimental.shard_map.shard_map`` whose equivalent knob is
+named ``check_rep``.  :func:`ensure` installs a thin adapter at
+``jax.shard_map`` so every call site -- and the static analyzer, which
+must trace the exact production functions -- runs unchanged on either
+runtime.  On a runtime that already provides ``jax.shard_map`` this is
+a no-op.
+
+Called once from ``chainermn_tpu/__init__.py``; importing any
+``chainermn_tpu`` submodule triggers it (Python imports the parent
+package first).
+"""
+
+import functools
+
+import jax
+
+
+def _adapt_legacy_shard_map(legacy):
+    @functools.wraps(legacy)
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+                  check_rep=None, **kwargs):
+        if check_rep is None:
+            check_rep = True if check_vma is None else check_vma
+        return legacy(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_rep,
+                      **kwargs)
+    return shard_map
+
+
+def _axis_size(axis_name):
+    """``lax.axis_size`` for runtimes that predate it.  ``psum`` of
+    the literal 1 is constant-folded to the static axis size at trace
+    time (no run-time collective)."""
+    from jax import lax
+    return lax.psum(1, axis_name)
+
+
+def ensure():
+    """Install missing modern-API aliases on ``jax``.  Idempotent."""
+    if not hasattr(jax, 'shard_map'):
+        from jax.experimental.shard_map import shard_map as legacy
+        jax.shard_map = _adapt_legacy_shard_map(legacy)
+    if not hasattr(jax.lax, 'axis_size'):
+        jax.lax.axis_size = _axis_size
+    return jax

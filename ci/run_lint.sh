@@ -1,11 +1,18 @@
 #!/usr/bin/env bash
 # Lint pass (reference parity: .travis.yml:51-54).  Uses flake8 when
 # installed (config in setup.cfg); otherwise the stdlib fallback
-# enforcing the core rule set.
+# enforcing the core rule set.  Then the shardlint static-analysis
+# gate (docs/static_analysis.md): a dirty jaxpr -- wrong collective
+# axis, dead donation, recompilation leak -- fails the lint gate
+# exactly like a style violation.  SHARDLINT=0 skips it (style-only
+# iteration).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 if python -c 'import flake8' 2>/dev/null; then
     python -m flake8 .
 else
     python ci/lint_fallback.py .
+fi
+if [ "${SHARDLINT:-1}" != "0" ]; then
+    bash ci/run_staticcheck.sh
 fi

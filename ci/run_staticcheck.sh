@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# shardlint gate: jaxpr-level static analysis of every registered
+# communicator strategy plus the example/updater/zero/pipeline train
+# steps (docs/static_analysis.md).  JSON mode on stdout for log
+# scraping; exit 1 (-> lint gate failure) on any ERROR-severity
+# finding.  CPU-only by construction: tracing runs no collective.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json

@@ -1,0 +1,305 @@
+"""shardlint fixture suite (``chainermn_tpu.analysis``).
+
+One known-bad and one known-good case per analyzer rule -- each bad
+fixture SEEDS the violation and asserts the exact rule ID fires, each
+good twin asserts silence -- plus the parametrized sweep pinning that
+every registered communicator strategy lints clean (the static
+replacement for the reference's ``mpiexec -n {1,2,3}`` matrix).
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax import lax
+
+from chainermn_tpu import analysis
+from chainermn_tpu.analysis import rules as rules_mod
+from chainermn_tpu.analysis import targets as targets_mod
+from chainermn_tpu.communicators import _COMMUNICATORS
+from chainermn_tpu.communicators.naive_communicator import (
+    NaiveCommunicator)
+
+STRATEGIES = sorted(_COMMUNICATORS)
+
+
+def _comm():
+    return NaiveCommunicator(mesh_shape=(2, 4))
+
+
+def _ids(findings, severity=None):
+    return sorted({f.rule_id for f in findings
+                   if severity is None or f.severity == severity})
+
+
+def _lint_mapped(fn, args, comm=None, **kw):
+    comm = comm or _comm()
+    target = targets_mod.LintTarget(
+        'fixture', targets_mod._mapped(comm, fn), args,
+        dict(comm.mesh.shape), **kw)
+    return analysis.lint_target(target)
+
+
+# ---------------------------------------------------------------- SL000
+def test_sl000_untraceable_target_is_a_finding():
+    def boom(x):
+        raise RuntimeError('fixture trace failure')
+    fs = _lint_mapped(boom, (jnp.zeros((4,)),))
+    assert _ids(fs, 'error') == ['SL000']
+
+
+def test_sl000_good_traceable_target_is_silent():
+    fs = _lint_mapped(lambda x: x * 2.0, (jnp.zeros((4,)),))
+    assert fs == []
+
+
+# ---------------------------------------------------------------- SL001
+def test_sl001_unknown_axis_fires():
+    class BadAxis(NaiveCommunicator):
+        def _allreduce_impl(self, grads):
+            return jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, 'node'), grads)
+
+    bad = targets_mod.strategy_targets(
+        ['naive'], comm_factory=lambda n: BadAxis(mesh_shape=(2, 4)))
+    fs = analysis.lint_target(bad[0])
+    assert _ids(fs, 'error') == ['SL001']
+
+
+def test_sl001_topology_mismatch_fires():
+    class Narrow(NaiveCommunicator):
+        # declares the full (inter, intra) reduction but only reduces
+        # over intra: trains wrong across slices, compiles fine
+        def _allreduce_impl(self, grads):
+            return jax.tree_util.tree_map(
+                lambda g: lax.pmean(g, 'intra'), grads)
+
+    bad = targets_mod.strategy_targets(
+        ['naive'], comm_factory=lambda n: Narrow(mesh_shape=(2, 4)))
+    fs = analysis.lint_target(bad[0])
+    assert _ids(fs, 'error') == ['SL001']
+    assert any('reduction_axes' in f.message for f in fs)
+
+
+def test_sl001_good_declared_subset_is_silent():
+    # single_node DECLARES the intra-only topology, so the identical
+    # collective pattern that fails above lints clean here
+    fs = analysis.lint_target(
+        targets_mod.strategy_targets(['single_node'])[0])
+    assert fs == []
+
+
+# ---------------------------------------------------------------- SL002
+def test_sl002_non_bijective_ppermute_fires():
+    comm = _comm()
+    perm = [(0, 1), (2, 1), (3, 0), (1, 2), (4, 5), (5, 4), (6, 7),
+            (7, 6)]  # two sources hit rank 1
+
+    def bad(x):
+        return lax.ppermute(x, ('inter', 'intra'), perm)
+
+    fs = _lint_mapped(bad, (jnp.zeros((4,)),), comm)
+    assert _ids(fs, 'error') == ['SL002']
+
+
+def test_sl002_partial_coverage_warns():
+    def partial(x):
+        return lax.ppermute(x, ('inter', 'intra'), [(0, 1)])
+
+    fs = _lint_mapped(partial, (jnp.zeros((4,)),))
+    assert _ids(fs, 'warning') == ['SL002']
+    assert _ids(fs, 'error') == []
+
+
+def test_sl002_good_rotation_is_silent():
+    comm = _comm()
+    perm = [(i, (i + 1) % comm.size) for i in range(comm.size)]
+    fs = _lint_mapped(lambda x: comm.send_recv(x, perm),
+                      (jnp.zeros((4,)),), comm)
+    assert fs == []
+
+
+# ---------------------------------------------------------------- SL003
+def test_sl003_psum_of_psum_warns():
+    def double(x):
+        return lax.psum(lax.psum(x, 'intra'), 'intra')
+
+    fs = _lint_mapped(double, (jnp.zeros((4,)),))
+    assert _ids(fs) == ['SL003']
+
+
+def test_sl003_good_staged_reduction_is_silent():
+    # the hierarchical scatter->psum->gather staging shares no axis
+    # between chained reduces and must NOT be flagged
+    fs = analysis.lint_target(
+        targets_mod.strategy_targets(['hierarchical'])[0])
+    assert fs == []
+
+
+# ---------------------------------------------------------------- SL004
+def test_sl004_narrowed_reduction_fires():
+    def narrow(x):
+        return lax.psum(x.astype(jnp.bfloat16), 'intra').astype(
+            x.dtype)
+
+    fs = _lint_mapped(narrow, (jnp.zeros((4,), jnp.float32),))
+    assert _ids(fs, 'error') == ['SL004']
+
+
+def test_sl004_good_widening_cast_is_silent():
+    def widen(x):
+        return lax.psum(x.astype(jnp.float32), 'intra')
+
+    fs = _lint_mapped(widen, (jnp.zeros((4,), jnp.bfloat16),))
+    assert fs == []
+
+
+# ---------------------------------------------------------------- SL005
+def _jit_target(fn, args, donate):
+    with warnings.catch_warnings():
+        warnings.simplefilter('ignore')  # jit's own donation warning
+        return targets_mod.LintTarget(
+            'fixture', jax.jit(fn, donate_argnums=donate), args, {})
+
+
+def test_sl005_unconsumed_donation_fires():
+    fs = analysis.lint_target(_jit_target(
+        lambda a, b: a * 2.0,
+        (jnp.zeros((3,)), jnp.zeros((4,))), (0, 1)))
+    assert _ids(fs, 'error') == ['SL005']
+    assert any('never consumed' in f.message for f in fs)
+
+
+def test_sl005_unaliasable_donation_fires():
+    # consumed, but no output of matching shape/dtype exists
+    fs = analysis.lint_target(_jit_target(
+        lambda a: a.sum(), (jnp.zeros((8,)),), (0,)))
+    assert _ids(fs, 'error') == ['SL005']
+    assert any('matches no output' in f.message for f in fs)
+
+
+def test_sl005_good_aliased_donation_is_silent():
+    fs = analysis.lint_target(_jit_target(
+        lambda a: a + 1.0, (jnp.zeros((3,)),), (0,)))
+    assert fs == []
+
+
+# ---------------------------------------------------------------- SL006
+def test_sl006_debug_callback_fires():
+    def step(x):
+        jax.debug.print('x = {}', x)
+        return x + 1.0
+
+    fs = analysis.lint_target(targets_mod.LintTarget(
+        'fixture', jax.jit(step), (jnp.zeros((3,)),), {}))
+    assert _ids(fs, 'error') == ['SL006']
+
+
+def test_sl006_pure_callback_fires():
+    import numpy as np
+
+    def step(x):
+        return jax.pure_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct((3,), jnp.float32), x)
+
+    fs = analysis.lint_target(targets_mod.LintTarget(
+        'fixture', step, (jnp.zeros((3,)),), {}))
+    assert _ids(fs, 'error') == ['SL006']
+
+
+def test_sl006_good_callback_free_step_is_silent():
+    fs = analysis.lint_target(targets_mod.LintTarget(
+        'fixture', jax.jit(lambda x: x + 1.0), (jnp.zeros((3,)),),
+        {}))
+    assert fs == []
+
+
+# ---------------------------------------------------------------- SL007
+def test_sl007_signature_drift_fires():
+    def make_args(it):
+        # a python scalar one iteration, a strong-typed array the
+        # next: jit re-traces every step
+        aux = float(it) if it == 1 else jnp.float32(it)
+        return (jnp.zeros((3,)), aux)
+
+    fs = analysis.lint_target(targets_mod.LintTarget(
+        'fixture', lambda a, b: a + b, make_args(1), {},
+        make_args=make_args))
+    assert 'SL007' in _ids(fs, 'error')
+
+
+def test_sl007_good_stable_signature_is_silent():
+    def make_args(it):
+        return (jnp.zeros((3,)), jnp.float32(it))
+
+    fs = analysis.lint_target(targets_mod.LintTarget(
+        'fixture', lambda a, b: a + b, make_args(1), {},
+        make_args=make_args))
+    assert fs == []
+
+
+# ----------------------------------------------------- full-sweep pins
+@pytest.mark.parametrize('strategy', STRATEGIES)
+def test_all_strategies_lint_clean(strategy):
+    """Every registered strategy's full collective surface is free of
+    errors AND warnings -- the CI gate's core guarantee."""
+    for target in targets_mod.strategy_targets([strategy]):
+        findings = analysis.lint_target(target)
+        assert findings == [], (target.name, findings)
+
+
+def test_strategy_registry_is_fully_swept():
+    names = {t.name for t in targets_mod.strategy_targets()}
+    assert len(_COMMUNICATORS) == 9  # update the docs table if grown
+    for strategy in STRATEGIES:
+        for method in ('allreduce_grad', 'broadcast_data',
+                       'send_recv'):
+            assert 'strategy:%s:%s' % (strategy, method) in names
+
+
+def test_step_targets_lint_clean():
+    """The standard (mlp example), ZeRO core/full and pipeline train
+    steps lint clean, donation marks and all."""
+    for target in targets_mod.step_targets(include_resnet50=False):
+        findings = analysis.lint_target(target)
+        assert findings == [], (target.name, findings)
+
+
+@pytest.mark.slow
+def test_resnet50_step_lints_clean():
+    target = targets_mod.resnet50_step_target()
+    findings = analysis.lint_target(target)
+    assert findings == [], findings
+
+
+def test_rule_catalogue_is_complete():
+    assert sorted(rules_mod.RULES) == [
+        'SL001', 'SL002', 'SL003', 'SL004', 'SL005', 'SL006', 'SL007']
+
+
+def test_report_json_roundtrip():
+    import json
+    report = analysis.build_report(
+        targets_mod.strategy_targets(['xla']))
+    data = json.loads(report.to_json())
+    assert data['ok'] is True
+    assert data['n_targets'] == 3
+    assert data['findings'] == []
+
+
+def test_cli_json_mode(capsys):
+    import json
+    from chainermn_tpu.analysis.__main__ import main
+    rc = main(['--no-steps', '--strategy', 'xla', '--json'])
+    out = capsys.readouterr().out
+    data = json.loads(out)
+    assert rc == 0 and data['ok'] is True
+    assert data['n_targets'] == 3
+
+
+def test_cli_rules_filter_rejects_unknown():
+    from chainermn_tpu.analysis.__main__ import main
+    with pytest.raises(SystemExit):
+        main(['--rules', 'SL999'])

@@ -485,3 +485,67 @@ print('OK', jax.default_backend())
                        text=True, cwd=REPO, timeout=120, env=env)
     assert p.returncode == 0, p.stderr[-2000:]
     assert 'OK cpu' in p.stdout
+
+
+# ----------------------------------------------------------------------
+# banked-last-good lookup (the backend_unavailable degradation path)
+
+def _fake_results(tmp_path, monkeypatch, files):
+    import bench
+    res = tmp_path / 'benchmarks' / 'results'
+    res.mkdir(parents=True)
+    for name, row in files.items():
+        (res / name).write_text(
+            '[bench] log line\n' + json.dumps(row) + '\n')
+    monkeypatch.setattr(
+        bench.os.path, 'dirname',
+        lambda p, _real=bench.os.path.dirname:
+            str(tmp_path) if p == bench.os.path.abspath(bench.__file__)
+            else _real(p))
+    return bench
+
+
+def test_banked_last_good_picks_newest_trustworthy_round(
+        tmp_path, monkeypatch):
+    bench = _fake_results(tmp_path, monkeypatch, {
+        'bench_resnet50_r4.out': _rs_row(2000.0),
+        'bench_resnet50_r5.out': _rs_row(2588.0),
+        # newest round exists but is untrustworthy: error, suspect
+        # and retracted rows must all be skipped, falling back to r5
+        'bench_resnet50_r6.out': _rs_row(0.0, error='bench_timeout'),
+        'bench_resnet50_b64_r6.out': _rs_row(9999.0, suspect=True),
+        'bench_resnet50_b128_r6.out': _rs_row(14011.0, retracted=True),
+    })
+    value, tag, src = bench.banked_last_good('resnet50')
+    assert (value, tag, src) == (2588.0, 'r5', 'bench_resnet50_r5.out')
+
+
+def test_banked_last_good_none_when_nothing_trustworthy(
+        tmp_path, monkeypatch):
+    bench = _fake_results(tmp_path, monkeypatch, {
+        'bench_vgg16_r5.out': {'metric': 'vgg16_train_x', 'backend':
+                               'tpu', 'value': 0.0, 'error': 'x'},
+    })
+    assert bench.banked_last_good('vgg16') == (None, None, None)
+    # and a model with no artifacts at all
+    assert bench.banked_last_good('transformer') == (None, None, None)
+
+
+def test_banked_last_good_best_within_round(tmp_path, monkeypatch):
+    bench = _fake_results(tmp_path, monkeypatch, {
+        'bench_resnet50_r5.out': _rs_row(2588.0),
+        'bench_resnet50_b128_r5.out': _rs_row(4100.0, override=128),
+    })
+    value, tag, src = bench.banked_last_good('resnet50')
+    assert (value, tag, src) == (
+        4100.0, 'r5', 'bench_resnet50_b128_r5.out')
+
+
+def test_trustworthy_value_rejects_retracted_rows():
+    from bench import _trustworthy_value
+    assert _trustworthy_value(_rs_row(100.0)) == 100.0
+    assert _trustworthy_value(_rs_row(100.0, retracted=True)) is None
+    mlp = {'metric': 'mlp_train_images_per_sec_per_chip',
+           'backend': 'tpu', 'value': 5.0}
+    assert _trustworthy_value(mlp, 'mlp') == 5.0
+    assert _trustworthy_value(mlp) is None  # wrong model prefix

@@ -487,6 +487,8 @@ def test_device_prefetch_composes_with_zero():
     upd = training.StandardUpdater(
         it, optax.adam(1e-2), clf, params, comm, has_aux=True,
         zero=True, device_prefetch=2)
-    losses = [upd.update()['loss'] for _ in range(4)]
+    # 6 steps: the first is the broadcast-only sync, and adam needs a
+    # few real updates before the loss durably dips under its start
+    losses = [upd.update()['loss'] for _ in range(6)]
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
