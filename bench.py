@@ -61,6 +61,11 @@ Flags: ``--model NAME``, ``--quick`` (shorter scans), ``--cpu``
 analysis), ``--check`` (transformer only: pin Pallas kernels against
 the jnp oracle on-device and record ``numerics_vs_oracle_ok``),
 ``--batch N`` (per-device batch override, the MFU-chase lever),
+``--policy NAME`` (mixed-precision arm: ``bf16`` = bf16
+compute/reduce with f32 master weights via
+``chainermn_tpu.precision.Policy`` -- rows record the policy dtypes
+so the A/B pair against the default row is self-describing; see
+``docs/mixed_precision.md``),
 ``--s2d`` (resnet50 only: MXU-friendly space-to-depth stem, exact
 weight-mapped equivalent of the 7x7/2 stem -- ``models/resnet50.py``),
 ``--no-adopt`` (resnet50 only: keep the default batch-32 config even
@@ -430,8 +435,36 @@ def calibrate_matmul_roofline(quick):
 # per-model builders: return dict(updater-free scan maker, items/step,
 # analytic train flops/step, extras)
 
+def _resolve_policy(policy):
+    """``--policy`` name -> ``chainermn_tpu.precision.Policy`` (child
+    side only; the parent validates the NAME without importing jax)."""
+    if policy is None:
+        return None
+    from chainermn_tpu.precision import Policy
+    return Policy.from_string(policy)
+
+
+def _policy_row(pol, default_compute='bfloat16'):
+    """The ``policy`` descriptor every bench row carries: which dtypes
+    the measured step computed/reduced in, so an A/B pair (f32-master
+    default vs ``--policy bf16``) is self-describing in the banked
+    artifacts.  ``default_compute`` is the model's native compute
+    dtype when no policy is applied (conv zoo models are bf16-compute
+    by construction; grads still reduce at master precision)."""
+    if pol is None:
+        return {'param_dtype': 'float32',
+                'compute_dtype': default_compute,
+                'reduce_dtype': None,
+                'loss_scaling': False}
+    return {'param_dtype': str(pol.param_dtype),
+            'compute_dtype': str(pol.compute_dtype),
+            'reduce_dtype': (str(pol.reduce_dtype)
+                             if pol.reduce_dtype is not None else None),
+            'loss_scaling': pol.loss_scale is not None}
+
+
 def _classifier_setup(model, insize, batch, seed=0, comm=None,
-                      n_classes=1000):
+                      n_classes=1000, policy=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -459,7 +492,7 @@ def _classifier_setup(model, insize, batch, seed=0, comm=None,
     clf = StatefulClassifier(model)
     upd = training.StandardUpdater(
         iter([]), optimizer, clf.loss, params, comm,
-        model_state=model_state, donate=False)
+        model_state=model_state, donate=False, policy=policy)
     arrays = upd.shard_batch([(x[i], y[i]) for i in range(batch)])
     return upd, arrays
 
@@ -504,7 +537,7 @@ _CONV_MODELS = {
 
 
 def _build_conv(name, quick, on_cpu, per_dev_override=None,
-                s2d=False):
+                s2d=False, policy=None):
     import jax
 
     import chainermn_tpu.models as zoo
@@ -523,7 +556,8 @@ def _build_conv(name, quick, on_cpu, per_dev_override=None,
     model = getattr(zoo, cls_name)(
         num_classes=1000,
         **({'stem': 'space_to_depth'} if s2d else {}))
-    upd, arrays = _classifier_setup(model, insize, batch)
+    pol = _resolve_policy(policy)
+    upd, arrays = _classifier_setup(model, insize, batch, policy=pol)
     fwd = fwd_gf * 1e9 * (insize / 224.0) ** 2
     base = BASELINE_IMG_PER_SEC_PER_CHIP * (4.1 / fwd_gf) \
         * (224.0 / insize) ** 2
@@ -534,10 +568,11 @@ def _build_conv(name, quick, on_cpu, per_dev_override=None,
     return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
                 items=batch, insize=insize,
                 analytic_flops=3.0 * fwd * batch, baseline=base,
+                policy=_policy_row(pol),
                 baseline_derivation=deriv)
 
 
-def _updater_setup(loss, params, examples):
+def _updater_setup(loss, params, examples, policy=None):
     """Shared LM/MLP bench plumbing: communicator + multi-node adam +
     StandardUpdater (donate=False so scans can replay from the same
     buffers) + sharded batch -- ONE place for the updater-construction
@@ -552,11 +587,11 @@ def _updater_setup(loss, params, examples):
         optax.adam(1e-3), comm)
     upd = training.StandardUpdater(
         iter([]), optimizer, loss, params, comm, has_aux=True,
-        donate=False)
+        donate=False, policy=policy)
     return upd, upd.shard_batch(examples)
 
 
-def build_seq2seq(quick, on_cpu, per_dev_override=None):
+def build_seq2seq(quick, on_cpu, per_dev_override=None, policy=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -579,9 +614,11 @@ def build_seq2seq(quick, on_cpu, per_dev_override=None):
         jnp.zeros((1, seq_len), jnp.int32))['params']
     loss = seq2seq_loss(
         lambda p, a, b: model.apply({'params': p}, a, b))
+    pol = _resolve_policy(policy)
     upd, arrays = _updater_setup(
         loss, params,
-        [(xs[i], ys_in[i], ys_out[i]) for i in range(batch)])
+        [(xs[i], ys_in[i], ys_out[i]) for i in range(batch)],
+        policy=pol)
     # LSTM train flops/token/layer ~ 3 * 16u^2 (fwd 8u^2 MACs x2);
     # + decoder softmax 3 * 2uV per target token; enc+dec tokens
     tokens = batch * seq_len  # target tokens (the reported unit)
@@ -591,11 +628,13 @@ def build_seq2seq(quick, on_cpu, per_dev_override=None):
         flops / tokens)
     return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
                 items=tokens, analytic_flops=flops, baseline=base,
+                policy=_policy_row(pol),
                 baseline_derivation='resnet50 baseline converted to '
                 'tokens/sec via analytic flops per item')
 
 
-def build_transformer(quick, on_cpu, per_dev_override=None):
+def build_transformer(quick, on_cpu, per_dev_override=None,
+                      policy=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -620,8 +659,10 @@ def build_transformer(quick, on_cpu, per_dev_override=None):
         model.init, jax.random.PRNGKey(0),
         jnp.zeros((1, seq), jnp.int32))['params']
     loss = lm_loss(lambda p, t: model.apply({'params': p}, t))
+    pol = _resolve_policy(policy)
     upd, arrays = _updater_setup(
-        loss, params, [(toks[i], tgts[i]) for i in range(batch)])
+        loss, params, [(toks[i], tgts[i]) for i in range(batch)],
+        policy=pol)
     tokens = batch * seq
     # per token fwd: 12 d^2 per layer (qkvo + 2-layer 4d MLP) +
     # 4*seq*d attention matmuls per layer (causal halves it) + lm head
@@ -634,6 +675,7 @@ def build_transformer(quick, on_cpu, per_dev_override=None):
         flops / tokens)
     return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
                 items=tokens, analytic_flops=flops, baseline=base,
+                policy=_policy_row(pol),
                 baseline_derivation='resnet50 baseline converted to '
                 'tokens/sec via analytic flops per item',
                 check_fn=lambda: _transformer_numerics_check(
@@ -683,7 +725,7 @@ def _transformer_numerics_check(model, params, toks, tgts):
             'numerics_gnorm_rel_err': round(rel_g, 6)}
 
 
-def build_mlp(quick, on_cpu, per_dev_override=None):
+def build_mlp(quick, on_cpu, per_dev_override=None, policy=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -692,7 +734,11 @@ def build_mlp(quick, on_cpu, per_dev_override=None):
 
     per_dev = per_dev_override or 128
     batch = per_dev * jax.device_count()
-    model = MLP(n_units=1000, n_out=10)
+    pol = _resolve_policy(policy)
+    # policy-aware construction: the MLP computes in the policy's
+    # compute dtype (params stay f32 masters via the updater)
+    model = MLP(n_units=1000, n_out=10,
+                dtype=pol.compute_dtype if pol is not None else None)
     rng = np.random.RandomState(0)
     x = rng.rand(batch, 784).astype(np.float32)
     y = rng.randint(0, 10, batch).astype(np.int32)
@@ -701,12 +747,13 @@ def build_mlp(quick, on_cpu, per_dev_override=None):
         jnp.zeros((1, 784), jnp.float32))['params']
     loss = classifier_loss(lambda p, xx: model.apply({'params': p}, xx))
     upd, arrays = _updater_setup(
-        loss, params, [(x[i], y[i]) for i in range(batch)])
+        loss, params, [(x[i], y[i]) for i in range(batch)], policy=pol)
     fwd = 2.0 * (784 * 1000 + 1000 * 1000 + 1000 * 10)
     base = BASELINE_IMG_PER_SEC_PER_CHIP * 4.1e9 * 3.0 / (3.0 * fwd)
     return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
                 items=batch, analytic_flops=3.0 * fwd * batch,
                 baseline=base,
+                policy=_policy_row(pol, default_compute='float32'),
                 baseline_derivation='resnet50 baseline converted via '
                 'analytic flops per image')
 
@@ -758,12 +805,19 @@ def measure(argv):
 
     per_dev = parse_batch(argv, model_name)
     s2d = parse_s2d(argv, model_name)
-    _log('building %s%s%s' % (model_name,
-                              ' (per-device batch %d)' % per_dev
-                              if per_dev else '',
-                              ' (s2d stem)' if s2d else ''))
-    cfg = BUILDERS[model_name](quick, on_cpu, per_dev,
-                               **({'s2d': True} if s2d else {}))
+    policy_name = parse_policy(argv, model_name)
+    _log('building %s%s%s%s' % (model_name,
+                                ' (per-device batch %d)' % per_dev
+                                if per_dev else '',
+                                ' (s2d stem)' if s2d else '',
+                                ' (policy %s)' % policy_name
+                                if policy_name else ''))
+    extra_kw = {}
+    if s2d:
+        extra_kw['s2d'] = True
+    if policy_name:
+        extra_kw['policy'] = policy_name
+    cfg = BUILDERS[model_name](quick, on_cpu, per_dev, **extra_kw)
     make = cfg['make']
 
     if on_cpu:
@@ -817,6 +871,7 @@ def measure(argv):
         global_batch_items=cfg['items'],
         per_device_batch_override=per_dev,
         stem='space_to_depth' if s2d else None,
+        policy=cfg.get('policy'),
     )
     if 'insize' in cfg:
         result['insize'] = cfg['insize']
@@ -968,6 +1023,30 @@ def parse_batch(argv, model):
                   detail='--batch needs a positive integer, got %r'
                   % (raw,)), rc=1)
     return val
+
+
+# mirror of chainermn_tpu.precision.Policy.from_string's registry --
+# the PARENT process never imports jax, so the flag is validated
+# against this static table and resolved to a Policy in the child
+POLICY_NAMES = ('f32', 'float32', 'bf16', 'bfloat16', 'f16',
+                'float16')
+
+
+def parse_policy(argv, model):
+    """Extract and validate ``--policy NAME`` (mixed-precision
+    bench arm: bf16 compute/reduce with f32 masters -- the A/B lever
+    against the default row).  Called in the PARENT before the
+    backend probe, and again in the child."""
+    if '--policy' not in argv:
+        return None
+    i = argv.index('--policy')
+    raw = argv[i + 1] if i + 1 < len(argv) else None
+    if raw is None or raw.lower() not in POLICY_NAMES:
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+                  error='bad_policy',
+                  detail='--policy needs one of %s, got %r'
+                  % ('/'.join(POLICY_NAMES), raw)), rc=1)
+    return raw.lower()
 
 
 def parse_s2d(argv, model):
@@ -1196,6 +1275,7 @@ def main():
     # fail fast on flag mistakes BEFORE the backend probe
     parse_batch(argv, model)
     parse_s2d(argv, model)
+    parse_policy(argv, model)
     if '--child' in argv:
         measure([a for a in argv if a != '--child'])
         return

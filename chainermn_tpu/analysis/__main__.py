@@ -53,6 +53,13 @@ def main(argv=None):
     parser.add_argument('--no-resnet50', action='store_true',
                         help='skip the resnet50 example step (the '
                              'slowest trace)')
+    parser.add_argument('--policy', default=None,
+                        help='sweep under a mixed-precision policy '
+                             '(bf16 | f16 | f32): strategies built '
+                             'with its reduce dtype, updaters with '
+                             'the policy -- proves the clean-sweep '
+                             'guarantee holds for the narrowed '
+                             'steps too')
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -74,10 +81,19 @@ def main(argv=None):
         print('[shardlint %.1fs] %s' % (time.monotonic() - t0, name),
               file=sys.stderr, flush=True)
 
+    policy = None
+    if args.policy:
+        from chainermn_tpu.precision import Policy
+        try:
+            policy = Policy.from_string(args.policy)
+        except ValueError as e:
+            parser.error(str(e))
+
     targets = analysis.default_targets(
         strategies=args.strategy,
         include_steps=not args.no_steps,
-        include_resnet50=not args.no_resnet50)
+        include_resnet50=not args.no_resnet50,
+        policy=policy)
     report = analysis.build_report(targets, only=only,
                                    progress=progress)
 

@@ -27,6 +27,11 @@ class RuleContext:
       reduction_axes: declared reduce topology (tuple of axis names)
         for gradient-reduction targets, else None -- the
         communicator's ``reduction_axes`` introspection hook.
+      declared_dtypes: dtype names the target DECLARES reductions may
+        narrow to (the communicator's / updater's
+        ``declared_reduce_dtypes`` introspection hook -- a
+        mixed-precision policy's reduce/compute dtypes); None or
+        empty means any narrowing is a finding.
       signatures: list of abstract signatures of two synthetic
         consecutive steps (None for single-shot targets).
       trace_error: exception raised while tracing, if any.
@@ -34,11 +39,12 @@ class RuleContext:
 
     def __init__(self, target_name, jaxpr=None, mesh_axes=None,
                  reduction_axes=None, signatures=None,
-                 trace_error=None):
+                 trace_error=None, declared_dtypes=None):
         self.target_name = target_name
         self.jaxpr = jaxpr
         self.mesh_axes = dict(mesh_axes or {})
         self.reduction_axes = reduction_axes
+        self.declared_dtypes = declared_dtypes
         self.signatures = signatures
         self.trace_error = trace_error
 
@@ -174,11 +180,21 @@ def rule_redundant_collectives(ctx):
 
 # ---------------------------------------------------------------------
 # SL004: a reduction must not execute in a narrower dtype than its
-# input (e.g. bf16 psum of f32 gradients loses mantissa on the wire).
+# input (e.g. bf16 psum of f32 gradients loses mantissa on the wire)
+# -- UNLESS the narrowed dtype is one the target DECLARES (a
+# mixed-precision policy's reduce/compute dtype, or a communicator
+# constructed with reduce_dtype): then the narrowing is the policy
+# working as specified, not an accidental precision loss.
 def rule_reduction_dtype(ctx):
     out = []
     if ctx.jaxpr is None:
         return out
+    allowed = set()
+    for d in (ctx.declared_dtypes or ()):
+        try:
+            allowed.add(np.dtype(d).name)
+        except TypeError:
+            continue
     for jx, _path in walker.iter_jaxprs(ctx.jaxpr):
         producers = walker.producer_map(jx)
         for eqn in jx.eqns:
@@ -197,12 +213,17 @@ def rule_reduction_dtype(ctx):
                               > np.dtype(dst.dtype).itemsize)
                 except TypeError:
                     continue
+                if narrow and np.dtype(dst.dtype).name in allowed:
+                    continue
                 if narrow:
                     out.append(ctx.finding(
                         'SL004', SEV_ERROR,
                         '%s executes in %s on a value narrowed from '
                         '%s immediately before the collective: the '
-                        'reduction loses precision on the wire'
+                        'reduction loses precision on the wire '
+                        '(declare an intentional reduce dtype via the '
+                        "strategy's reduce_dtype or the updater's "
+                        'policy)'
                         % (eqn.primitive.name, dst.dtype, src.dtype),
                         eqn))
     return out

@@ -30,8 +30,9 @@ def lint_target(target, only=None):
             sig_err = e
     ctx = rules_mod.RuleContext(
         target.name, jaxpr=jaxpr, mesh_axes=target.mesh_axes,
-        reduction_axes=target.reduction_axes, signatures=signatures,
-        trace_error=err)
+        reduction_axes=target.reduction_axes,
+        declared_dtypes=getattr(target, 'declared_dtypes', None),
+        signatures=signatures, trace_error=err)
     findings = rules_mod.run_rules(ctx, only=only)
     # a trace failure no rule claimed (SL001 claims unbound-axis
     # aborts) is itself a lint error: the production step cannot

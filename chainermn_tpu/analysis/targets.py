@@ -25,18 +25,23 @@ class LintTarget:
       mesh_axes: ``{axis_name: size}``.
       reduction_axes: declared reduce topology for gradient-reduction
         targets (the communicator's introspection hook), else None.
+      declared_dtypes: dtype names the target declares reductions may
+        narrow to (the ``declared_reduce_dtypes`` introspection hook
+        on communicators/updaters; SL004 allows these), else None.
       make_args: ``make_args(iteration) -> args`` for targets with an
         iteration-dependent signature (recompilation rule); None
         disables that rule.
     """
 
     def __init__(self, name, fn, args, mesh_axes, reduction_axes=None,
-                 make_args=None):
+                 make_args=None, declared_dtypes=None):
         self.name = name
         self.fn = fn
         self.args = tuple(args)
         self.mesh_axes = dict(mesh_axes)
         self.reduction_axes = reduction_axes
+        self.declared_dtypes = (tuple(sorted(declared_dtypes))
+                                if declared_dtypes else None)
         self.make_args = make_args
 
     def __repr__(self):
@@ -67,11 +72,13 @@ def _synthetic_grads():
             'b': jnp.zeros((5,), jnp.float32)}
 
 
-def strategy_targets(names=None, comm_factory=None):
+def strategy_targets(names=None, comm_factory=None, reduce_dtype=None):
     """Lint targets for each registered strategy (default: all 9).
 
     ``comm_factory(name) -> communicator`` overrides construction --
     the fixture tests inject known-bad strategies through it.
+    ``reduce_dtype`` constructs each strategy with that gradient
+    reduce dtype (the bf16-policy sweep of ``ci/run_staticcheck.sh``).
     """
     from chainermn_tpu import communicators
 
@@ -84,13 +91,17 @@ def strategy_targets(names=None, comm_factory=None):
             comm = comm_factory(name)
         else:
             comm = communicators.create_communicator(
-                name, mesh_shape=_strategy_mesh_shape(name, n))
+                name, mesh_shape=_strategy_mesh_shape(name, n),
+                reduce_dtype=reduce_dtype)
         mesh_axes = dict(comm.mesh.shape)
         grads = _synthetic_grads()
+        declared = getattr(comm, 'declared_reduce_dtypes',
+                           lambda: None)()
         out.append(LintTarget(
             'strategy:%s:allreduce_grad' % name,
             _mapped(comm, comm.allreduce_grad), (grads,), mesh_axes,
-            reduction_axes=tuple(comm.reduction_axes)))
+            reduction_axes=tuple(comm.reduction_axes),
+            declared_dtypes=declared))
         out.append(LintTarget(
             'strategy:%s:broadcast_data' % name,
             _mapped(comm, comm.broadcast_data), (grads,), mesh_axes))
@@ -117,15 +128,26 @@ def _data_comm():
 
 def _updater_target(name, updater, batch, mesh_axes):
     fn, args = updater.traceable_step(batch, iteration=1)
+    declared = getattr(updater, 'declared_reduce_dtypes',
+                       lambda: None)()
     return LintTarget(
-        name, fn, args, mesh_axes,
+        name, fn, args, mesh_axes, declared_dtypes=declared,
         make_args=lambda it: updater.traceable_step(
             batch, iteration=it)[1])
 
 
-def mlp_step_target(comm=None):
+def _policy_batch(policy, batch):
+    """The batch dtypes the updater's host-side cast would ship."""
+    if policy is None:
+        return batch
+    from chainermn_tpu.precision import cast_floating
+    return tuple(cast_floating(list(batch), policy.compute_dtype))
+
+
+def mlp_step_target(comm=None, policy=None):
     """The mnist example's train step (``examples/mnist``): MLP +
-    multi-node optimizer + donation, standard updater."""
+    multi-node optimizer + donation, standard updater.  ``policy``
+    lints the mixed-precision variant of the same step."""
     import optax
     import chainermn_tpu
     from chainermn_tpu import training
@@ -139,14 +161,16 @@ def mlp_step_target(comm=None):
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.adam(1e-3), comm)
     updater = training.StandardUpdater(
-        iter([]), optimizer, clf, params, comm, has_aux=True)
-    batch = (jnp.zeros((16, 784), jnp.float32),
-             jnp.zeros((16,), jnp.int32))
+        iter([]), optimizer, clf, params, comm, has_aux=True,
+        policy=policy)
+    batch = _policy_batch(policy, (
+        jnp.zeros((16, 784), jnp.float32),
+        jnp.zeros((16,), jnp.int32)))
     return _updater_target('step:mlp_example', updater, batch,
                            dict(comm.mesh.shape))
 
 
-def zero_step_target(comm=None):
+def zero_step_target(comm=None, policy=None):
     """The full ZeRO-1 train step (``StandardUpdater(zero=True)``)."""
     import optax
     from chainermn_tpu import training
@@ -159,9 +183,10 @@ def zero_step_target(comm=None):
     clf = Classifier(model.apply)
     updater = training.StandardUpdater(
         iter([]), optax.adam(1e-3), clf, params, comm, has_aux=True,
-        zero=True)
-    batch = (jnp.zeros((16, 784), jnp.float32),
-             jnp.zeros((16,), jnp.int32))
+        zero=True, policy=policy)
+    batch = _policy_batch(policy, (
+        jnp.zeros((16, 784), jnp.float32),
+        jnp.zeros((16,), jnp.int32)))
     return _updater_target('step:zero', updater, batch,
                            dict(comm.mesh.shape))
 
@@ -180,7 +205,7 @@ def zero_core_target(comm=None):
                       dict(comm.mesh.shape))
 
 
-def pipeline_step_target():
+def pipeline_step_target(policy=None):
     """The pipeline updater's gpipe train step on a (data, stage)
     mesh."""
     import optax
@@ -202,15 +227,16 @@ def pipeline_step_target():
         'b': jnp.zeros((2, d), jnp.float32)}
     updater = PipelineUpdater(
         iter([]), optax.sgd(1e-2), stage_fn, loss_on_last,
-        params_stacked, mesh, n_micro=2)
+        params_stacked, mesh, n_micro=2, policy=policy)
     n_data = mesh.shape['data']
-    batch = (jnp.zeros((4 * n_data, d), jnp.float32),
-             jnp.zeros((4 * n_data, d), jnp.float32))
+    batch = _policy_batch(policy, (
+        jnp.zeros((4 * n_data, d), jnp.float32),
+        jnp.zeros((4 * n_data, d), jnp.float32)))
     return _updater_target('step:pipeline', updater, batch,
                            dict(mesh.shape))
 
 
-def resnet50_step_target(comm=None, insize=32, batch=8):
+def resnet50_step_target(comm=None, insize=32, batch=8, policy=None):
     """The imagenet example's train step (``examples/imagenet``):
     ResNet-50 with BatchNorm state, dropout RNG plumbing and
     cross-replica statistics sync."""
@@ -233,24 +259,33 @@ def resnet50_step_target(comm=None, insize=32, batch=8):
         optax.sgd(0.1, momentum=0.9), comm)
     updater = training.StandardUpdater(
         iter([]), optimizer, clf.loss, params, comm,
-        model_state=model_state)
-    arrays = (jnp.zeros((batch, insize, insize, 3), jnp.float32),
-              jnp.zeros((batch,), jnp.int32))
+        model_state=model_state, policy=policy)
+    arrays = _policy_batch(policy, (
+        jnp.zeros((batch, insize, insize, 3), jnp.float32),
+        jnp.zeros((batch,), jnp.int32)))
     return _updater_target('step:resnet50_example', updater, arrays,
                            dict(comm.mesh.shape))
 
 
-def step_targets(include_resnet50=True):
-    out = [mlp_step_target(), zero_core_target(), zero_step_target(),
-           pipeline_step_target()]
+def step_targets(include_resnet50=True, policy=None):
+    out = [mlp_step_target(policy=policy), zero_core_target(),
+           zero_step_target(policy=policy),
+           pipeline_step_target(policy=policy)]
     if include_resnet50:
-        out.append(resnet50_step_target())
+        out.append(resnet50_step_target(policy=policy))
     return out
 
 
 def default_targets(strategies=None, include_steps=True,
-                    include_resnet50=True):
-    out = strategy_targets(strategies)
+                    include_resnet50=True, policy=None):
+    """``policy`` sweeps every target under a mixed-precision policy:
+    strategies constructed with its reduce dtype, updaters with the
+    policy itself -- the second pass of ``ci/run_staticcheck.sh``."""
+    out = strategy_targets(
+        strategies,
+        reduce_dtype=policy.reduce_dtype if policy is not None
+        else None)
     if include_steps:
-        out.extend(step_targets(include_resnet50=include_resnet50))
+        out.extend(step_targets(include_resnet50=include_resnet50,
+                                policy=policy))
     return out

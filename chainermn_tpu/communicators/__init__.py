@@ -64,7 +64,10 @@ def create_communicator(communicator_name='xla', mesh=None, mesh_shape=None,
     ``mesh_shape``/``devices`` replace the ``mpi_comm`` argument (the
     default -- discover all global devices -- replaces
     ``MPI.COMM_WORLD``).  Extra keyword arguments pass through to the
-    strategy (e.g. ``bucket_mb`` for ``'bucketed'``).
+    strategy (e.g. ``bucket_mb`` for ``'bucketed'``, or
+    ``reduce_dtype='bfloat16'`` -- accepted by EVERY strategy -- to
+    run gradient reductions in a narrower dtype; see
+    ``CommunicatorBase.__init__`` and ``docs/mixed_precision.md``).
     """
     try:
         cls = _COMMUNICATORS[communicator_name]

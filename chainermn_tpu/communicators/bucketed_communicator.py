@@ -33,8 +33,9 @@ from chainermn_tpu.communicators.mesh_utility import AXES
 class BucketedCommunicator(CommunicatorBase):
 
     def __init__(self, mesh=None, mesh_shape=None, devices=None,
-                 bucket_mb=25.0):
-        super().__init__(mesh, mesh_shape, devices)
+                 bucket_mb=25.0, reduce_dtype=None):
+        super().__init__(mesh, mesh_shape, devices,
+                         reduce_dtype=reduce_dtype)
         if bucket_mb <= 0:
             raise ValueError('bucket_mb must be positive')
         self.bucket_bytes = int(bucket_mb * 1e6)

@@ -17,8 +17,10 @@ class SingleNodeCommunicator(CommunicatorBase):
 
     reduction_axes = (AXIS_INTRA,)
 
-    def __init__(self, mesh=None, mesh_shape=None, devices=None):
-        super().__init__(mesh, mesh_shape, devices)
+    def __init__(self, mesh=None, mesh_shape=None, devices=None,
+                 reduce_dtype=None):
+        super().__init__(mesh, mesh_shape, devices,
+                         reduce_dtype=reduce_dtype)
         if self.inter_size != 1:
             raise ValueError(
                 'SingleNodeCommunicator requires inter_size == 1 '

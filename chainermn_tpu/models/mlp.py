@@ -2,16 +2,28 @@
 ``examples/mnist/train_mnist.py:20-31``: 784 -> units -> units -> 10
 with ReLU)."""
 
+from functools import partial
+from typing import Any
+
 import flax.linen as nn
+import jax.numpy as jnp
 
 
 class MLP(nn.Module):
+    """``dtype`` is the COMPUTE dtype (policy-aware construction:
+    pass ``policy.compute_dtype``); parameters are always initialized
+    in float32 so the updater's master weights start wide regardless
+    of the compute precision.  ``None`` computes at input/param
+    promotion (full precision for f32 inputs)."""
     n_units: int = 100
     n_out: int = 10
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, x):
+        dense = partial(nn.Dense, dtype=self.dtype,
+                        param_dtype=jnp.float32)
         x = x.reshape((x.shape[0], -1))
-        x = nn.relu(nn.Dense(self.n_units)(x))
-        x = nn.relu(nn.Dense(self.n_units)(x))
-        return nn.Dense(self.n_out)(x)
+        x = nn.relu(dense(self.n_units)(x))
+        x = nn.relu(dense(self.n_units)(x))
+        return dense(self.n_out)(x)

@@ -4,14 +4,35 @@ in the reference examples, e.g. ``train_mnist.py:99``)."""
 import numpy as np
 
 
-def concat_examples(batch, padding=None):
+def _cast_cols(cols, dtype):
+    """Cast floating columns to ``dtype`` on the HOST (integer labels
+    untouched): a batch shipped at the step's compute dtype halves the
+    host->device bytes a downstream downcast would otherwise waste."""
+    if dtype is None:
+        return cols
+    dt = np.dtype(dtype)
+
+    def cast(a):
+        if np.issubdtype(a.dtype, np.floating) and a.dtype != dt:
+            return a.astype(dt)
+        return a
+
+    if isinstance(cols, dict):
+        return {k: cast(v) for k, v in cols.items()}
+    return tuple(cast(c) for c in cols)
+
+
+def concat_examples(batch, padding=None, dtype=None):
     """Stack a list of examples into batched arrays.
 
     Examples may be tuples (``(x, y)`` -> ``(X, Y)``), dicts, or bare
     arrays.  With ``padding=(pad_to, fill)`` the leading dimension is
     padded to ``pad_to`` (for static-shape jit steps on final partial
     batches) and a float32 validity ``mask`` of shape ``(pad_to,)`` is
-    appended to the result tuple.
+    appended to the result tuple.  ``dtype`` casts floating columns to
+    a target dtype host-side (a mixed-precision policy's compute
+    dtype; the validity mask stays float32 -- metric averages are kept
+    in f32).
     """
     if len(batch) == 0:
         raise ValueError('batch is empty')
@@ -24,7 +45,7 @@ def concat_examples(batch, padding=None):
         if padding is not None:
             raise ValueError('padding is only supported for lists of '
                              'examples, not pre-collated arrays')
-        return batch
+        return _cast_cols(batch, dtype)
     if isinstance(first, tuple):
         cols = tuple(
             np.stack([np.asarray(b[i])  # noqa: shardlint - collate
@@ -39,6 +60,7 @@ def concat_examples(batch, padding=None):
         cols = (
             np.stack([np.asarray(b)  # noqa: shardlint - collate
                       for b in batch]),)
+    cols = _cast_cols(cols, dtype)
     if padding is None:
         return cols
     pad_to, fill = padding

@@ -187,9 +187,34 @@ class PipelineUpdater:
                  params_stacked, mesh, n_micro, remat=False,
                  donate=True, schedule='gpipe', schedule_check=True,
                  prologue=None, extra_params=None, param_specs=None,
-                 opt_state_specs=None):
+                 opt_state_specs=None, policy=None):
+        """``policy`` (a :class:`chainermn_tpu.precision.Policy`):
+        mixed-precision training with f32 master weights, same
+        contract as ``StandardUpdater(policy=...)``.  Stage (and
+        extra) parameters are stored in ``param_dtype`` and cast to
+        ``compute_dtype`` inside the differentiated stage/loss/
+        prologue bodies, so gradient cotangents upcast to the master
+        dtype at the cast boundary; batches are cast host-side in
+        :meth:`shard_batch`; loss and metrics are pinned to f32
+        before their cross-stage psums.  ``reduce_dtype`` narrows the
+        1f1b schedule's explicit data-axis gradient pmean
+        (cast-before, upcast-after); the gpipe schedule's data-axis
+        reduction lives inside the shard_map transpose and runs at
+        the master dtype -- the boundary cast upcasts cotangents
+        before they cross devices.  Loss-scaled policies
+        (``Policy.f16()``) are not supported here: bf16 -- the
+        TPU-native compute dtype -- needs no scaling, and the
+        schedule's per-stage backward has no single point to apply
+        the skip-on-nonfinite contract; use ``Policy.bf16()``.
+        """
         if schedule not in ('gpipe', '1f1b'):
             raise ValueError("schedule must be 'gpipe' or '1f1b'")
+        if policy is not None and policy.loss_scale is not None:
+            raise ValueError(
+                'PipelineUpdater does not support loss-scaled '
+                'policies (use Policy.bf16(), whose f32-range '
+                'exponent needs no scaling, or StandardUpdater for '
+                'f16 with dynamic loss scaling)')
         if param_specs is not None:
             if schedule == '1f1b':
                 raise ValueError(
@@ -258,6 +283,16 @@ class PipelineUpdater:
         self.n_micro = n_micro
         self.n_stages = mesh.shape[AXIS_STAGE]
         self.iteration = 0
+        self._policy = policy
+        if policy is not None:
+            from chainermn_tpu.precision import cast_floating
+            # master weights live in param_dtype (f32); compute-dtype
+            # copies exist only inside the step
+            params_stacked = cast_floating(params_stacked,
+                                           policy.param_dtype)
+            if extra_params is not None:
+                extra_params = cast_floating(extra_params,
+                                             policy.param_dtype)
 
         p_specs = (param_specs if param_specs is not None
                    else jax.tree_util.tree_map(
@@ -392,8 +427,18 @@ class PipelineUpdater:
         # how ``tests/test_parallel.py::test_pipeline_backward`` pins
         # the schedule's reverse pairing.
 
+        policy = self._policy
+
         def device_loss(params, extra, x, y):
             p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+            if policy is not None:
+                # compute-dtype cast INSIDE the differentiated
+                # function: the transpose upcasts cotangents back to
+                # the master dtype before they cross the shard_map
+                # boundary (where the data-axis psum happens)
+                p_local = policy.cast_to_compute(p_local)
+                extra = policy.cast_to_compute(extra)
+                x = policy.cast_to_compute(x)
             acts = prologue(extra, x) if prologue is not None else x
             outs = pipe(p_local, microbatch(acts, n_micro_))
             stage = lax.axis_index(AXIS_STAGE)
@@ -414,6 +459,12 @@ class PipelineUpdater:
                 loss, metrics = loss_on_last(extra, outs_safe, y_micro)
             else:
                 loss, metrics = loss_on_last(outs_safe, y_micro)
+            if policy is not None:
+                # metric averages stay f32 regardless of the compute
+                # dtype (and their cross-stage psums run widened)
+                loss = loss.astype(jnp.float32)
+                metrics = jax.tree_util.tree_map(
+                    lambda m: m.astype(jnp.float32), metrics)
             # garbage on non-last stages is masked with where, NOT
             # multiplication: the garbage loss can be inf/NaN (loss_fn
             # on raw activations) and inf * 0 = NaN would poison the
@@ -460,6 +511,32 @@ class PipelineUpdater:
         # runs on each stage's complete local tree in the same program.
         stage_spec = P(AXIS_STAGE)
 
+        def _pmean_data(g_tree):
+            """Data-axis gradient mean, narrowed to the policy's
+            reduce dtype on the wire (cast-before, upcast-after) --
+            the 1f1b twin of the communicator reduce-dtype plumbing."""
+            rd = policy.reduce_dtype if policy is not None else None
+            if rd is None:
+                return lax.pmean(g_tree, AXIS_DATA)
+            narrowed = jax.tree_util.tree_map(
+                lambda g: g.astype(rd), g_tree)
+            return jax.tree_util.tree_map(
+                lambda r, g: r.astype(g.dtype),
+                lax.pmean(narrowed, AXIS_DATA), g_tree)
+
+        def _reduce_extra(g_tree):
+            """Stage-psum + data-mean of the extra-params gradients,
+            narrowed like :func:`_pmean_data`."""
+            rd = policy.reduce_dtype if policy is not None else None
+            if rd is None:
+                return lax.pmean(lax.psum(g_tree, AXIS_STAGE),
+                                 AXIS_DATA)
+            narrowed = jax.tree_util.tree_map(
+                lambda g: g.astype(rd), g_tree)
+            red = lax.pmean(lax.psum(narrowed, AXIS_STAGE), AXIS_DATA)
+            return jax.tree_util.tree_map(
+                lambda r, g: r.astype(g.dtype), red, g_tree)
+
         def device_step_1f1b(params, extra, opt_state, x, y):
             p_local = jax.tree_util.tree_map(lambda a: a[0], params)
             # squeeze only the stage-stacked optimizer leaves; scalar
@@ -468,28 +545,41 @@ class PipelineUpdater:
                 lambda a, sp: a[0] if sp == stage_spec else a,
                 opt_state, opt_specs)
 
+            if policy is None:
+                stage_body = stage_fn
+                cast = lambda t: t  # noqa: E731
+            else:
+                # casts INSIDE the vjp'd bodies: masters stay f32 and
+                # the cast transpose upcasts every gradient for free
+                cast = policy.cast_to_compute
+
+                def stage_body(p, a):
+                    return stage_fn(cast(p), a)
+
+                x = cast(x)
+
             if extra_used:
                 y_m = microbatch(y, n_micro_)
 
                 def per_micro_loss(e, yy, ym):
-                    return loss_on_last(e, yy[None], ym[None])
+                    return loss_on_last(cast(e), yy[None], ym[None])
 
                 if prologue is not None:
                     # ONE prologue forward: jax.vjp's primal IS the
                     # activation stack fed to the pipeline (no
                     # reliance on CSE to dedupe a second trace)
                     acts_m, vjp_pro = jax.vjp(
-                        lambda e: microbatch(prologue(e, x),
+                        lambda e: microbatch(prologue(cast(e), x),
                                              n_micro_), extra)
                 else:
                     acts_m = microbatch(x, n_micro_)
                 _assert_1f1b_safe(
                     lambda e, yy, ym: per_micro_loss(e, yy, ym)[0],
-                    (extra, acts_m[0], y_m[0]), stage_fn, p_local,
+                    (extra, acts_m[0], y_m[0]), stage_body, p_local,
                     acts_m[0], prologue=prologue, extra=extra, x=x)
                 loss, metrics, grads, g_extra, dx_buf = \
                     pipeline_1f1b_grads(
-                        stage_fn, per_micro_loss, p_local,
+                        stage_body, per_micro_loss, p_local,
                         acts_m, y_m, n_stages, axis=AXIS_STAGE,
                         extra=extra,
                         collect_input_cotangents=prologue is not None)
@@ -503,9 +593,8 @@ class PipelineUpdater:
                 # head grads live on the last stage, prologue grads
                 # on stage 0, zeros elsewhere: psum over stage sums
                 # the disjoint contributions, pmean over data averages
-                g_extra = lax.pmean(
-                    lax.psum(g_extra, AXIS_STAGE), AXIS_DATA)
-                grads = lax.pmean(grads, AXIS_DATA)
+                g_extra = _reduce_extra(g_extra)
+                grads = _pmean_data(grads)
                 tree = {'stages': p_local, 'extra': extra}
                 gtree = {'stages': grads, 'extra': g_extra}
             else:
@@ -516,12 +605,17 @@ class PipelineUpdater:
                 y_m = microbatch(y, n_micro_)
                 _assert_1f1b_safe(
                     lambda yy, ym: per_micro_loss(yy, ym)[0],
-                    (x_m[0], y_m[0]), stage_fn, p_local, x_m[0])
+                    (x_m[0], y_m[0]), stage_body, p_local, x_m[0])
                 loss, metrics, grads = pipeline_1f1b_grads(
-                    stage_fn, per_micro_loss, p_local, x_m, y_m,
+                    stage_body, per_micro_loss, p_local, x_m, y_m,
                     n_stages, axis=AXIS_STAGE)
-                grads = lax.pmean(grads, AXIS_DATA)
+                grads = _pmean_data(grads)
                 tree, gtree = p_local, grads
+            if policy is not None:
+                # metric averages stay f32 (same pin as device_loss)
+                loss = loss.astype(jnp.float32)
+                metrics = jax.tree_util.tree_map(
+                    lambda m: m.astype(jnp.float32), metrics)
 
             # mesh-aware transforms (zero.clip_by_global_norm) finish
             # their statistic across stages: stage leaves are disjoint
@@ -597,8 +691,11 @@ class PipelineUpdater:
         """Collate and place a batch sharded over the data axis.
         Dict examples flatten in INSERTION order -- the positional
         (x, y) contract of the train step follows that order (same
-        convention as ``StandardUpdater.shard_batch``)."""
-        arrays = concat_examples(batch)
+        convention as ``StandardUpdater.shard_batch``, including the
+        host-side compute-dtype cast under a policy)."""
+        arrays = concat_examples(
+            batch, dtype=(self._policy.compute_dtype
+                          if self._policy is not None else None))
         if isinstance(arrays, dict):
             arrays = tuple(arrays.values())
         data_sharding = NamedSharding(self.mesh, P(AXIS_DATA))
@@ -639,6 +736,14 @@ class PipelineUpdater:
         loss, metrics = self._eval(self.params, self.extra, *arrays)
         return {k: float(v) for k, v in
                 dict(metrics, loss=loss).items()}
+
+    def declared_reduce_dtypes(self):
+        """Dtype names reductions in this updater's compiled step may
+        legitimately narrow to (the shardlint SL004 introspection
+        hook, mirroring ``StandardUpdater``)."""
+        if self._policy is None:
+            return set()
+        return set(self._policy.declared_dtypes())
 
     @property
     def epoch(self):

@@ -35,7 +35,8 @@ class StandardUpdater:
     def __init__(self, iterator, optimizer, loss_fn, params, comm,
                  has_aux=False, donate=True, model_state=None, rng=None,
                  zero=False, accum_steps=1, zero_check=True,
-                 zero_reduce_dtype=None, device_prefetch=0):
+                 zero_reduce_dtype=None, device_prefetch=0,
+                 policy=None):
         """``model_state``: optional non-trainable collections (e.g.
         BatchNorm running stats).  When given, ``loss_fn`` must have
         the extended signature
@@ -88,6 +89,26 @@ class StandardUpdater:
         overlap the running step instead of serializing between
         steps (pair with ``update(sync=False)`` /
         ``Trainer(async_metrics=True)`` for a gap-free device).
+
+        ``policy`` (a :class:`chainermn_tpu.precision.Policy`, e.g.
+        ``Policy.bf16()``): mixed-precision training with master
+        weights.  Params are STORED in ``param_dtype`` (f32) and cast
+        to ``compute_dtype`` INSIDE the differentiated loss, so the
+        forward and backward run narrow while gradient cotangents
+        upcast to the master dtype at the cast boundary for the f32
+        optimizer update.  The policy's ``reduce_dtype`` is imposed on
+        the communicator's ``allreduce_grad`` (or on the ZeRO
+        reduce-scatter, subsuming ``zero_reduce_dtype``), batches are
+        cast to compute dtype on the HOST in :meth:`shard_batch`
+        (halved H2D traffic; the prefetch iterator inherits this), and
+        BatchNorm statistics plus metric averages are pinned to f32.
+        A policy with a ``loss_scale`` (``Policy.f16()``) scales the
+        loss before the backward pass, unscales gradients before the
+        optimizer, SKIPS the update when any device's unscaled
+        gradients are non-finite (verdict made replica-uniform with a
+        pmin, so no device can diverge), and adjusts the scale --
+        metrics then carry ``loss_scale`` and ``grads_finite``.
+        See ``docs/mixed_precision.md``.
         """
         self.iterator = iterator
         self.optimizer = optimizer
@@ -106,6 +127,25 @@ class StandardUpdater:
         if accum_steps < 1:
             raise ValueError('accum_steps must be >= 1')
         self._accum_steps = accum_steps
+        self._policy = policy
+        self._loss_scale = (policy.loss_scale
+                            if policy is not None else None)
+        if policy is not None:
+            if zero_reduce_dtype is not None:
+                raise ValueError(
+                    'zero_reduce_dtype is subsumed by the policy: set '
+                    'Policy(reduce_dtype=...) instead of passing both')
+            from chainermn_tpu.precision import cast_floating
+            # master weights live in param_dtype (f32); compute-dtype
+            # copies exist only inside the step
+            params = cast_floating(params, policy.param_dtype)
+            if (policy.reduce_dtype is not None and not zero
+                    and getattr(comm, 'reduce_dtype', None) is None):
+                # impose the policy's reduce dtype on the strategy's
+                # allreduce_grad (an explicitly-constructed
+                # communicator reduce_dtype wins); the ZeRO path
+                # narrows its own reduce-scatter instead
+                comm.reduce_dtype = policy.reduce_dtype
         from chainermn_tpu.training.placement import owned_device_put
 
         # replicate + donation-aliasing guard in one placement: copies
@@ -143,6 +183,8 @@ class StandardUpdater:
                                               protect=params)
         self.iteration = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self.scale_state = (comm.replicate(self._loss_scale.init())
+                            if self._loss_scale is not None else None)
         self._step = self._build_step(donate)
         self._device_prefetch = bool(device_prefetch)
         if device_prefetch:
@@ -157,41 +199,70 @@ class StandardUpdater:
         loss_fn = self.loss_fn
         has_aux = self._has_aux
 
+        from chainermn_tpu import precision as precision_mod
         from chainermn_tpu.communicators.mesh_utility import AXES
         has_state = self._has_state
         is_zero = self._zero
+        policy = self._policy
+        loss_scale = self._loss_scale
         reduce_dtype = self._zero_reduce_dtype
+        if policy is not None and policy.reduce_dtype is not None:
+            # the policy subsumes zero_reduce_dtype (enforced in
+            # __init__); the non-zero path narrows inside the
+            # communicator's allreduce_grad instead
+            reduce_dtype = policy.reduce_dtype
         axes = AXES
 
         accum = self._accum_steps
 
-        def grads_and_metrics_once(params, model_state, rng, *batch):
+        def grads_and_metrics_once(params, model_state, rng, scale,
+                                   *batch):
+            # ``scale`` (loss-scale scalar or None) multiplies the
+            # DIFFERENTIATED output only; the reported loss rides the
+            # aux dict unscaled.  The policy's compute-dtype cast sits
+            # inside the differentiated function, so the
+            # convert_element_type transpose upcasts gradient
+            # cotangents back to the master dtype for free.
             if has_state:
                 dev_rng = jax.random.fold_in(rng, comm.axis_rank())
 
                 def wrapped(p):
+                    if policy is not None:
+                        p = policy.cast_to_compute(p)
                     loss, (metrics, new_state) = loss_fn(
                         p, model_state, dev_rng, *batch)
-                    return loss, (metrics, new_state)
-                (loss, (metrics, new_state)), grads = jax.value_and_grad(
+                    sloss = (loss * scale.astype(loss.dtype)
+                             if scale is not None else loss)
+                    return sloss, (dict(metrics, loss=loss), new_state)
+                (_, (metrics, new_state)), grads = jax.value_and_grad(
                     wrapped, has_aux=True)(params)
+                if policy is not None:
+                    # BatchNorm statistics stay in the master state
+                    # dtype (f32): a compute-dtype model must not
+                    # narrow the running stats it emits
+                    new_state = jax.tree_util.tree_map(
+                        lambda n, o: n.astype(jnp.result_type(o)),
+                        new_state, model_state)
                 # cross-replica sync of running statistics
                 new_state = comm.allreduce(new_state, op='mean')
             else:
-                out = jax.value_and_grad(loss_fn, has_aux=has_aux)(
-                    params, *batch)
-                if has_aux:
-                    (loss, metrics), grads = out
-                else:
-                    loss, grads = out
-                    metrics = {}
+                def wrapped(p):
+                    if policy is not None:
+                        p = policy.cast_to_compute(p)
+                    out = loss_fn(p, *batch)
+                    loss, metrics = out if has_aux else (out, {})
+                    sloss = (loss * scale.astype(loss.dtype)
+                             if scale is not None else loss)
+                    return sloss, dict(metrics, loss=loss)
+                (_, metrics), grads = jax.value_and_grad(
+                    wrapped, has_aux=True)(params)
                 new_state = model_state
-            return grads, dict(metrics, loss=loss), new_state
+            return grads, metrics, new_state
 
-        def grads_and_metrics(params, model_state, rng, *batch):
+        def grads_and_metrics(params, model_state, rng, scale, *batch):
             if accum == 1:
                 return grads_and_metrics_once(params, model_state, rng,
-                                              *batch)
+                                              scale, *batch)
 
             # micro-batch scan: (B, ...) -> (accum, B/accum, ...);
             # grads/metrics averaged, model_state threaded through
@@ -202,7 +273,7 @@ class StandardUpdater:
             def body(carry, mb):
                 state_c, rng_c = carry
                 g, m, new_state = grads_and_metrics_once(
-                    params, state_c, rng_c, *mb)
+                    params, state_c, rng_c, scale, *mb)
                 rng_c = (jax.random.fold_in(rng_c, 1)
                          if has_state else rng_c)
                 return (new_state, rng_c), (g, m)
@@ -215,21 +286,73 @@ class StandardUpdater:
                 lambda m: jnp.mean(m, axis=0), ms)
             return grads, metrics, new_state
 
-        def step(params, model_state, opt_state, rng, *batch):
-            grads, metrics, new_state = grads_and_metrics(
-                params, model_state, rng, *batch)
-            updates, opt_state = optimizer.update(grads, opt_state,
-                                                  params)
-            params = optax.apply_updates(params, updates)
-            metrics = comm.allreduce(metrics, op='mean')
-            return params, new_state, opt_state, metrics
+        def finish_metrics(metrics):
+            if policy is not None:
+                # metric averages stay f32 regardless of the compute
+                # dtype (a bf16 loss mean would quantize the logs)
+                metrics = jax.tree_util.tree_map(
+                    lambda m: (m.astype(jnp.float32)
+                               if jnp.issubdtype(jnp.result_type(m),
+                                                 jnp.floating) else m),
+                    metrics)
+            return comm.allreduce(metrics, op='mean')
 
-        def zero_step(params, model_state, opt_state, rng, needs_bcast,
+        def unscale_and_check(grads, scale_state):
+            """Unscaled gradients + a REPLICA-UNIFORM finiteness
+            verdict.  Gradients here are local (pre-reduction), so one
+            overflowing device must veto the update everywhere --
+            otherwise devices take different branches and params
+            silently diverge."""
+            grads = loss_scale.unscale(grads, scale_state)
+            local = precision_mod.all_finite(grads)
+            finite = comm.allreduce(local.astype(jnp.float32),
+                                    op='min') > 0.5
+            return grads, finite
+
+        def step_core(params, model_state, opt_state, rng, scale_state,
                       *batch):
+            scale = (scale_state.scale if scale_state is not None
+                     else None)
+            grads, metrics, new_state = grads_and_metrics(
+                params, model_state, rng, scale, *batch)
+            if loss_scale is None:
+                updates, opt_state = optimizer.update(grads, opt_state,
+                                                      params)
+                params = optax.apply_updates(params, updates)
+                metrics = finish_metrics(metrics)
+                return params, new_state, opt_state, metrics
+            grads, finite = unscale_and_check(grads, scale_state)
+            # zero the grads (not the branch: collectives inside
+            # optimizer.update must still be issued in lockstep), then
+            # discard the poisoned update and state on overflow
+            safe = jax.tree_util.tree_map(
+                lambda g: jnp.where(finite, g, jnp.zeros_like(g)),
+                grads)
+            updates, new_opt = optimizer.update(safe, opt_state,
+                                                params)
+            updates = jax.tree_util.tree_map(
+                lambda u: jnp.where(finite, u, jnp.zeros_like(u)),
+                updates)
+            opt_state = precision_mod.tree_select(finite, new_opt,
+                                                  opt_state)
+            params = optax.apply_updates(params, updates)
+            new_scale = loss_scale.adjust(scale_state, finite)
+            metrics = finish_metrics(dict(
+                metrics, loss_scale=scale_state.scale,
+                grads_finite=finite.astype(jnp.float32)))
+            return params, new_state, opt_state, new_scale, metrics
+
+        def zero_step_core(params, model_state, opt_state, rng,
+                           scale_state, needs_bcast, *batch):
             from jax import lax
             from chainermn_tpu.parallel import zero as z
+            scale = (scale_state.scale if scale_state is not None
+                     else None)
             grads, metrics, new_state = grads_and_metrics(
-                params, model_state, rng, *batch)
+                params, model_state, rng, scale, *batch)
+            finite = None
+            if loss_scale is not None:
+                grads, finite = unscale_and_check(grads, scale_state)
             n = comm.size
             rank = comm.axis_rank()
 
@@ -272,42 +395,80 @@ class StandardUpdater:
                 return (optax.apply_updates(params, upd_full),
                         z.unsqueeze_state(new_opt))
 
-            params, opt_state = lax.cond(
+            new_params, new_opt_state = lax.cond(
                 needs_bcast, first_call, later_call, operand=None)
-            metrics = comm.allreduce(metrics, op='mean')
-            return params, new_state, opt_state, metrics
+            if loss_scale is None:
+                metrics = finish_metrics(metrics)
+                return new_params, new_state, new_opt_state, metrics
+            # skip-on-nonfinite -- but never revert the first-call
+            # broadcast: it is a weight SYNC, not an update, and
+            # reverting it would leave replicas permanently unsynced
+            keep = jnp.logical_or(finite, needs_bcast)
+            new_params = precision_mod.tree_select(keep, new_params,
+                                                   params)
+            new_opt_state = precision_mod.tree_select(
+                keep, new_opt_state, opt_state)
+            new_scale = loss_scale.adjust(scale_state, finite)
+            metrics = finish_metrics(dict(
+                metrics, loss_scale=scale_state.scale,
+                grads_finite=finite.astype(jnp.float32)))
+            return (new_params, new_state, new_opt_state, new_scale,
+                    metrics)
+
+        # fixed-arity entry points: the leading-args layout is
+        # (params, model_state, opt_state, rng[, scale_state]
+        #  [, needs_bcast], *batch) -- scale only under a loss-scaled
+        # policy, needs_bcast only under zero -- with matching specs
+        scaled = loss_scale is not None
+        if is_zero and scaled:
+            def core(params, model_state, opt_state, rng, scale_state,
+                     needs_bcast, *batch):
+                return zero_step_core(params, model_state, opt_state,
+                                      rng, scale_state, needs_bcast,
+                                      *batch)
+        elif is_zero:
+            def core(params, model_state, opt_state, rng, needs_bcast,
+                     *batch):
+                return zero_step_core(params, model_state, opt_state,
+                                      rng, None, needs_bcast, *batch)
+        elif scaled:
+            def core(params, model_state, opt_state, rng, scale_state,
+                     *batch):
+                return step_core(params, model_state, opt_state, rng,
+                                 scale_state, *batch)
+        else:
+            def core(params, model_state, opt_state, rng, *batch):
+                return step_core(params, model_state, opt_state, rng,
+                                 None, *batch)
+
+        opt_specs = self._zero_specs if is_zero else P()
+        lead_specs = ((P(), P(), opt_specs, P())
+                      + ((P(),) if scaled else ())
+                      + ((P(),) if is_zero else ()))
+        out_specs = ((P(), P(), opt_specs)
+                     + ((P(),) if scaled else ()) + (P(),))
+        n_lead = len(lead_specs)
 
         # arity of in_specs depends on the batch tuple; resolved at
         # trace time (jit caches per shape signature)
-        if is_zero:
-            zero_specs = self._zero_specs
-
-            def mapped_call(params, model_state, opt_state, rng,
-                            needs_bcast, *batch):
-                fn = jax.shard_map(
-                    zero_step, mesh=comm.mesh,
-                    in_specs=(P(), P(), zero_specs, P(), P()) +
-                    (comm.batch_spec(),) * len(batch),
-                    out_specs=(P(), P(), zero_specs, P()),
-                    check_vma=False)
-                return fn(params, model_state, opt_state, rng,
-                          needs_bcast, *batch)
-        else:
-            def mapped_call(params, model_state, opt_state, rng,
-                            *batch):
-                fn = jax.shard_map(
-                    step, mesh=comm.mesh,
-                    in_specs=(P(), P(), P(), P()) +
-                    (comm.batch_spec(),) * len(batch),
-                    out_specs=(P(), P(), P(), P()), check_vma=False)
-                return fn(params, model_state, opt_state, rng, *batch)
+        def mapped_call(*args):
+            n_batch = len(args) - n_lead
+            fn = jax.shard_map(
+                core, mesh=comm.mesh,
+                in_specs=lead_specs + (comm.batch_spec(),) * n_batch,
+                out_specs=out_specs, check_vma=False)
+            return fn(*args)
 
         jit_kwargs = {'donate_argnums': (0, 1, 2)} if donate else {}
         return jax.jit(mapped_call, static_argnums=(), **jit_kwargs)
 
     def shard_batch(self, batch):
-        """Collate a list of examples and place it sharded on the mesh."""
-        arrays = concat_examples(batch)
+        """Collate a list of examples and place it sharded on the mesh
+        (under a policy, floating columns are cast to compute dtype on
+        the HOST first, halving the host->device bytes)."""
+        arrays = concat_examples(
+            batch, dtype=(self._policy.compute_dtype
+                          if self._policy is not None else None))
         if isinstance(arrays, dict):
             arrays = tuple(arrays.values())
         n = arrays[0].shape[0]
@@ -331,6 +492,8 @@ class StandardUpdater:
                     if self._has_state else self._rng)
         args = (self.params, self.model_state, self.opt_state,
                 step_rng)
+        if self._loss_scale is not None:
+            args += (self.scale_state,)
         if self._zero:
             args += (jnp.asarray(it == 0),)
         return args + tuple(arrays)
@@ -348,8 +511,13 @@ class StandardUpdater:
         """Advance one iteration on already-sharded device arrays;
         returns device-resident metrics (no host sync -- steps can
         overlap)."""
-        self.params, self.model_state, self.opt_state, metrics = \
-            self._step(*self._step_args(arrays))
+        out = self._step(*self._step_args(arrays))
+        if self._loss_scale is not None:
+            (self.params, self.model_state, self.opt_state,
+             self.scale_state, metrics) = out
+        else:
+            self.params, self.model_state, self.opt_state, metrics = \
+                out
         self.iteration += 1
         return metrics
 
@@ -376,6 +544,21 @@ class StandardUpdater:
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         return dict(cost or {})
+
+    def declared_reduce_dtypes(self):
+        """Dtype names reductions in this updater's compiled step may
+        legitimately narrow to (the shardlint SL004 introspection
+        hook): the policy's compute/reduce dtypes, the ZeRO reduce
+        dtype, and the communicator's own declaration."""
+        out = set()
+        if self._policy is not None:
+            out |= self._policy.declared_dtypes()
+        if self._zero_reduce_dtype is not None:
+            out.add(str(self._zero_reduce_dtype))
+        hook = getattr(self.comm, 'declared_reduce_dtypes', None)
+        if hook is not None:
+            out |= set(hook())
+        return out
 
     # epoch accounting is delegated to the iterator
     @property

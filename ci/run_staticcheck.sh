@@ -4,6 +4,14 @@
 # steps (docs/static_analysis.md).  JSON mode on stdout for log
 # scraping; exit 1 (-> lint gate failure) on any ERROR-severity
 # finding.  CPU-only by construction: tracing runs no collective.
+#
+# TWO sweeps: the default full-precision pass, then the same targets
+# under the bf16 mixed-precision policy (strategies constructed with
+# reduce_dtype=bfloat16, updaters with Policy.bf16()) -- the
+# clean-sweep guarantee covers both precisions, and SL004's
+# declared-reduce-dtype allowance is exercised for real, not just in
+# fixtures (docs/mixed_precision.md).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json
+JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16

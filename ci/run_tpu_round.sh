@@ -214,6 +214,11 @@ done
 # MXU-friendly space-to-depth stem (exact equivalent; models/resnet50.py)
 run bench_resnet50_s2d $QT python bench.py --quick --s2d
 run bench_resnet50_s2d_b128 $QT python bench.py --quick --s2d --batch 128
+# mixed-precision A/B: bf16 compute + bf16 gradient reduction with
+# f32 master weights (chainermn_tpu/precision.py) against the tier-2
+# f32-master headline -- rows carry the policy dtypes, so the pair is
+# self-describing in the banked artifacts (docs/mixed_precision.md)
+run bench_resnet50_bf16 $QT python bench.py --quick --policy bf16
 
 # end-of-sweep headline rerun: a PLAIN bench.py invocation adopts the
 # sweep winner just banked above (bench.py:adopt_tuned_config), so the

@@ -45,6 +45,10 @@ def main():
                              '(view in TensorBoard)')
     parser.add_argument('--quick', action='store_true',
                         help='tiny run for smoke testing')
+    parser.add_argument('--policy', default=None,
+                        help='mixed-precision policy (bf16 | f16 | '
+                             'f32): compute/reduce narrow, f32 master '
+                             'weights (docs/mixed_precision.md)')
     args = parser.parse_args()
 
     if args.cpu:
@@ -67,7 +71,10 @@ def main():
         print('Num epoch: {}'.format(args.epoch))
         print('==========================================')
 
-    model = MLP(n_units=args.unit, n_out=10)
+    policy = (chainermn_tpu.Policy.from_string(args.policy)
+              if args.policy else None)
+    model = MLP(n_units=args.unit, n_out=10,
+                dtype=policy.compute_dtype if policy else None)
     params = model.init(jax.random.PRNGKey(0),
                         jnp.zeros((1, 784), jnp.float32))
     clf = Classifier(model.apply)
@@ -90,7 +97,8 @@ def main():
                                         repeat=False, shuffle=False)
 
     updater = training.StandardUpdater(
-        train_iter, optimizer, clf, params, comm, has_aux=True)
+        train_iter, optimizer, clf, params, comm, has_aux=True,
+        policy=policy)
     trainer = training.Trainer(updater, (args.epoch, 'epoch'),
                                out=args.out)
 

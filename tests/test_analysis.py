@@ -155,6 +155,54 @@ def test_sl004_good_widening_cast_is_silent():
     assert fs == []
 
 
+def test_sl004_declared_strategy_reduce_dtype_is_allowed():
+    # a strategy CONSTRUCTED with reduce_dtype declares the narrowing
+    # via declared_reduce_dtypes (the same introspection idiom as
+    # reduction_axes): the identical bf16 psum that fails above lints
+    # clean here
+    target = targets_mod.strategy_targets(
+        ['naive'],
+        comm_factory=lambda n: NaiveCommunicator(
+            mesh_shape=(2, 4), reduce_dtype='bfloat16'))[0]
+    assert target.declared_dtypes == ('bfloat16',)
+    fs = analysis.lint_target(target)
+    assert fs == []
+
+
+def test_sl004_undeclared_narrowing_still_fires():
+    # a declaration covers ONLY its own dtype: narrowing to bf16 with
+    # a declared f16 reduce dtype is still an accidental precision
+    # loss and must keep firing
+    def narrow(x):
+        return lax.psum(x.astype(jnp.bfloat16), 'intra').astype(
+            x.dtype)
+
+    fs = _lint_mapped(narrow, (jnp.zeros((4,), jnp.float32),),
+                      declared_dtypes=('float16',))
+    assert _ids(fs, 'error') == ['SL004']
+
+
+def test_sl004_bf16_policy_step_lints_clean():
+    # the updater-level hook: a Policy.bf16() mlp step declares its
+    # reduce/compute dtypes and the whole step (donation marks,
+    # bf16 gradient allreduce and all) lints clean
+    from chainermn_tpu.precision import Policy
+
+    target = targets_mod.mlp_step_target(policy=Policy.bf16())
+    assert 'bfloat16' in (target.declared_dtypes or ())
+    fs = analysis.lint_target(target)
+    assert fs == [], fs
+
+
+def test_bf16_policy_strategy_sweep_lints_clean():
+    # the second ci/run_staticcheck.sh pass in miniature: every
+    # registered strategy under reduce_dtype=bfloat16
+    for target in targets_mod.strategy_targets(
+            reduce_dtype='bfloat16'):
+        fs = analysis.lint_target(target)
+        assert fs == [], (target.name, fs)
+
+
 # ---------------------------------------------------------------- SL005
 def _jit_target(fn, args, donate):
     with warnings.catch_warnings():
