@@ -866,6 +866,7 @@ def measure(argv):
         rep_times_s={str(k): [round(t, 4) for t in v]
                      for k, v in times.items()},
         rep_spread=round(spread, 3),
+        quick=quick,
         sync_method='device_get',
         baseline_derivation=cfg['baseline_derivation'],
         global_batch_items=cfg['items'],
@@ -886,6 +887,15 @@ def measure(argv):
     if os.environ.get('CHAINERMN_TPU_ADOPTED_FROM'):
         result['adopted_config_from'] = \
             os.environ['CHAINERMN_TPU_ADOPTED_FROM']
+    if os.environ.get('CHAINERMN_TPU_ADOPTED_COMPARISON'):
+        # the crowning comparison (winner vs incumbent sources,
+        # values, quickness, scan_lengths, device_kind) rides the row
+        # so adoption fairness is auditable from the artifact alone
+        try:
+            result['adopted_comparison'] = json.loads(
+                os.environ['CHAINERMN_TPU_ADOPTED_COMPARISON'])
+        except ValueError:
+            pass
     if bur_trustworthy is not None:
         result['block_until_ready_trustworthy'] = bool(bur_trustworthy)
     if matmul_tflops is not None:
@@ -1076,12 +1086,56 @@ def _last_json_row(path):
     return row if isinstance(row, dict) else None
 
 
+_RETRACTION_LEDGER = None
+
+
+def load_retraction_ledger():
+    """``benchmarks/results/retractions.json`` as a list of
+    retraction records (VERDICT r5 item 7): the machine-readable
+    ledger flagging numbers whose own artifact cannot be edited (a
+    committed round ledger like ``BENCH_r02.json``) or predates the
+    in-row ``retracted`` field.  Each record carries ``metric`` and
+    ``value``; a row matching both (value to 2 decimals) is treated
+    as retracted everywhere ``_trustworthy_value`` is consulted.
+    Cached after first read; missing/corrupt ledger = empty."""
+    global _RETRACTION_LEDGER
+    if _RETRACTION_LEDGER is None:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            'benchmarks', 'results', 'retractions.json')
+        try:
+            with open(path) as f:
+                entries = json.load(f).get('retractions', [])
+            _RETRACTION_LEDGER = [e for e in entries
+                                  if isinstance(e, dict)]
+        except (OSError, ValueError, AttributeError):
+            _RETRACTION_LEDGER = []
+    return _RETRACTION_LEDGER
+
+
+def _retracted_by_ledger(row):
+    try:
+        value = round(float(row.get('value', 0.0)), 2)
+    except (TypeError, ValueError):
+        return False
+    metric = row.get('metric')
+    for entry in load_retraction_ledger():
+        try:
+            if (entry.get('metric') == metric
+                    and round(float(entry.get('value')), 2) == value):
+                return True
+        except (TypeError, ValueError):
+            continue
+    return False
+
+
 def _trustworthy_value(row, model='resnet50'):
     """The row's value when it is a trustworthy ``model`` measurement
-    (real-TPU, error-free, suspect-free, retraction-free, finite
-    positive value), else None.  ONE filter shared by the winner
-    pick, the newest-tag search and the banked-last-good lookup so
-    they can never disagree on what counts."""
+    (real-TPU, error-free, suspect-free, retraction-free -- both the
+    in-row flag and the retractions.json ledger -- finite positive
+    value), else None.  ONE filter shared by the winner pick, the
+    newest-tag search and the banked-last-good lookup so they can
+    never disagree on what counts."""
     if (not isinstance(row, dict)
             or not str(row.get('metric', '')).startswith(model)
             or row.get('backend') != 'tpu' or row.get('error')
@@ -1093,22 +1147,60 @@ def _trustworthy_value(row, model='resnet50'):
         return None
     if not math.isfinite(value) or value <= 0:
         return None
+    if _retracted_by_ledger(row):
+        return None
     return value
 
 
-def pick_tuned_resnet50(rows):
-    """Choose the best banked resnet50 tuning from bench JSON rows.
+def _row_quickness(row):
+    """``'quick'`` / ``'full'`` / ``None`` (unknown) for a bench row.
+    Rows measured from this round on carry ``quick`` directly; older
+    rows are inferred from ``scan_lengths`` (the --quick sweep used
+    max length 6, the full config 12+).  ADVICE r5 #1: quick and
+    non-quick rows have different measurement bias, so adoption must
+    not crown a winner across the boundary."""
+    if isinstance(row.get('quick'), bool):
+        return 'quick' if row['quick'] else 'full'
+    ks = row.get('scan_lengths')
+    if isinstance(ks, list) and ks:
+        try:
+            return 'quick' if max(ks) <= 6 else 'full'
+        except TypeError:
+            return None
+    return None
 
-    Returns ``(flags, source, value)`` where ``flags`` is the argv
-    suffix reproducing the winning config (``['--batch', '128']``,
-    optionally ``'--s2d'``), or ``(None, None, None)`` when the
-    default config is (still) the best or no trustworthy tuned row
-    exists.  A row is trustworthy when it is a real-TPU, error-free,
-    suspect-free measurement with a finite positive value; the
-    incumbent is the best such row measured at the default config.
-    Pure function so the adoption policy is unit-testable off-chip.
+
+def _quickness_matches(a, b):
+    """Rows are comparable when their quickness classes agree; an
+    unknown class (legacy rows) matches anything -- strictness cannot
+    retroactively orphan every pre-ledger artifact."""
+    return a is None or b is None or a == b
+
+
+def _pick_tuned(rows, fallback_incumbent=None):
+    """Adoption decision over bench JSON rows (rich form).
+
+    Returns a dict: ``flags``/``source``/``value`` for the winning
+    tuned config (``flags`` None = keep the default config), plus the
+    comparison provenance -- incumbent source/value, both sides'
+    quickness class, ``scan_lengths`` and ``device_kind``, and a
+    ``declined`` reason when adoption was refused.
+
+    Fairness rules (ADVICE r5 #1/#2):
+
+    - a tuned winner is only crowned against an incumbent of MATCHING
+      quickness (``--quick`` sweep rows measure with shorter scans
+      and different bias than the non-quick headline; legacy rows
+      without the ``quick`` field are inferred from ``scan_lengths``
+      and unknowns match anything);
+    - when the deciding rows hold NO trustworthy default-config
+      incumbent, the caller-supplied ``fallback_incumbent`` (the
+      newest trustworthy default-config row from an OLDER tag) is
+      used for the comparison; with neither, adoption is DECLINED --
+      a tuned row must never be adopted uncompared, it could be
+      slower than the proven default.
     """
-    best, incumbent = None, None
+    best, incumbents = None, []
     for row in rows:
         value = _trustworthy_value(row)
         if value is None:
@@ -1117,18 +1209,57 @@ def pick_tuned_resnet50(rows):
                      or row.get('stem'))
         if tuned and (best is None or value > best[0]):
             best = (value, row)
-        if not tuned and (incumbent is None or value > incumbent[0]):
-            incumbent = (value, row)
-    if best is None or (incumbent is not None
-                        and best[0] <= incumbent[0]):
-        return None, None, None
+        if not tuned:
+            incumbents.append((value, row))
+    out = {'flags': None, 'source': None, 'value': None}
+    if best is None:
+        return out
     value, row = best
+    quickness = _row_quickness(row)
+    matching = [iv for iv in incumbents
+                if _quickness_matches(quickness,
+                                      _row_quickness(iv[1]))]
+    if not matching and fallback_incumbent is not None:
+        fb_value = _trustworthy_value(fallback_incumbent)
+        if fb_value is not None and _quickness_matches(
+                quickness, _row_quickness(fallback_incumbent)):
+            matching = [(fb_value, fallback_incumbent)]
+            out['incumbent_fallback'] = True
+    if not matching:
+        out['declined'] = ('no trustworthy default-config incumbent '
+                           'of matching quickness (%s) to compare '
+                           'against' % (quickness or 'unknown'))
+        return out
+    inc_value, inc_row = max(matching, key=lambda iv: iv[0])
+    out.update(
+        incumbent_source=inc_row.get('_source', '(unknown artifact)'),
+        incumbent_value=inc_value,
+        incumbent_quick=_row_quickness(inc_row),
+        winner_quick=quickness,
+        winner_scan_lengths=row.get('scan_lengths'),
+        incumbent_scan_lengths=inc_row.get('scan_lengths'),
+        winner_device_kind=row.get('device_kind'),
+        incumbent_device_kind=inc_row.get('device_kind'),
+    )
+    if value <= inc_value:
+        return out  # default config still wins
     flags = []
     if row.get('per_device_batch_override'):
         flags += ['--batch', str(int(row['per_device_batch_override']))]
     if row.get('stem'):
         flags.append('--s2d')
-    return flags, row.get('_source', '(unknown artifact)'), value
+    out.update(flags=flags,
+               source=row.get('_source', '(unknown artifact)'),
+               value=value)
+    return out
+
+
+def pick_tuned_resnet50(rows, fallback_incumbent=None):
+    """Back-compat 3-tuple view of :func:`_pick_tuned`:
+    ``(flags, source, value)``, all None when the default config wins
+    or adoption is declined."""
+    d = _pick_tuned(rows, fallback_incumbent)
+    return d['flags'], d['source'], d['value']
 
 
 def banked_last_good(model):
@@ -1193,12 +1324,21 @@ def adopt_tuned_config(argv, model):
     are considered (``bench_resnet50*_rN.out``): a winner crowned in
     an earlier round -- possibly under a different chip allocation or
     a since-fixed harness -- must not silently steer today's headline
-    config; within one tag all rows came from the same chip.
+    config.  Fairness (ADVICE r5 #1/#2, implemented in
+    ``_pick_tuned``): winners are only crowned against incumbents of
+    matching --quick-ness; when the deciding tag holds no trustworthy
+    default-config incumbent, the newest trustworthy default-config
+    row from an OLDER tag stands in, and with neither, adoption is
+    declined outright.  The full comparison (winner/incumbent
+    sources, values, quickness, scan_lengths, device_kind) is
+    exported via ``CHAINERMN_TPU_ADOPTED_COMPARISON`` and lands in
+    the measured row as ``adopted_comparison``.
     """
     # cleared unconditionally so a value inherited from a wrapper's
     # environment can never fabricate provenance on a run where
     # adoption was disabled or declined
     os.environ.pop('CHAINERMN_TPU_ADOPTED_FROM', None)
+    os.environ.pop('CHAINERMN_TPU_ADOPTED_COMPARISON', None)
     if (model != 'resnet50' or '--batch' in argv or '--s2d' in argv
             or '--cpu' in argv or '--no-adopt' in argv):
         return argv
@@ -1240,17 +1380,47 @@ def adopt_tuned_config(argv, model):
         return (int(m2.group(1)) if m2 else -1,
                 tag_mtime.get(tag, 0.0), tag)
 
-    flags = source = value = None
-    for tag in sorted(by_tag, key=tag_key, reverse=True):
-        flags, source, value = pick_tuned_resnet50(by_tag[tag])
+    ordered = sorted(by_tag, key=tag_key, reverse=True)
+    decision, deciding_idx = None, None
+    for i, tag in enumerate(ordered):
         if any(_trustworthy_value(r) is not None
                for r in by_tag[tag]):
-            break  # newest tag with any trustworthy row decides
+            deciding_idx = i  # newest tag with any trustworthy row
+            break
+    if deciding_idx is None:
+        return argv
+    # fallback incumbent (ADVICE r5 #2): the newest trustworthy
+    # DEFAULT-CONFIG row from any OLDER tag, for when the deciding
+    # tag banked only tuned rows
+    fallback = None
+    for tag in ordered[deciding_idx + 1:]:
+        candidates = [
+            r for r in by_tag[tag]
+            if _trustworthy_value(r) is not None
+            and not (r.get('per_device_batch_override')
+                     or r.get('stem'))]
+        if candidates:
+            fallback = max(candidates,
+                           key=lambda r: float(r.get('value', 0.0)))
+            break
+    decision = _pick_tuned(by_tag[ordered[deciding_idx]],
+                           fallback_incumbent=fallback)
+    flags, source, value = (decision['flags'], decision['source'],
+                            decision['value'])
     if not flags:
+        if decision.get('declined'):
+            _log('tuned-config adoption declined: %s'
+                 % decision['declined'])
         return argv
     _log('adopting tuned resnet50 config %s from %s '
-         '(banked %.1f items/s/chip)' % (' '.join(flags), source, value))
+         '(banked %.1f items/s/chip vs incumbent %s at %.1f)'
+         % (' '.join(flags), source, value,
+            decision.get('incumbent_source'),
+            decision.get('incumbent_value') or 0.0))
     os.environ['CHAINERMN_TPU_ADOPTED_FROM'] = source
+    os.environ['CHAINERMN_TPU_ADOPTED_COMPARISON'] = json.dumps(
+        {k: v for k, v in decision.items()
+         if k not in ('flags',)}, sort_keys=True)
     return argv + flags
 
 

@@ -72,6 +72,23 @@ def bucket_of(category):
     return 'other'
 
 
+def cell_float(v):
+    """Tolerant float from an xprof DataTable cell (ADVICE r5 #3).
+    DataTables emit plain numbers but ALSO formatted strings --
+    thousands separators ('1,234'), percent suffixes ('56.2%') --
+    depending on converter version; a strict float() crashed the
+    standalone CLI after analysis already succeeded.  Returns None
+    for anything unparseable (callers fall back to the raw value)."""
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return float(v)
+    try:
+        return float(str(v).replace(',', '').replace('%', '').strip())
+    except ValueError:
+        return None
+
+
 def datatable_rows(table):
     """Yield dicts from a Google-DataTable-shaped ``hlo_stats`` JSON."""
     cols = [c.get('id') for c in table.get('cols', [])]
@@ -95,7 +112,7 @@ def _collect_ops(paths, tool):
     buckets, ops = {}, []
     for table in _tool_tables(paths, tool):
         for row in datatable_rows(table):
-            self_us = float(row.get('total_self_time') or 0.0)
+            self_us = cell_float(row.get('total_self_time')) or 0.0
             if self_us <= 0:
                 continue
             cat = row.get('category') or row.get('type') or '?'
@@ -219,13 +236,22 @@ def render(report):
     lines.append('  top ops by self time:')
     for o in report['top_ops']:
         extras = []
-        if o.get('gflops_per_sec'):
-            extras.append('%.0f GF/s' % float(o['gflops_per_sec']))
-        if o.get('memory_bw_gibs'):
-            extras.append('%.0f GiB/s' % float(o['memory_bw_gibs']))
-        if o.get('dma_stall_pct'):
-            extras.append('%.0f%% DMA stall'
-                          % float(o['dma_stall_pct']))
+        # tolerant per-op formatting (ADVICE r5 #3): a cell the
+        # converter rendered as a formatted string must not crash the
+        # report -- parse through cell_float, fall back to the raw
+        # value verbatim
+        for field, fmt in (('gflops_per_sec', '%.0f GF/s'),
+                           ('memory_bw_gibs', '%.0f GiB/s'),
+                           ('dma_stall_pct', '%.0f%% DMA stall')):
+            raw = o.get(field)
+            if not raw:
+                continue
+            try:
+                f = cell_float(raw)
+                extras.append(fmt % f if f is not None
+                              else '%s=%r' % (field, raw))
+            except (TypeError, ValueError):
+                extras.append('%s=%r' % (field, raw))
         lines.append('    %8.1f us  %-28s %-16s %s'
                      % (o['self_time_us'], o['op'][:28], o['category'],
                         ', '.join(extras)))
@@ -236,12 +262,30 @@ def main(argv):
     dirs = [a for a in argv if not a.startswith('--')]
     if '--latest' in argv or not dirs:
         dirs = dirs or latest_trace_dirs()
+    out_path = os.path.join(RES, 'trace_report.json')
     if not dirs:
+        # ADVICE r5 #4: a previously committed breakdown must not
+        # outlive the captures it described (strategy_trace rmtree's
+        # failed capture dirs) -- rewrite the artifact with an
+        # explanatory stub so it always reflects the LATEST capture
+        # state instead of contradicting a jsonl row's trace_error
+        stub = {
+            'error': 'no trace dirs found',
+            'detail': ('no capture dirs under %s at report time; any '
+                       'previous per-op breakdown is superseded (its '
+                       'captures were removed)'
+                       % os.path.relpath(os.path.join(RES, 'traces'),
+                                         HERE)),
+        }
+        os.makedirs(RES, exist_ok=True)
+        with open(out_path, 'w') as f:
+            f.write(json.dumps(stub) + '\n')
         print('no trace dirs found under %s'
               % os.path.join(RES, 'traces'))
+        print('wrote stub %s' % os.path.relpath(out_path,
+                                                os.getcwd()))
         return 0
     reports = [analyze_trace(d) for d in dirs]
-    out_path = os.path.join(RES, 'trace_report.json')
     with open(out_path, 'w') as f:
         for rep in reports:
             f.write(json.dumps(rep) + '\n')

@@ -80,11 +80,36 @@ def load_npz(path, template):
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def updater_state(updater):
+    """The canonical snapshot pytree of a live updater: params,
+    optimizer state, iteration/epoch counters, plus -- when present --
+    BatchNorm/model state, the pipeline's replicated prologue/epilogue
+    params (``extra``) and the mixed-precision loss-scale state
+    (``scale_state``, so a resumed f16 run continues at its adapted
+    scale instead of re-warming from the initial one).  Single source
+    of truth shared by ``extensions.snapshot()``, NanGuard's
+    divergence forensics and the preemption checkpoint
+    (:mod:`chainermn_tpu.training.recovery`)."""
+    state = {
+        'params': updater.params,
+        'opt_state': updater.opt_state,
+        'iteration': updater.iteration,
+        'epoch': updater.epoch,
+    }
+    if getattr(updater, 'model_state', None) is not None:
+        state['model_state'] = updater.model_state
+    if getattr(updater, 'extra', None) is not None:
+        state['extra'] = updater.extra
+    if getattr(updater, 'scale_state', None) is not None:
+        state['scale_state'] = updater.scale_state
+    return state
+
+
 def resume_updater(path, updater, comm=None):
     """Restore a snapshot written by ``extensions.snapshot()`` into a
-    live updater: params, optimizer state, BatchNorm/model state, and
-    the iteration/epoch counters (so stop triggers and log filenames
-    continue rather than restart).
+    live updater: params, optimizer state, BatchNorm/model state,
+    loss-scale state, and the iteration/epoch counters (so stop
+    triggers and log filenames continue rather than restart).
 
     Every restored leaf is placed with the LIVE updater leaf's own
     sharding, so whatever layout the updater established at
@@ -93,14 +118,17 @@ def resume_updater(path, updater, comm=None):
     pipeline params (``PipelineUpdater``).  The loaded host arrays
     never alias device buffers, so donation stays safe.  ``comm`` is
     accepted for backward compatibility and unused."""
-    template = {'params': updater.params, 'opt_state': updater.opt_state,
-                'iteration': 0, 'epoch': 0}
-    if getattr(updater, 'model_state', None) is not None:
-        template['model_state'] = updater.model_state
-    if getattr(updater, 'extra', None) is not None:
-        # PipelineUpdater's replicated prologue/epilogue params
-        template['extra'] = updater.extra
-    state = load_npz(path, template)
+    template = dict(updater_state(updater), iteration=0, epoch=0)
+    try:
+        state = load_npz(path, template)
+    except KeyError:
+        if 'scale_state' not in template:
+            raise
+        # checkpoints written before loss-scale state was snapshot
+        # (or by a non-policy run) restore everything else; the live
+        # scale state is kept as-is
+        template.pop('scale_state')
+        state = load_npz(path, template)
 
     def place(new_tree, cur_tree):
         return jax.tree_util.tree_map(
@@ -115,6 +143,9 @@ def resume_updater(path, updater, comm=None):
                                     updater.model_state)
     if 'extra' in template:
         updater.extra = place(state['extra'], updater.extra)
+    if 'scale_state' in state:
+        updater.scale_state = place(state['scale_state'],
+                                    updater.scale_state)
     updater.iteration = int(state['iteration'])
     it = updater.iterator
     if hasattr(it, 'restore_epoch'):

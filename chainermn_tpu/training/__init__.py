@@ -18,5 +18,8 @@ from chainermn_tpu.training.pipeline_updater import (  # noqa
     PipelineUpdater, pipeline_mesh)
 from chainermn_tpu.training.evaluator import Evaluator  # noqa
 from chainermn_tpu.training import extensions  # noqa
+from chainermn_tpu.training import recovery  # noqa
+from chainermn_tpu.training.recovery import (  # noqa
+    PreemptionHandler, auto_resume)
 from chainermn_tpu.training import triggers  # noqa
 from chainermn_tpu.training.convert import concat_examples  # noqa

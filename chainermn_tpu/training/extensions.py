@@ -124,7 +124,10 @@ class PrintReport:
 
 
 def snapshot(filename='snapshot_iter_{iteration}', rank0_only=True):
-    """Checkpoint trainer state (params + optimizer state + counters).
+    """Checkpoint trainer state (params + optimizer state + loss-scale
+    state + counters; the exact pytree
+    ``serializers.updater_state()`` defines, shared with the
+    preemption and divergence checkpoints).
 
     The reference delegates to ``chainer.serializers`` npz snapshots
     (``train_mnist.py:117-118``); ours go through
@@ -140,18 +143,7 @@ def snapshot(filename='snapshot_iter_{iteration}', rank0_only=True):
         u = trainer.updater
         path = os.path.join(
             trainer.out, filename.format(iteration=u.iteration))
-        state = {
-            'params': u.params,
-            'opt_state': u.opt_state,
-            'iteration': u.iteration,
-            'epoch': u.epoch,
-        }
-        if getattr(u, 'model_state', None) is not None:
-            state['model_state'] = u.model_state
-        if getattr(u, 'extra', None) is not None:
-            # PipelineUpdater's replicated prologue/epilogue params
-            state['extra'] = u.extra
-        serializers.save_npz(path, state)
+        serializers.save_npz(path, serializers.updater_state(u))
     ext.trigger = (1, 'epoch')
     ext.priority = 50
     ext.name = 'snapshot'

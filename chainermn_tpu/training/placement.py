@@ -23,6 +23,51 @@ state (e.g. lookahead slow weights) still get caught.
 import jax
 
 
+def multihost_device_put(tree, shardings):
+    """``jax.device_put`` with a multihost-safe path.
+
+    A host value bound for a sharding that spans OTHER processes'
+    devices cannot go through plain ``device_put``: jax routes that
+    through ``multihost_utils.assert_equal``, which dispatches one
+    tiny cross-process psum PER LEAF -- a storm of concurrent gloo/ICI
+    collectives that (a) serializes construction on the coordination
+    service and (b) can interleave in a different order on different
+    ranks and wedge the transport (observed as gloo message-size
+    mismatches on CPU meshes).  Instead each such leaf is placed with
+    ``jax.make_array_from_callback``: every process supplies exactly
+    its addressable shards from its local host copy -- ZERO
+    cross-process traffic.  The value-equality across processes that
+    ``assert_equal`` used to check becomes the caller's contract
+    (every process passes the same host value -- the same contract
+    the reference's replicated init always had; the multiprocess
+    suite pins it end-to-end by comparing trajectories).
+
+    Leaves that are already fully-addressable arrays, or shardings
+    local to this process, take the plain ``device_put`` path
+    unchanged.
+    """
+    import numpy as np
+
+    def one(leaf, sh):
+        if (isinstance(sh, jax.sharding.Sharding)
+                and not sh.is_fully_addressable):
+            if isinstance(leaf, jax.Array) and not (
+                    leaf.is_fully_addressable
+                    or leaf.is_fully_replicated):
+                return jax.device_put(leaf, sh)  # no host copy exists
+            # eager placement helper, never traced: the host copy is
+            # the point (local shards are cut from it)
+            host = np.asarray(leaf)  # noqa: shardlint
+            return jax.make_array_from_callback(
+                host.shape, sh, lambda idx, _h=host: _h[idx])
+        return jax.device_put(leaf, sh)
+
+    if isinstance(shardings, jax.sharding.Sharding):
+        return jax.tree_util.tree_map(
+            lambda leaf: one(leaf, shardings), tree)
+    return jax.tree_util.tree_map(one, tree, shardings)
+
+
 def _buffer_keys(a):
     """Set of (device, buffer pointer) for an array's local shards;
     None when the backend cannot tell (treated as possibly-aliased)."""
@@ -38,7 +83,7 @@ def owned_device_put(tree, shardings, donate, protect=None):
     is guaranteed not to alias ``protect`` (default: ``tree`` itself,
     i.e. the caller's own buffers) so it is safe to donate into a
     jitted step."""
-    out = jax.device_put(tree, shardings)
+    out = multihost_device_put(tree, shardings)
     if not donate:
         return out
 

@@ -41,6 +41,16 @@ class Trainer:
         self.elapsed_time = 0.0
         self._async = bool(async_metrics)
         self._sync_interval = max(1, int(sync_interval))
+        self._stop_requested = False
+        self.stop_reason = None
+
+    def stop(self, reason=None):
+        """Request a clean stop at the current iteration boundary
+        (used by the preemption handler after its checkpoint; any
+        extension may call it).  ``run()`` returns normally with
+        ``stop_reason`` set."""
+        self._stop_requested = True
+        self.stop_reason = reason
 
     def extend(self, extension, trigger=None, name=None, priority=None):
         if trigger is None:
@@ -59,7 +69,7 @@ class Trainer:
             os.makedirs(self.out, exist_ok=True)
         start = time.time()
         stop = self.stop_trigger
-        while not stop(self):
+        while not (self._stop_requested or stop(self)):
             if self._async:
                 self.observation = self.updater.update(sync=False)
                 if self.updater.iteration % self._sync_interval == 0:
@@ -78,4 +88,6 @@ class Trainer:
                     result = entry.extension(self)
                     if isinstance(result, dict):
                         self.observation.update(result)
+                if self._stop_requested:
+                    break  # e.g. preemption checkpoint just written
         self._done = True

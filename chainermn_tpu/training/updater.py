@@ -16,6 +16,7 @@ import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from chainermn_tpu.training.convert import concat_examples
+from chainermn_tpu.utils import chaos as _chaos
 
 
 class StandardUpdater:
@@ -471,6 +472,8 @@ class StandardUpdater:
                           if self._policy is not None else None))
         if isinstance(arrays, dict):
             arrays = tuple(arrays.values())
+        if _chaos._active is not None:  # nan_batch fault injection
+            arrays = _chaos.corrupt_batch(arrays)
         n = arrays[0].shape[0]
         if n % (self.comm.size * self._accum_steps):
             raise ValueError(
@@ -511,6 +514,8 @@ class StandardUpdater:
         """Advance one iteration on already-sharded device arrays;
         returns device-resident metrics (no host sync -- steps can
         overlap)."""
+        if _chaos._active is not None:  # sigterm_step / kill_step
+            _chaos.on_step(self.iteration)
         out = self._step(*self._step_args(arrays))
         if self._loss_scale is not None:
             (self.params, self.model_state, self.opt_state,

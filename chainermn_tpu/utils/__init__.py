@@ -1,11 +1,15 @@
-"""Runtime utilities: platform setup, profiling, failure detection,
-distributed LR recipes."""
+"""Runtime utilities: platform setup, profiling, failure detection
+and recovery primitives, chaos (fault) injection, distributed LR
+recipes."""
 
 from chainermn_tpu.utils.platform import enable_host_cpu_backend  # noqa
 from chainermn_tpu.utils.platform import force_host_devices  # noqa
 from chainermn_tpu.utils import profiling  # noqa
+from chainermn_tpu.utils import chaos  # noqa
+from chainermn_tpu.utils.chaos import FaultInjector  # noqa
 from chainermn_tpu.utils.failure import (  # noqa
     NanGuard, DivergenceError, Heartbeat, check_finite, detect_stall,
-    heartbeat_extension)
+    heartbeat_extension, CommFailure, ChannelTimeout, PeerDeadError,
+    Backoff, Deadline)
 from chainermn_tpu.utils.schedules import (  # noqa
     linear_scaled_lr, gradual_warmup, distributed_sgd_schedule)

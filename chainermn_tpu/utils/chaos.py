@@ -1,0 +1,318 @@
+"""Seeded, deterministic fault injection for the distributed stack.
+
+Elastic-training systems earn trust by BREAKING themselves on purpose:
+inject a fault deterministically, watch the recovery layer absorb it,
+assert the run still converges.  This module is that injector for
+chainermn_tpu -- the counterpart of the detectors in
+:mod:`chainermn_tpu.utils.failure` and the recovery layer in
+:mod:`chainermn_tpu.training.recovery` (see
+``docs/fault_tolerance.md`` for the full detectors -> injector ->
+recovery loop and ``ci/run_matrix.sh`` for the multi-controller chaos
+leg that runs the multiprocess suite clean AND under these faults).
+
+Design constraints:
+
+- **Zero cost when off.**  Every hook first checks the module-global
+  ``_active`` against ``None`` (one attribute load + identity check);
+  no spec parsing, no rng, no environment reads happen on the hot
+  path of a chaos-free run.
+- **Deterministic under a fixed seed.**  Each site owns a
+  ``random.Random`` seeded from ``(seed, crc32(site))`` -- NOT
+  Python's per-process salted ``hash`` -- so two processes (or two
+  runs) given the same spec replay the identical fault sequence.
+  This is what lets the SIGTERM-mid-step scenario fire on every rank
+  at the same iteration, making the collective orbax checkpoint
+  coherent.
+- **Env/flag activated.**  ``CHAINERMN_TPU_CHAOS`` holds a spec
+  string; :func:`maybe_install_from_env` (called from communicator
+  and updater construction) installs it once per process.
+
+Spec grammar (items separated by ``;``)::
+
+    seed=INT                 rng seed (default 0)
+    rank=INT                 restrict the whole spec to this
+                             jax.process_index() (default: all)
+    SITE=WHEN[:ARG]          one fault rule
+
+    WHEN := '@' i,j,...      fire at these 0-based occurrences of SITE
+          | 'p' FLOAT        fire with this probability per occurrence
+          | '*'              fire every occurrence
+    ARG  := FLOAT            site-specific (delay seconds, burst
+                             length, exit code)
+
+Sites (the action is part of the site name):
+
+==================  ====================================================
+``drop_send``       the eager-p2p publish attempt fails (transient
+                    store error); ``send_obj``'s bounded backoff loop
+                    must retry it through
+``delay_send``      sleep ARG (default 0.05 s) before the publish
+``dup_send``        publish the message key twice (at-least-once
+                    delivery duplicate)
+``stall_kv``        sleep ARG (default 0.2 s) before each KV-store
+                    wait slice (slow/contended coordination service)
+``nan_batch``       poison the first ARG (default 1) elements of the
+                    next host batch's first floating array with NaN --
+                    the gradients of that step become NaN (divergence
+                    burst for NanGuard integration)
+``sigterm_step``    deliver SIGTERM to this process at the start of
+                    update_core occurrence N (preemption mid-step)
+``kill_step``       hard-kill (``os._exit(ARG or 42)``) at the start
+                    of update_core occurrence N
+``kill_recv``       hard-kill at recv_obj call occurrence N (receiver
+                    death mid-conversation)
+==================  ====================================================
+
+Example -- drop the first publish, delay half the rest, stall the
+store, SIGTERM at step 3::
+
+    CHAINERMN_TPU_CHAOS='seed=7;drop_send=@0;delay_send=p0.5:0.02;
+                         stall_kv=p0.5:0.05;sigterm_step=@3'
+
+(one line in a real environment; wrapped here for width)
+"""
+
+import os
+import signal
+import time
+import zlib
+
+ENV_VAR = 'CHAINERMN_TPU_CHAOS'
+
+SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
+         'nan_batch', 'sigterm_step', 'kill_step', 'kill_recv')
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the injector to model a transient failure (e.g. a
+    dropped publish).  The message carries 'UNAVAILABLE' so generic
+    transient-error classifiers treat it as retryable, which is the
+    point: recovery code must survive it without special-casing."""
+
+    def __init__(self, site, occurrence):
+        super().__init__(
+            'UNAVAILABLE (chaos: injected %s at occurrence %d)'
+            % (site, occurrence))
+        self.site = site
+        self.occurrence = occurrence
+
+
+class Rule:
+    __slots__ = ('site', 'prob', 'at', 'always', 'arg')
+
+    def __init__(self, site, prob=None, at=None, always=False, arg=None):
+        self.site = site
+        self.prob = prob
+        self.at = at
+        self.always = always
+        self.arg = arg
+
+
+def parse_spec(spec):
+    """``(seed, rank, {site: Rule})`` from a spec string (grammar in
+    the module docstring).  Raises ValueError on malformed items so a
+    typo'd env var fails loudly at install, not silently mid-run."""
+    seed, rank, rules = 0, None, {}
+    for item in filter(None, (s.strip() for s in spec.split(';'))):
+        name, _, rhs = item.partition('=')
+        name = name.strip()
+        if name == 'seed':
+            seed = int(rhs)
+            continue
+        if name == 'rank':
+            rank = int(rhs)
+            continue
+        if name not in SITES:
+            raise ValueError('chaos spec: unknown site %r (one of %s)'
+                             % (name, '/'.join(SITES)))
+        when, _, argtxt = rhs.partition(':')
+        when = when.strip()
+        rule = Rule(name, arg=float(argtxt) if argtxt else None)
+        if when.startswith('@'):
+            rule.at = frozenset(int(x) for x in when[1:].split(','))
+        elif when.startswith('p'):
+            rule.prob = float(when[1:])
+            if not 0.0 <= rule.prob <= 1.0:
+                raise ValueError('chaos spec: probability %r out of '
+                                 '[0,1]' % when)
+        elif when == '*':
+            rule.always = True
+        else:
+            raise ValueError(
+                'chaos spec: bad WHEN %r for %s (use @i,j / pFLOAT / *)'
+                % (when, name))
+        rules[name] = rule
+    return seed, rank, rules
+
+
+class FaultInjector:
+    """Deterministic per-site fault scheduler.
+
+    ``fires(site)`` advances that site's occurrence counter and
+    returns the matching :class:`Rule` when the fault fires (else
+    ``None``).  ``log`` records every decision as
+    ``(site, occurrence, fired)`` -- the determinism tests replay two
+    injectors and assert identical logs.
+    """
+
+    def __init__(self, spec='', seed=None):
+        import random
+        pseed, self.rank, self.rules = parse_spec(spec)
+        self.seed = pseed if seed is None else seed
+        self.spec = spec
+        self._counts = {}
+        self._rngs = {
+            site: random.Random(
+                (self.seed & 0xffffffff) * 1000003
+                + zlib.crc32(site.encode()))
+            for site in self.rules}
+        self.log = []
+
+    def fires(self, site):
+        rule = self.rules.get(site)
+        if rule is None:
+            return None
+        idx = self._counts.get(site, 0)
+        self._counts[site] = idx + 1
+        if rule.prob is not None:
+            hit = self._rngs[site].random() < rule.prob
+        elif rule.at is not None:
+            hit = idx in rule.at
+        else:
+            hit = rule.always
+        self.log.append((site, idx, hit))
+        return rule if hit else None
+
+    def counts(self):
+        return dict(self._counts)
+
+
+# ----------------------------------------------------------------------
+# Module-level activation (the zero-cost-when-off switch)
+# ----------------------------------------------------------------------
+
+_active = None
+_env_checked = False
+
+
+def active():
+    """The installed :class:`FaultInjector`, or None."""
+    return _active
+
+
+def install(injector):
+    global _active
+    _active = injector
+    return injector
+
+
+def uninstall():
+    global _active, _env_checked
+    _active, _env_checked = None, False
+
+
+def maybe_install_from_env(env_var=ENV_VAR):
+    """Install an injector from ``CHAINERMN_TPU_CHAOS`` once per
+    process (no-op when unset, already checked, or the spec's
+    ``rank=`` does not match this process)."""
+    global _env_checked
+    if _active is not None or _env_checked:
+        return _active
+    _env_checked = True
+    spec = os.environ.get(env_var)
+    if not spec:
+        return None
+    inj = FaultInjector(spec)
+    if inj.rank is not None:
+        import jax
+        if jax.process_index() != inj.rank:
+            return None
+    return install(inj)
+
+
+# ----------------------------------------------------------------------
+# Hook points (called from communicators/base.py and training/updater)
+# Every hook is a no-op returning instantly when no injector is
+# installed; call sites additionally guard on ``chaos._active is not
+# None`` so the off path costs one attribute load.
+# ----------------------------------------------------------------------
+
+def before_send():
+    """p2p publish hooks: ``delay_send`` sleeps, ``drop_send`` raises
+    :class:`InjectedFault` (the bounded-retry loop in ``send_obj``
+    must absorb it)."""
+    inj = _active
+    if inj is None:
+        return
+    r = inj.fires('delay_send')
+    if r is not None:
+        time.sleep(r.arg if r.arg is not None else 0.05)
+    r = inj.fires('drop_send')
+    if r is not None:
+        raise InjectedFault('drop_send', inj._counts['drop_send'] - 1)
+
+
+def duplicate_send():
+    """True when the just-published message should be published again
+    (at-least-once duplicate)."""
+    inj = _active
+    return inj is not None and inj.fires('dup_send') is not None
+
+
+def before_kv_wait():
+    """``stall_kv``: sleep before a KV-store wait slice."""
+    inj = _active
+    if inj is None:
+        return
+    r = inj.fires('stall_kv')
+    if r is not None:
+        time.sleep(r.arg if r.arg is not None else 0.2)
+
+
+def on_recv():
+    """``kill_recv``: hard-kill this process at a recv_obj call."""
+    inj = _active
+    if inj is None:
+        return
+    r = inj.fires('kill_recv')
+    if r is not None:
+        os._exit(int(r.arg) if r.arg is not None else 42)
+
+
+def on_step(iteration):
+    """Per-train-step hooks: ``sigterm_step`` (graceful preemption --
+    the handler checkpoints and stops) and ``kill_step`` (hard
+    kill)."""
+    inj = _active
+    if inj is None:
+        return
+    r = inj.fires('sigterm_step')
+    if r is not None:
+        os.kill(os.getpid(), signal.SIGTERM)
+    r = inj.fires('kill_step')
+    if r is not None:
+        os._exit(int(r.arg) if r.arg is not None else 42)
+
+
+def corrupt_batch(arrays):
+    """``nan_batch``: poison the first ARG elements of the first
+    floating array of a host batch (tuple/list of numpy arrays) --
+    the resulting gradients are a NaN burst.  Returns the (possibly
+    new) batch; never mutates the caller's arrays."""
+    inj = _active
+    if inj is None:
+        return arrays
+    r = inj.fires('nan_batch')
+    if r is None:
+        return arrays
+    import numpy as np
+    out, poisoned = [], False
+    for a in arrays:
+        arr = np.asarray(a)
+        if not poisoned and arr.dtype.kind == 'f':
+            arr = np.array(arr, copy=True)
+            n = max(1, int(r.arg) if r.arg is not None else 1)
+            arr.reshape(-1)[:n] = np.nan
+            poisoned = True
+        out.append(arr)
+    return tuple(out) if isinstance(arrays, tuple) else out

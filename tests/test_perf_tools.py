@@ -549,3 +549,197 @@ def test_trustworthy_value_rejects_retracted_rows():
            'backend': 'tpu', 'value': 5.0}
     assert _trustworthy_value(mlp, 'mlp') == 5.0
     assert _trustworthy_value(mlp) is None  # wrong model prefix
+
+
+# ----------------------------------------------------------------------
+# retraction ledger (VERDICT r5 item 7)
+
+def test_retraction_ledger_flags_the_r2_ghost():
+    """The committed ledger must carry the r2 14,011 img/s retraction,
+    and _trustworthy_value must reject ANY row presenting that
+    (metric, value) pair -- the artifact itself (BENCH_r02.json) can
+    then be quoted by no automated reader."""
+    import bench
+    entries = bench.load_retraction_ledger()
+    assert any(e.get('value') == 14011.84
+               and e.get('metric')
+               == 'resnet50_train_images_per_sec_per_chip'
+               and e.get('retracted') for e in entries), entries
+    ghost = _rs_row(14011.84)  # no in-row flag: ledger must catch it
+    assert bench._trustworthy_value(ghost) is None
+    # the r2 ledger row itself parses and is rejected end to end
+    with open(os.path.join(REPO, 'BENCH_r02.json')) as f:
+        parsed = json.load(f)['parsed']
+    assert bench._trustworthy_value(parsed) is None
+    # a nearby-but-different value is untouched
+    assert bench._trustworthy_value(_rs_row(14011.0)) == 14011.0
+
+
+def test_retraction_ledger_missing_file_is_empty(tmp_path,
+                                                 monkeypatch):
+    import bench
+    monkeypatch.setattr(bench, '_RETRACTION_LEDGER', None)
+    monkeypatch.setattr(
+        bench.os.path, 'dirname',
+        lambda p, _real=bench.os.path.dirname:
+            str(tmp_path) if p == bench.os.path.abspath(bench.__file__)
+            else _real(p))
+    assert bench.load_retraction_ledger() == []
+    monkeypatch.setattr(bench, '_RETRACTION_LEDGER', None)
+
+
+# ----------------------------------------------------------------------
+# adoption fairness (ADVICE r5 #1/#2)
+
+def test_row_quickness_recorded_and_inferred():
+    from bench import _row_quickness
+    assert _row_quickness(_rs_row(1.0, quick=True)) == 'quick'
+    assert _row_quickness(_rs_row(1.0, quick=False)) == 'full'
+    # legacy rows: inferred from scan lengths
+    assert _row_quickness(_rs_row(1.0, scan_lengths=[2, 4, 6])) == \
+        'quick'
+    assert _row_quickness(_rs_row(1.0, scan_lengths=[4, 8, 12])) == \
+        'full'
+    assert _row_quickness(_rs_row(1.0)) is None
+
+
+def test_pick_tuned_only_crowns_against_matching_quickness():
+    from bench import _pick_tuned, pick_tuned_resnet50
+    # quick tuned winner vs full incumbent only: DECLINED -- the
+    # cross-quickness comparison is exactly the bias ADVICE r5 #1
+    # forbids
+    rows = [
+        _rs_row(2588.0, quick=False, _source='full_default.out'),
+        _rs_row(4100.0, override=128, quick=True,
+                _source='quick_b128.out'),
+    ]
+    d = _pick_tuned(rows)
+    assert d['flags'] is None and 'quickness' in d['declined']
+    assert pick_tuned_resnet50(rows) == (None, None, None)
+    # matching-quickness incumbent present: crowned, and the
+    # comparison provenance is recorded
+    rows.append(_rs_row(2500.0, quick=True,
+                        _source='quick_default.out'))
+    d = _pick_tuned(rows)
+    assert d['flags'] == ['--batch', '128']
+    assert d['incumbent_source'] == 'quick_default.out'
+    assert d['winner_quick'] == 'quick'
+    assert d['incumbent_quick'] == 'quick'
+    # unknown quickness (legacy rows) still matches anything
+    legacy = [_rs_row(2588.0), _rs_row(4100.0, override=128)]
+    assert pick_tuned_resnet50(legacy)[0] == ['--batch', '128']
+
+
+def test_pick_tuned_fallback_incumbent_and_decline():
+    from bench import _pick_tuned
+    tuned_only = [_rs_row(4100.0, override=128,
+                          _source='quick_b128.out')]
+    # no incumbent anywhere: DECLINE (the old behavior adopted
+    # uncompared -- ADVICE r5 #2's bug)
+    d = _pick_tuned(tuned_only)
+    assert d['flags'] is None and d.get('declined')
+    # fallback incumbent from an older tag: compared against it
+    older_default = _rs_row(4500.0, _source='old_default.out')
+    d = _pick_tuned(tuned_only, fallback_incumbent=older_default)
+    assert d['flags'] is None  # tuned row LOSES to the old default
+    assert d['incumbent_source'] == 'old_default.out'
+    assert d.get('incumbent_fallback') is True
+    slower_default = _rs_row(2500.0, _source='old_default.out')
+    d = _pick_tuned(tuned_only, fallback_incumbent=slower_default)
+    assert d['flags'] == ['--batch', '128']
+    assert d.get('incumbent_fallback') is True
+
+
+def test_adopt_declines_when_deciding_tag_has_no_incumbent(
+        tmp_path, monkeypatch):
+    import bench
+    res = tmp_path / 'benchmarks' / 'results'
+    res.mkdir(parents=True)
+    # newest tag holds ONLY a tuned row; the older tag's default row
+    # is the fallback incumbent and it BEATS the tuned value, so no
+    # adoption happens
+    (res / 'bench_resnet50_b128_r7.out').write_text(
+        json.dumps(_rs_row(4100.0, override=128)) + '\n')
+    (res / 'bench_resnet50_r6.out').write_text(
+        json.dumps(_rs_row(4500.0)) + '\n')
+    monkeypatch.setattr(
+        bench.os.path, 'dirname',
+        lambda p, _real=bench.os.path.dirname:
+            str(tmp_path) if p == bench.os.path.abspath(bench.__file__)
+            else _real(p))
+    monkeypatch.setenv('CHAINERMN_TPU_ADOPTED_FROM', 'sentinel')
+    monkeypatch.setenv('CHAINERMN_TPU_ADOPTED_COMPARISON', 'sentinel')
+    os.environ.pop('CHAINERMN_TPU_ADOPTED_FROM')
+    os.environ.pop('CHAINERMN_TPU_ADOPTED_COMPARISON')
+    assert bench.adopt_tuned_config([], 'resnet50') == []
+    assert 'CHAINERMN_TPU_ADOPTED_FROM' not in os.environ
+    # flip the older default below the tuned value: now adopted, with
+    # the fallback comparison recorded in the provenance env
+    (res / 'bench_resnet50_r6.out').write_text(
+        json.dumps(_rs_row(2500.0)) + '\n')
+    assert bench.adopt_tuned_config([], 'resnet50') == \
+        ['--batch', '128']
+    comp = json.loads(os.environ['CHAINERMN_TPU_ADOPTED_COMPARISON'])
+    assert comp['incumbent_fallback'] is True
+    assert comp['incumbent_source'] == 'bench_resnet50_r6.out'
+    assert comp['value'] == 4100.0
+
+
+# ----------------------------------------------------------------------
+# trace_report tolerant parsing + no-dirs stub (ADVICE r5 #3/#4)
+
+def test_trace_report_cell_float_tolerates_formatted_strings():
+    from benchmarks.trace_report import cell_float
+    assert cell_float(1234.5) == 1234.5
+    assert cell_float('1,234') == 1234.0
+    assert cell_float('56.2%') == 56.2
+    assert cell_float(' 7 ') == 7.0
+    assert cell_float('n/a') is None
+    assert cell_float(None) is None
+
+
+def test_trace_report_formatted_cells_survive_render(tmp_path,
+                                                     monkeypatch):
+    from benchmarks import trace_report as tr
+    table = _datatable(
+        ['category', 'hlo_op_name', 'occurrences', 'total_self_time',
+         'model_flop_rate', 'measured_memory_bw', 'dma_stall_percent'],
+        [
+            # formatted-string cells, exactly what crashed the
+            # standalone CLI (ADVICE r5 #3)
+            ['convolution', '%conv.1', 3, '5,000', '1,234', '300.5',
+             '2.5%'],
+            ['copy', '%copy.1', 1, '250', 'n/a', None, 'oops'],
+        ])
+    d = tmp_path / 'trace'
+    d.mkdir()
+    (d / 'vm.xplane.pb').write_bytes(b'\x00')
+    monkeypatch.setattr(tr, '_tool_tables',
+                        lambda paths, tool: [table])
+    rep = tr.analyze_trace(str(d))
+    assert rep['total_self_time_us'] == 5250.0
+    text = tr.render(rep)  # must not raise
+    assert '1234 GF/s' in text
+    # unparseable cells fall back to the raw value, never crash
+    assert "dma_stall_pct='oops'" in text
+
+
+def test_trace_report_no_dirs_writes_explanatory_stub(tmp_path,
+                                                      monkeypatch,
+                                                      capsys):
+    from benchmarks import trace_report as tr
+    res = tmp_path / 'results'
+    res.mkdir()
+    # a stale committed breakdown from an earlier capture...
+    (res / 'trace_report.json').write_text(
+        json.dumps({'buckets': {'conv/matmul': {}}}) + '\n')
+    monkeypatch.setattr(tr, 'RES', str(res))
+    assert tr.main(['--latest']) == 0
+    out = capsys.readouterr().out
+    assert 'no trace dirs' in out and 'stub' in out
+    # ...is REWRITTEN with the explanatory stub (ADVICE r5 #4)
+    rows = [json.loads(ln)
+            for ln in open(str(res / 'trace_report.json'))]
+    assert len(rows) == 1
+    assert rows[0]['error'] == 'no trace dirs found'
+    assert 'superseded' in rows[0]['detail']
