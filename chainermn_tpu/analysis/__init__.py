@@ -22,3 +22,4 @@ from chainermn_tpu.analysis.runner import (  # noqa
     build_report, lint_target, trace_target)
 from chainermn_tpu.analysis.targets import (  # noqa
     LintTarget, default_targets, step_targets, strategy_targets)
+from chainermn_tpu.analysis import memtraffic  # noqa

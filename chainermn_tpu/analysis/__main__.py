@@ -60,6 +60,12 @@ def main(argv=None):
                              'the policy -- proves the clean-sweep '
                              'guarantee holds for the narrowed '
                              'steps too')
+    parser.add_argument('--no-memtraffic', action='store_true',
+                        help='skip the HBM-traffic audit (per-target '
+                             'bytes-accessed / bytes-per-item / '
+                             'widest intermediates -- compiles each '
+                             'step target, the slow part of the '
+                             'sweep)')
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -96,6 +102,15 @@ def main(argv=None):
         policy=policy)
     report = analysis.build_report(targets, only=only,
                                    progress=progress)
+    if not args.no_memtraffic:
+        # HBM-traffic audit over the STEP targets (strategy targets
+        # move a synthetic 200-byte pytree; auditing them would be
+        # noise): cost-analysis bytes/step + bytes/item + the widest
+        # intermediates + the SL008 f32-materialization aggregate
+        from chainermn_tpu.analysis import memtraffic
+        report.memtraffic = memtraffic.report(
+            [t for t in targets if t.name.startswith('step:')],
+            progress=progress)
 
     if args.json:
         print(report.to_json())

@@ -45,6 +45,10 @@ class Report:
     def __init__(self):
         self.findings = []
         self.targets = []  # names, in lint order
+        # memtraffic rows (chainermn_tpu.analysis.memtraffic.report):
+        # per-target bytes-accessed / bytes-per-item / top widest
+        # intermediates; empty when the sweep skipped the audit
+        self.memtraffic = []
 
     def add(self, finding):
         self.findings.append(finding)
@@ -75,6 +79,7 @@ class Report:
             'n_warnings': len(self.warnings),
             'ok': self.ok(),
             'findings': [f.as_dict() for f in self.findings],
+            'memtraffic': list(self.memtraffic),
         }
 
     def to_json(self, indent=None):
@@ -84,6 +89,25 @@ class Report:
         lines = []
         for f in self.findings:
             lines.append(repr(f))
+        for row in self.memtraffic:
+            bits = []
+            if row.get('bytes_accessed'):
+                bits.append('%.1f MB accessed'
+                            % (row['bytes_accessed'] / 1e6))
+            if row.get('bytes_per_item'):
+                bits.append('%.2f MB/item'
+                            % (row['bytes_per_item'] / 1e6))
+            if row.get('f32_materialized_count'):
+                bits.append('%d f32 materializations (%.1f MB)'
+                            % (row['f32_materialized_count'],
+                               row['f32_materialized_bytes'] / 1e6))
+            if row.get('cost_error'):
+                bits.append('cost: %s' % row['cost_error'])
+            if row.get('trace_error'):
+                bits.append('trace: %s' % row['trace_error'])
+            lines.append('memtraffic %s: %s'
+                         % (row.get('target'),
+                            '; '.join(bits) or 'no data'))
         lines.append('shardlint: %d target(s), %d error(s), '
                      '%d warning(s)' % (len(self.targets),
                                         len(self.errors),

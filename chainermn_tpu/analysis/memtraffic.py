@@ -1,0 +1,206 @@
+"""HBM-traffic audit: turn PERF.md's hand-derived "~316 MB/img" into
+a tool every strategy and model can run.
+
+Two complementary views of one lint target's memory traffic:
+
+1. **XLA cost analysis** (:func:`cost_summary`): the compiled
+   executable's post-fusion ``bytes accessed`` / ``flops`` -- the
+   backend's own accounting of the step's memory traffic, divided
+   down to bytes/item when the target declares an item count.  On the
+   CPU backend this is the available stand-in for TPU HBM traffic
+   (VMEM-resident reuse is still counted, so absolute numbers read
+   high; deltas between two variants of the same step are the signal).
+
+2. **jaxpr materialization pressure** (:func:`jaxpr_traffic`): a
+   static, backend-independent walk of the traced step summing the
+   bytes of every intermediate the program writes, the top-k widest
+   intermediates (the tensors a fusion-shy backend would spill to
+   HBM), and -- the SL008 quantity -- the bytes of **f32
+   upcast-materialized intermediates in declared-bf16 compute
+   graphs**: ``convert_element_type`` equations widening a
+   >= ``min_bytes`` tensor, outside the sanctioned kernel layer.
+   This is where the fused-norm path's structural change shows
+   unconditionally: XLA's CPU fusion recovers much of the *runtime*
+   traffic either way, but the f32 activation materializations are
+   simply absent from the fused jaxpr.
+
+The CLI (``python -m chainermn_tpu.analysis --json``) attaches a
+``memtraffic`` section to the report; rule SL008
+(:mod:`chainermn_tpu.analysis.rules`) flags each f32 materialization
+as a warning-severity finding.
+"""
+
+import numpy as np
+
+from chainermn_tpu.analysis import walker
+
+#: an intermediate at least this big counts as "activation-sized"
+#: for the f32-materialization audit (statistics vectors and logits
+#: stay below it at every lint-target shape; per-device activations
+#: of the resnet50 step target sit above it)
+SL008_MIN_BYTES = 16 * 1024
+
+#: source-path fragment marking the sanctioned kernel layer: upcasts
+#: INSIDE chainermn_tpu/ops/ are kernel-internal (VMEM-local on the
+#: TPU Pallas path, never an HBM materialization boundary)
+KERNEL_LAYER_FRAGMENT = 'chainermn_tpu/ops/'
+
+#: narrow compute dtypes whose graphs the f32-materialization audit
+#: applies to
+NARROW_DTYPES = ('bfloat16', 'float16')
+
+
+def _aval_bytes(aval):
+    try:
+        size = int(np.prod([int(d) for d in aval.shape])) \
+            if aval.shape else 1
+        return size * np.dtype(aval.dtype).itemsize
+    except (TypeError, ValueError, AttributeError):
+        return 0
+
+
+def _in_kernel_layer(eqn, path):
+    """Equations from the hand-scheduled kernel layer are exempt from
+    the materialization audit: by source file (the kernel's reference
+    / backward math lives in ``chainermn_tpu/ops/``) or by enclosing
+    custom-derivative scope (the forward trace of a ``custom_vjp`` op
+    is one opaque kernel call on the real backend)."""
+    if any('custom' in p for p in path):
+        return True
+    where = walker.eqn_source(eqn)
+    return bool(where) and KERNEL_LAYER_FRAGMENT in \
+        where.replace('\\', '/')
+
+
+def param_shapes(jaxpr):
+    """Shapes of the traced step's own float32 inputs (parameters,
+    optimizer state, batch).  A widening convert whose OUTPUT matches
+    one of these is the master-weight pattern -- a bf16 weight
+    GRADIENT upcast back to the f32 master's dtype for the reduce /
+    optimizer update (the mixed-precision design working as declared,
+    ``docs/mixed_precision.md``) -- not an activation
+    materialization."""
+    out = set()
+    for var in walker.raw_jaxpr(jaxpr).invars:
+        aval = getattr(var, 'aval', None)
+        try:
+            if np.dtype(aval.dtype) == np.dtype('float32'):
+                out.add(tuple(int(d) for d in aval.shape))
+        except (TypeError, AttributeError):
+            continue
+    return out
+
+
+def f32_materializations(jaxpr, min_bytes=SL008_MIN_BYTES):
+    """Upcast-materialized wide intermediates: ``(eqn, bytes)`` for
+    every ``convert_element_type`` widening a >= ``min_bytes`` tensor
+    outside the kernel layer, excluding master-weight-shaped gradient
+    upcasts (see :func:`param_shapes`)."""
+    out = []
+    exempt = param_shapes(jaxpr)
+    for eqn, path in walker.iter_eqns(jaxpr):
+        if eqn.primitive.name != 'convert_element_type':
+            continue
+        src = eqn.invars[0].aval
+        dst = eqn.outvars[0].aval
+        try:
+            widens = (np.dtype(dst.dtype).itemsize
+                      > np.dtype(src.dtype).itemsize)
+        except TypeError:
+            continue
+        if not widens:
+            continue
+        nbytes = _aval_bytes(dst)
+        if nbytes < min_bytes:
+            continue
+        if tuple(int(d) for d in dst.shape) in exempt:
+            continue
+        if _in_kernel_layer(eqn, path):
+            continue
+        out.append((eqn, nbytes))
+    return out
+
+
+def jaxpr_traffic(jaxpr, top_k=8, min_bytes=SL008_MIN_BYTES):
+    """Static materialization-pressure summary of one traced step."""
+    inter_bytes = 0
+    widest = []
+    for eqn, path in walker.iter_eqns(jaxpr):
+        for var in eqn.outvars:
+            b = _aval_bytes(getattr(var, 'aval', None))
+            inter_bytes += b
+            if b >= min_bytes:
+                widest.append((b, eqn, path))
+    widest.sort(key=lambda t: -t[0])
+    top = [{
+        'bytes': b,
+        'op': eqn.primitive.name,
+        'shape': list(getattr(eqn.outvars[0].aval, 'shape', ())),
+        'dtype': str(getattr(eqn.outvars[0].aval, 'dtype', '?')),
+        'where': walker.eqn_source(eqn),
+        'scope': '/'.join(path) or 'top level',
+    } for b, eqn, path in widest[:top_k]]
+    f32_mat = f32_materializations(jaxpr, min_bytes=min_bytes)
+    return {
+        'jaxpr_intermediate_bytes': int(inter_bytes),
+        'top_intermediates': top,
+        'f32_materialized_bytes': int(sum(b for _, b in f32_mat)),
+        'f32_materialized_count': len(f32_mat),
+    }
+
+
+def cost_summary(fn, args):
+    """XLA cost analysis of the compiled target: ``{'bytes_accessed',
+    'flops'}`` (floats), or ``{'cost_error': ...}`` when lowering or
+    compiling fails (the static half of the report still stands)."""
+    import jax
+    try:
+        lower = fn.lower if hasattr(fn, 'lower') else \
+            jax.jit(fn).lower
+        cost = lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        cost = dict(cost or {})
+        return {'bytes_accessed': float(cost.get('bytes accessed',
+                                                 0.0)),
+                'flops': float(cost.get('flops', 0.0))}
+    except Exception as e:  # backend-dependent; never kill the sweep
+        return {'cost_error': '%s: %s'
+                % (type(e).__name__,
+                   str(e).splitlines()[0] if str(e) else '')}
+
+
+def audit_target(target, top_k=8, compile_costs=True):
+    """One memtraffic report row for one
+    :class:`chainermn_tpu.analysis.targets.LintTarget`."""
+    import jax
+
+    row = {'target': target.name}
+    try:
+        jaxpr = jax.make_jaxpr(target.fn)(*target.args)
+    except Exception as e:
+        row['trace_error'] = '%s: %s' % (
+            type(e).__name__,
+            str(e).splitlines()[0] if str(e) else '')
+        return row
+    row.update(jaxpr_traffic(jaxpr, top_k=top_k))
+    if compile_costs:
+        row.update(cost_summary(target.fn, target.args))
+        items = getattr(target, 'items', None)
+        if items and row.get('bytes_accessed'):
+            row['items_per_step'] = items
+            row['bytes_per_item'] = round(
+                row['bytes_accessed'] / items, 1)
+    return row
+
+
+def report(targets, top_k=8, compile_costs=True, progress=None):
+    """Memtraffic rows for every target (the CLI's ``memtraffic``
+    report section)."""
+    rows = []
+    for target in targets:
+        if progress is not None:
+            progress('memtraffic:%s' % target.name)
+        rows.append(audit_target(target, top_k=top_k,
+                                 compile_costs=compile_costs))
+    return rows

@@ -34,17 +34,24 @@ class RuleContext:
         empty means any narrowing is a finding.
       signatures: list of abstract signatures of two synthetic
         consecutive steps (None for single-shot targets).
+      compute_dtype: the dtype name the target DECLARES its compute
+        runs in (a mixed-precision policy's compute dtype, or a
+        model's native compute dtype); SL008 audits f32
+        materializations only in declared-narrow graphs.  None
+        disables that rule.
       trace_error: exception raised while tracing, if any.
     """
 
     def __init__(self, target_name, jaxpr=None, mesh_axes=None,
                  reduction_axes=None, signatures=None,
-                 trace_error=None, declared_dtypes=None):
+                 trace_error=None, declared_dtypes=None,
+                 compute_dtype=None):
         self.target_name = target_name
         self.jaxpr = jaxpr
         self.mesh_axes = dict(mesh_axes or {})
         self.reduction_axes = reduction_axes
         self.declared_dtypes = declared_dtypes
+        self.compute_dtype = compute_dtype
         self.signatures = signatures
         self.trace_error = trace_error
 
@@ -322,6 +329,40 @@ def rule_recompilation(ctx):
     return out
 
 
+# ---------------------------------------------------------------------
+# SL008: no f32-materialized activation-sized intermediates inside a
+# declared-narrow (bf16/f16) compute graph.  An upcast that widens an
+# activation-sized tensor doubles its HBM footprint ON TOP of the
+# narrow original -- exactly the materialized-intermediate traffic
+# PERF.md's batch sweep diagnosed around the BN/relu/add interludes.
+# The sanctioned kernel layer (chainermn_tpu/ops/, and anything under
+# a custom-derivative scope) is exempt: its upcasts are VMEM-local on
+# the TPU Pallas path.  WARNING severity: flax-oracle paths upcast by
+# design (the finding is the chase list, not a gate failure); the
+# fused-norm step is the clean state.
+def rule_f32_materialization(ctx):
+    from chainermn_tpu.analysis import memtraffic
+
+    out = []
+    if ctx.jaxpr is None or ctx.compute_dtype is None:
+        return out
+    if str(ctx.compute_dtype) not in memtraffic.NARROW_DTYPES:
+        return out
+    for eqn, nbytes in memtraffic.f32_materializations(ctx.jaxpr):
+        src = eqn.invars[0].aval
+        dst = eqn.outvars[0].aval
+        out.append(ctx.finding(
+            'SL008', SEV_WARNING,
+            '%s%s upcast to %s materialized (%.2f MB) in a '
+            'declared-%s compute graph: activation-sized f32 '
+            'intermediates are the HBM-traffic excess the fused '
+            'kernel path (fused_norm=True / ops.batch_norm_act) '
+            'removes'
+            % (src.dtype, list(dst.shape), dst.dtype, nbytes / 1e6,
+               ctx.compute_dtype), eqn))
+    return out
+
+
 #: rule id -> (callable, one-line description)
 RULES = {
     'SL001': (rule_axis_topology,
@@ -342,6 +383,10 @@ RULES = {
     'SL007': (rule_recompilation,
               'abstract step signature is stable across iterations '
               '(no recompilation leak)'),
+    'SL008': (rule_f32_materialization,
+              'no f32-materialized activation-sized intermediates '
+              'inside declared-bf16/f16 compute graphs (outside the '
+              'kernel layer)'),
 }
 
 

@@ -28,13 +28,20 @@ class LintTarget:
       declared_dtypes: dtype names the target declares reductions may
         narrow to (the ``declared_reduce_dtypes`` introspection hook
         on communicators/updaters; SL004 allows these), else None.
+      compute_dtype: the dtype name the target's compute is DECLARED
+        to run in (policy compute dtype or a model's native dtype);
+        enables the SL008 f32-materialization audit when narrow.
+      items: items (images/tokens) one step of this target processes;
+        the memtraffic report divides bytes-accessed down to
+        bytes/item with it.  None for non-step targets.
       make_args: ``make_args(iteration) -> args`` for targets with an
         iteration-dependent signature (recompilation rule); None
         disables that rule.
     """
 
     def __init__(self, name, fn, args, mesh_axes, reduction_axes=None,
-                 make_args=None, declared_dtypes=None):
+                 make_args=None, declared_dtypes=None,
+                 compute_dtype=None, items=None):
         self.name = name
         self.fn = fn
         self.args = tuple(args)
@@ -42,6 +49,8 @@ class LintTarget:
         self.reduction_axes = reduction_axes
         self.declared_dtypes = (tuple(sorted(declared_dtypes))
                                 if declared_dtypes else None)
+        self.compute_dtype = compute_dtype
+        self.items = items
         self.make_args = make_args
 
     def __repr__(self):
@@ -126,14 +135,22 @@ def _data_comm():
         'xla', mesh_shape=mesh_utility.balanced_2d(n))
 
 
-def _updater_target(name, updater, batch, mesh_axes):
+def _updater_target(name, updater, batch, mesh_axes,
+                    compute_dtype=None, items=None):
     fn, args = updater.traceable_step(batch, iteration=1)
     declared = getattr(updater, 'declared_reduce_dtypes',
                        lambda: None)()
     return LintTarget(
         name, fn, args, mesh_axes, declared_dtypes=declared,
+        compute_dtype=compute_dtype, items=items,
         make_args=lambda it: updater.traceable_step(
             batch, iteration=it)[1])
+
+
+def _policy_compute(policy):
+    """The compute dtype a policy declares for a step target (the
+    SL008 audit scope), or None without a policy."""
+    return str(policy.compute_dtype) if policy is not None else None
 
 
 def _policy_batch(policy, batch):
@@ -167,7 +184,9 @@ def mlp_step_target(comm=None, policy=None):
         jnp.zeros((16, 784), jnp.float32),
         jnp.zeros((16,), jnp.int32)))
     return _updater_target('step:mlp_example', updater, batch,
-                           dict(comm.mesh.shape))
+                           dict(comm.mesh.shape),
+                           compute_dtype=_policy_compute(policy),
+                           items=16)
 
 
 def zero_step_target(comm=None, policy=None):
@@ -188,7 +207,9 @@ def zero_step_target(comm=None, policy=None):
         jnp.zeros((16, 784), jnp.float32),
         jnp.zeros((16,), jnp.int32)))
     return _updater_target('step:zero', updater, batch,
-                           dict(comm.mesh.shape))
+                           dict(comm.mesh.shape),
+                           compute_dtype=_policy_compute(policy),
+                           items=16)
 
 
 def zero_core_target(comm=None):
@@ -233,13 +254,19 @@ def pipeline_step_target(policy=None):
         jnp.zeros((4 * n_data, d), jnp.float32),
         jnp.zeros((4 * n_data, d), jnp.float32)))
     return _updater_target('step:pipeline', updater, batch,
-                           dict(mesh.shape))
+                           dict(mesh.shape),
+                           compute_dtype=_policy_compute(policy),
+                           items=4 * n_data)
 
 
-def resnet50_step_target(comm=None, insize=32, batch=8, policy=None):
+def resnet50_step_target(comm=None, insize=32, batch=8, policy=None,
+                         fused_norm=False):
     """The imagenet example's train step (``examples/imagenet``):
     ResNet-50 with BatchNorm state, dropout RNG plumbing and
-    cross-replica statistics sync."""
+    cross-replica statistics sync.  ``fused_norm=True`` lints the
+    fused ``batch_norm_act`` variant of the same step (the SL008 /
+    memtraffic A/B pair -- the model computes bf16-native either
+    way, so both declare ``compute_dtype='bfloat16'``)."""
     import optax
     import chainermn_tpu
     from chainermn_tpu import training
@@ -247,7 +274,7 @@ def resnet50_step_target(comm=None, insize=32, batch=8, policy=None):
     from chainermn_tpu.models.resnet50 import ResNet50
 
     comm = comm or _data_comm()
-    model = ResNet50(num_classes=10)
+    model = ResNet50(num_classes=10, fused_norm=fused_norm)
     x0 = jnp.zeros((1, insize, insize, 3), jnp.float32)
     variables = model.init({'params': jax.random.PRNGKey(0)}, x0,
                            train=False)
@@ -263,8 +290,10 @@ def resnet50_step_target(comm=None, insize=32, batch=8, policy=None):
     arrays = _policy_batch(policy, (
         jnp.zeros((batch, insize, insize, 3), jnp.float32),
         jnp.zeros((batch,), jnp.int32)))
-    return _updater_target('step:resnet50_example', updater, arrays,
-                           dict(comm.mesh.shape))
+    name = 'step:resnet50_%s' % ('fused' if fused_norm else 'example')
+    return _updater_target(name, updater, arrays,
+                           dict(comm.mesh.shape),
+                           compute_dtype='bfloat16', items=batch)
 
 
 def step_targets(include_resnet50=True, policy=None):
@@ -272,7 +301,12 @@ def step_targets(include_resnet50=True, policy=None):
            zero_step_target(policy=policy),
            pipeline_step_target(policy=policy)]
     if include_resnet50:
+        # unfused (flax-oracle) AND fused train steps: the SL008 /
+        # memtraffic A/B pair ci/run_staticcheck.sh sweeps in both
+        # precisions
         out.append(resnet50_step_target(policy=policy))
+        out.append(resnet50_step_target(policy=policy,
+                                        fused_norm=True))
     return out
 
 

@@ -1,0 +1,110 @@
+"""Shared norm+activation layer for the conv zoo.
+
+Every zoo model routes its ``BatchNorm -> relu (-> +residual)``
+interludes (and, for the norm-free models, its bare activations)
+through :func:`norm_act`, so ONE switch -- the models'
+``fused_norm=`` flag -- selects between:
+
+- the stock ``flax.linen.BatchNorm`` + ``jax.nn.relu`` composition
+  (the numerics ORACLE: this path is what the fused kernel is pinned
+  against, and what ``CHAINERMN_TPU_PALLAS=0`` A/B runs measure); and
+- :class:`NormAct`, which drives the fused
+  :func:`chainermn_tpu.ops.batch_norm_act` Pallas kernel -- one HBM
+  pass for normalize + affine + residual add + relu, f32 statistics
+  over bf16 activations, and a backward that recomputes the
+  normalized value instead of materializing it (PERF.md's
+  "conv+BN+relu Pallas fusion" knob).
+
+Both paths register IDENTICAL variable trees (``scale``/``bias``
+params, ``batch_stats`` ``mean``/``var``, under the same module
+name), so checkpoints and init are interchangeable: init once,
+apply under either flag.
+"""
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from chainermn_tpu.ops.batch_norm_act import (
+    batch_norm_act, batch_norm_act_inference)
+
+
+class NormAct(nn.Module):
+    """Fused-kernel twin of ``nn.BatchNorm`` (+ relu + residual add).
+
+    Same variable layout as ``flax.linen.BatchNorm`` (``scale`` /
+    ``bias`` params in ``param_dtype``, f32 ``batch_stats``
+    ``mean`` / ``var``, same ``momentum`` running-average update), so
+    a module named like the BatchNorm it replaces is checkpoint- and
+    init-compatible with it.
+    """
+
+    use_running_average: bool = False
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    relu: bool = True
+    scale_init: Callable = nn.initializers.ones
+    bias_init: Callable = nn.initializers.zeros
+
+    @nn.compact
+    def __call__(self, x, residual=None):
+        features = x.shape[-1]
+        scale = self.param('scale', self.scale_init, (features,),
+                           self.param_dtype)
+        bias = self.param('bias', self.bias_init, (features,),
+                          self.param_dtype)
+        ra_mean = self.variable(
+            'batch_stats', 'mean',
+            lambda s: jnp.zeros(s, jnp.float32), (features,))
+        ra_var = self.variable(
+            'batch_stats', 'var',
+            lambda s: jnp.ones(s, jnp.float32), (features,))
+        if self.use_running_average:
+            return batch_norm_act_inference(
+                x, scale, bias, ra_mean.value, ra_var.value,
+                eps=self.epsilon, residual=residual, relu=self.relu)
+        out, mean, var = batch_norm_act(
+            x, scale, bias, eps=self.epsilon, residual=residual,
+            relu=self.relu)
+        if not self.is_initializing():
+            m = self.momentum
+            ra_mean.value = m * ra_mean.value + (1.0 - m) * mean
+            ra_var.value = m * ra_var.value + (1.0 - m) * var
+        return out
+
+
+def norm_act(x, *, train, fused, dtype, name, residual=None,
+             relu=True, use_norm=True, momentum=0.9, epsilon=1e-5,
+             scale_init=nn.initializers.ones):
+    """The zoo models' one norm+activation entry point.
+
+    Must be called from inside a parent module's ``@nn.compact``
+    ``__call__``.  ``name`` is REQUIRED for normed layers so the
+    fused and unfused paths register the same module name (pass the
+    name flax auto-numbering would have chosen, e.g.
+    ``'BatchNorm_0'``, to keep existing checkpoints loadable).
+
+    ``use_norm=False`` (VGG/NIN: activation-only models) skips the
+    norm entirely -- the residual add and relu still run here so the
+    call sites stay uniform; ``fused`` is a no-op without a norm
+    (XLA already fuses a bare add+relu).
+    """
+    if not use_norm:
+        y = x if residual is None else x + residual
+        return nn.relu(y) if relu else y
+    if fused:
+        return NormAct(use_running_average=not train,
+                       momentum=momentum, epsilon=epsilon,
+                       dtype=dtype, param_dtype=jnp.float32,
+                       relu=relu, scale_init=scale_init,
+                       name=name)(x, residual)
+    y = nn.BatchNorm(use_running_average=not train, momentum=momentum,
+                     epsilon=epsilon, dtype=dtype,
+                     param_dtype=jnp.float32, scale_init=scale_init,
+                     name=name)(x)
+    if residual is not None:
+        y = y + residual
+    return nn.relu(y) if relu else y

@@ -2,13 +2,21 @@
 ``examples/imagenet/models_v2/googlenetbn.py``, BASELINE config 5:
 multi-branch gradients stressing node-aware reduction).  Inception
 branches use 3x3 factorization + BatchNorm as in the reference's
-``InceptionBN``."""
+``InceptionBN``.
+
+Every conv->BN->relu triple routes through
+:func:`chainermn_tpu.models._norm.norm_act`; ``fused_norm=True``
+selects the fused ``batch_norm_act`` Pallas pass (explicit
+``BatchNorm_N`` module names reproduce flax's auto-numbering, so both
+paths share one variable tree)."""
 
 from functools import partial
 from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from chainermn_tpu.models._norm import norm_act
 
 
 class InceptionBN(nn.Module):
@@ -23,18 +31,21 @@ class InceptionBN(nn.Module):
     pool: str = 'avg'  # 'avg' | 'max'
     stride: int = 1
     dtype: Any = jnp.bfloat16
+    fused_norm: bool = False
 
     @nn.compact
     def __call__(self, x, train=True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=jnp.float32)
+        # explicit names replay flax's auto-numbering (norm creation
+        # order == cbr call order), keeping fused/unfused trees equal
+        counter = iter(range(16))
 
         def cbr(y, feats, kernel, stride=1, pad='SAME'):
             y = conv(feats, kernel, strides=(stride, stride),
                      padding=pad)(y)
-            return nn.relu(norm()(y))
+            return norm_act(y, train=train, fused=self.fused_norm,
+                            dtype=self.dtype,
+                            name='BatchNorm_%d' % next(counter))
 
         s = self.stride
         branches = []
@@ -57,33 +68,35 @@ class GoogLeNetBN(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     insize: int = 224
+    fused_norm: bool = False
 
     @nn.compact
     def __call__(self, x, train=True):
         d = self.dtype
         conv = partial(nn.Conv, use_bias=False, dtype=d)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=d,
-                       param_dtype=jnp.float32)
+        na = partial(norm_act, train=train, fused=self.fused_norm,
+                     dtype=d)
+        inception = partial(InceptionBN, dtype=d,
+                            fused_norm=self.fused_norm)
         x = x.astype(d)
-        x = nn.relu(norm()(conv(64, (7, 7), strides=(2, 2),
-                                padding=3)(x)))
+        x = na(conv(64, (7, 7), strides=(2, 2), padding=3)(x),
+               name='BatchNorm_0')
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
-        x = nn.relu(norm()(conv(192, (3, 3), padding=1)(x)))
+        x = na(conv(192, (3, 3), padding=1)(x), name='BatchNorm_1')
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
-        x = InceptionBN(64, 64, 64, 64, 96, 32, dtype=d)(x, train)
-        x = InceptionBN(64, 64, 96, 64, 96, 64, dtype=d)(x, train)
-        x = InceptionBN(0, 128, 160, 64, 96, 0, pool='max', stride=2,
-                        dtype=d)(x, train)
-        x = InceptionBN(224, 64, 96, 96, 128, 128, dtype=d)(x, train)
-        x = InceptionBN(192, 96, 128, 96, 128, 128, dtype=d)(x, train)
-        x = InceptionBN(160, 128, 160, 128, 160, 128, dtype=d)(x, train)
-        x = InceptionBN(96, 128, 192, 160, 192, 128, dtype=d)(x, train)
-        x = InceptionBN(0, 128, 192, 192, 256, 0, pool='max', stride=2,
-                        dtype=d)(x, train)
-        x = InceptionBN(352, 192, 320, 160, 224, 128, dtype=d)(x, train)
-        x = InceptionBN(352, 192, 320, 192, 224, 128, pool='max',
-                        dtype=d)(x, train)
+        x = inception(64, 64, 64, 64, 96, 32)(x, train)
+        x = inception(64, 64, 96, 64, 96, 64)(x, train)
+        x = inception(0, 128, 160, 64, 96, 0, pool='max', stride=2)(
+            x, train)
+        x = inception(224, 64, 96, 96, 128, 128)(x, train)
+        x = inception(192, 96, 128, 96, 128, 128)(x, train)
+        x = inception(160, 128, 160, 128, 160, 128)(x, train)
+        x = inception(96, 128, 192, 160, 192, 128)(x, train)
+        x = inception(0, 128, 192, 192, 256, 0, pool='max', stride=2)(
+            x, train)
+        x = inception(352, 192, 320, 160, 224, 128)(x, train)
+        x = inception(352, 192, 320, 192, 224, 128, pool='max')(
+            x, train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
         return x.astype(jnp.float32)

@@ -1,22 +1,36 @@
 """Network-in-Network (reference ``examples/imagenet/models_v2/nin.py``,
-insize 227: 4 mlpconv stacks, global average pool head)."""
+insize 227: 4 mlpconv stacks, global average pool head).
+
+Norm-free model: activations route through the zoo's shared
+:func:`chainermn_tpu.models._norm.norm_act` helper with
+``use_norm=False``, so ``fused_norm`` is accepted for zoo API parity
+and is a no-op here."""
 
 from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
 
+from chainermn_tpu.models._norm import norm_act
+
 
 class NIN(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     insize: int = 227
+    fused_norm: bool = False  # accepted for zoo API parity; no norm
 
-    def _mlpconv(self, x, features, kernel, stride, pad):
-        x = nn.relu(nn.Conv(features, kernel, strides=stride, padding=pad,
-                            dtype=self.dtype)(x))
-        x = nn.relu(nn.Conv(features, (1, 1), dtype=self.dtype)(x))
-        x = nn.relu(nn.Conv(features, (1, 1), dtype=self.dtype)(x))
+    def _act(self, x, train):
+        return norm_act(x, train=train, fused=self.fused_norm,
+                        dtype=self.dtype, name=None, use_norm=False)
+
+    def _mlpconv(self, x, features, kernel, stride, pad, train):
+        x = self._act(nn.Conv(features, kernel, strides=stride,
+                              padding=pad, dtype=self.dtype)(x), train)
+        x = self._act(nn.Conv(features, (1, 1), dtype=self.dtype)(x),
+                      train)
+        x = self._act(nn.Conv(features, (1, 1), dtype=self.dtype)(x),
+                      train)
         return x
 
     @nn.compact
@@ -29,13 +43,14 @@ class NIN(nn.Module):
                 'NIN needs input >= 68x68 (canonical %d), got %r'
                 % (self.insize, x.shape[1:3]))
         x = x.astype(self.dtype)
-        x = self._mlpconv(x, 96, (11, 11), (4, 4), 'VALID')
+        x = self._mlpconv(x, 96, (11, 11), (4, 4), 'VALID', train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = self._mlpconv(x, 256, (5, 5), (1, 1), 2)
+        x = self._mlpconv(x, 256, (5, 5), (1, 1), 2, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        x = self._mlpconv(x, 384, (3, 3), (1, 1), 1)
+        x = self._mlpconv(x, 384, (3, 3), (1, 1), 1, train)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         x = nn.Dropout(0.5, deterministic=not train)(x)
-        x = self._mlpconv(x, self.num_classes, (3, 3), (1, 1), 1)
+        x = self._mlpconv(x, self.num_classes, (3, 3), (1, 1), 1,
+                          train)
         x = jnp.mean(x, axis=(1, 2))  # global average pooling head
         return x.astype(jnp.float32)

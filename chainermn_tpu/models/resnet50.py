@@ -15,33 +15,42 @@ from typing import Any, Sequence
 import flax.linen as nn
 import jax.numpy as jnp
 
+from chainermn_tpu.models._norm import norm_act
+
 
 class Bottleneck(nn.Module):
-    """1x1 -> 3x3 -> 1x1 bottleneck (reference ``BottleNeckA``/``B``)."""
+    """1x1 -> 3x3 -> 1x1 bottleneck (reference ``BottleNeckA``/``B``).
+
+    ``fused_norm=True`` routes every BN+relu (and the final
+    BN+add+relu) through the fused ``batch_norm_act`` kernel via
+    :func:`chainermn_tpu.models._norm.norm_act`; module names match
+    flax's auto-numbering, so variables are interchangeable between
+    the two paths."""
     features: int
     stride: int = 1
     dtype: Any = jnp.bfloat16
+    fused_norm: bool = False
 
     @nn.compact
     def __call__(self, x, train=True):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=jnp.float32)
+        norm = partial(norm_act, train=train, fused=self.fused_norm,
+                       dtype=self.dtype)
         residual = x
         y = conv(self.features, (1, 1))(x)
-        y = nn.relu(norm()(y))
+        y = norm(y, name='BatchNorm_0')
         y = conv(self.features, (3, 3), strides=(self.stride,
                                                  self.stride))(y)
-        y = nn.relu(norm()(y))
+        y = norm(y, name='BatchNorm_1')
         y = conv(self.features * 4, (1, 1))(y)
-        y = norm(scale_init=nn.initializers.zeros)(y)
         if residual.shape != y.shape:
             residual = conv(self.features * 4, (1, 1),
                             strides=(self.stride, self.stride),
                             name='proj')(residual)
-            residual = norm(name='proj_bn')(residual)
-        return nn.relu(y + residual)
+            residual = norm(residual, name='proj_bn', relu=False)
+        # BN (zero-init scale) + shortcut add + relu: ONE fused pass
+        return norm(y, name='BatchNorm_2', residual=residual,
+                    scale_init=nn.initializers.zeros)
 
 
 class ResNet(nn.Module):
@@ -60,6 +69,9 @@ class ResNet(nn.Module):
     dtype: Any = jnp.bfloat16
     insize: int = 224  # reference resnet50.py insize=224
     stem: str = 'standard'
+    # fused BN+relu(+add) Pallas path (chainermn_tpu/ops/
+    # batch_norm_act.py); False keeps the flax nn.BatchNorm oracle
+    fused_norm: bool = False
 
     @nn.compact
     def __call__(self, x, train=True):
@@ -85,25 +97,26 @@ class ResNet(nn.Module):
         else:
             raise ValueError("stem must be 'standard' or "
                              "'space_to_depth', got %r" % (self.stem,))
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype,
-                         param_dtype=jnp.float32, name='bn_init')(x)
-        x = nn.relu(x)
+        x = norm_act(x, train=train, fused=self.fused_norm,
+                     dtype=self.dtype, name='bn_init')
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding='SAME')
         for i, block_count in enumerate(self.stage_sizes):
             for j in range(block_count):
                 stride = 2 if i > 0 and j == 0 else 1
                 x = Bottleneck(self.width * 2 ** i, stride=stride,
-                               dtype=self.dtype)(x, train=train)
+                               dtype=self.dtype,
+                               fused_norm=self.fused_norm)(
+                                   x, train=train)
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=jnp.float32,
                      param_dtype=jnp.float32, name='fc')(x)
         return x.astype(jnp.float32)
 
 
-def ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem='standard'):
+def ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem='standard',
+             fused_norm=False):
     return ResNet(stage_sizes=[3, 4, 6, 3], num_classes=num_classes,
-                  dtype=dtype, stem=stem)
+                  dtype=dtype, stem=stem, fused_norm=fused_norm)
 
 
 def convert_stem_variables(variables):
@@ -150,11 +163,11 @@ def s2d_stem_kernel(w7):
     return w4
 
 
-def ResNet101(num_classes=1000, dtype=jnp.bfloat16):
+def ResNet101(num_classes=1000, dtype=jnp.bfloat16, fused_norm=False):
     return ResNet(stage_sizes=[3, 4, 23, 3], num_classes=num_classes,
-                  dtype=dtype)
+                  dtype=dtype, fused_norm=fused_norm)
 
 
-def ResNet152(num_classes=1000, dtype=jnp.bfloat16):
+def ResNet152(num_classes=1000, dtype=jnp.bfloat16, fused_norm=False):
     return ResNet(stage_sizes=[3, 8, 36, 3], num_classes=num_classes,
-                  dtype=dtype)
+                  dtype=dtype, fused_norm=fused_norm)

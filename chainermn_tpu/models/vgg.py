@@ -1,10 +1,18 @@
 """VGG-16 (BASELINE config 3: the tensor-fusion stress workload --
-~138M parameters in a handful of huge tensors)."""
+~138M parameters in a handful of huge tensors).
+
+No BatchNorm in the canonical VGG-16: conv activations still route
+through the zoo's shared :func:`chainermn_tpu.models._norm.norm_act`
+helper (``use_norm=False``) so the ``fused_norm`` constructor flag is
+uniform across the conv zoo -- here it is accepted and a no-op (XLA
+already fuses a bare relu into the conv)."""
 
 from typing import Any, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+from chainermn_tpu.models._norm import norm_act
 
 _VGG16 = (2, 2, 3, 3, 3)
 _WIDTHS = (64, 128, 256, 512, 512)
@@ -15,14 +23,18 @@ class VGG(nn.Module):
     num_classes: int = 1000
     dtype: Any = jnp.bfloat16
     insize: int = 224
+    fused_norm: bool = False  # accepted for zoo API parity; no norm
 
     @nn.compact
     def __call__(self, x, train=True):
         x = x.astype(self.dtype)
         for n, width in zip(self.stage_sizes, _WIDTHS):
             for _ in range(n):
-                x = nn.relu(nn.Conv(width, (3, 3), padding=1,
-                                    dtype=self.dtype)(x))
+                x = norm_act(nn.Conv(width, (3, 3), padding=1,
+                                     dtype=self.dtype)(x),
+                             train=train, fused=self.fused_norm,
+                             dtype=self.dtype, name=None,
+                             use_norm=False)
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.reshape((x.shape[0], -1))
         x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
@@ -33,5 +45,6 @@ class VGG(nn.Module):
         return x.astype(jnp.float32)
 
 
-def VGG16(num_classes=1000, dtype=jnp.bfloat16):
-    return VGG(num_classes=num_classes, dtype=dtype)
+def VGG16(num_classes=1000, dtype=jnp.bfloat16, fused_norm=False):
+    return VGG(num_classes=num_classes, dtype=dtype,
+               fused_norm=fused_norm)

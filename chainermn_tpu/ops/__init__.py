@@ -20,4 +20,6 @@ from chainermn_tpu.ops.flash_attention import (  # noqa
 from chainermn_tpu.ops.cross_entropy import (  # noqa
     softmax_cross_entropy, softmax_cross_entropy_reference)
 from chainermn_tpu.ops.layer_norm import layer_norm, layer_norm_reference  # noqa
+from chainermn_tpu.ops.batch_norm_act import (  # noqa
+    batch_norm_act, batch_norm_act_inference, batch_norm_act_reference)
 from chainermn_tpu.ops.optimizer import fused_momentum_sgd, momentum_sgd  # noqa

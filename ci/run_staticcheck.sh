@@ -11,7 +11,42 @@
 # clean-sweep guarantee covers both precisions, and SL004's
 # declared-reduce-dtype allowance is exercised for real, not just in
 # fixtures (docs/mixed_precision.md).
+#
+# Each sweep also carries the HBM-traffic audit (docs/kernels.md):
+# the memtraffic report (bytes-accessed / bytes-per-item / widest
+# intermediates) over every step target, and rule SL008 flagging f32
+# activation materializations in declared-bf16 graphs.  The check
+# below pins the gate's structural claims: both resnet50 variants
+# (flax-oracle AND fused batch_norm_act) are audited, and the FUSED
+# step materializes zero f32 activation-sized intermediates.
 set -euo pipefail
 cd "$(dirname "$0")/.."
-JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json
-JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16
+
+check_memtraffic() {
+  python -c "
+import json, sys
+report = json.load(open(sys.argv[1]))
+rows = {r['target']: r for r in report.get('memtraffic', [])}
+for target in ('step:resnet50_example', 'step:resnet50_fused'):
+    assert target in rows, 'memtraffic row missing: %s' % target
+    assert rows[target].get('bytes_accessed') or \
+        rows[target].get('cost_error'), rows[target]
+fused = rows['step:resnet50_fused']
+assert fused['f32_materialized_count'] == 0, fused
+unfused = rows['step:resnet50_example']
+assert unfused['f32_materialized_bytes'] > \
+    fused['f32_materialized_bytes'], (unfused, fused)
+print('memtraffic OK: unfused %.2f MB f32-materialized -> fused %d'
+      % (unfused['f32_materialized_bytes'] / 1e6,
+         fused['f32_materialized_bytes']))
+" "$1"
+}
+
+out_f32=$(mktemp)
+out_bf16=$(mktemp)
+trap 'rm -f "$out_f32" "$out_bf16"' EXIT
+
+JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json | tee "$out_f32"
+check_memtraffic "$out_f32"
+JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16 | tee "$out_bf16"
+check_memtraffic "$out_bf16"

@@ -219,6 +219,11 @@ run bench_resnet50_s2d_b128 $QT python bench.py --quick --s2d --batch 128
 # f32-master headline -- rows carry the policy dtypes, so the pair is
 # self-describing in the banked artifacts (docs/mixed_precision.md)
 run bench_resnet50_bf16 $QT python bench.py --quick --policy bf16
+# fused BN+relu+add Pallas arm (docs/kernels.md): the direct attack
+# on the HBM-bandwidth wall the r5 batch sweep diagnosed -- rows
+# carry fused_norm/hbm_bytes_per_image/pct_of_hbm_peak, so the A/B
+# against bench_resnet50_bf16 is self-describing in the artifacts
+run bench_resnet50_fused $QT python bench.py --quick --policy bf16 --fused-norm
 
 # end-of-sweep headline rerun: a PLAIN bench.py invocation adopts the
 # sweep winner just banked above (bench.py:adopt_tuned_config), so the

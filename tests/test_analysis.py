@@ -317,14 +317,29 @@ def test_step_targets_lint_clean():
 
 @pytest.mark.slow
 def test_resnet50_step_lints_clean():
+    # the flax-oracle (unfused) step upcasts activations by design:
+    # SL008 flags each one as a WARNING (the chase list), never an
+    # error -- and no OTHER rule fires
     target = targets_mod.resnet50_step_target()
+    findings = analysis.lint_target(target)
+    assert _ids(findings) in ([], ['SL008']), findings
+    assert _ids(findings, 'error') == [], findings
+
+
+@pytest.mark.slow
+def test_resnet50_fused_step_lints_fully_clean():
+    # the fused batch_norm_act path is the clean state: zero findings,
+    # SL008 included -- the structural proof that the f32 activation
+    # materializations are gone from the traced step
+    target = targets_mod.resnet50_step_target(fused_norm=True)
     findings = analysis.lint_target(target)
     assert findings == [], findings
 
 
 def test_rule_catalogue_is_complete():
     assert sorted(rules_mod.RULES) == [
-        'SL001', 'SL002', 'SL003', 'SL004', 'SL005', 'SL006', 'SL007']
+        'SL001', 'SL002', 'SL003', 'SL004', 'SL005', 'SL006', 'SL007',
+        'SL008']
 
 
 def test_report_json_roundtrip():
@@ -351,3 +366,133 @@ def test_cli_rules_filter_rejects_unknown():
     from chainermn_tpu.analysis.__main__ import main
     with pytest.raises(SystemExit):
         main(['--rules', 'SL999'])
+
+
+# ---------------------------------------------------------------- SL008
+# fixture shapes: (64, 128) bf16 upcast to f32 is 32 KiB, over the
+# activation-size floor; (8, 8) stays under it
+def _lint_compute(fn, args, compute_dtype='bfloat16'):
+    return analysis.lint_target(targets_mod.LintTarget(
+        'fixture', fn, args, {}, compute_dtype=compute_dtype))
+
+
+def test_sl008_f32_materialization_fires_as_warning():
+    def f(x):
+        return (x.astype(jnp.float32) * 2.0).sum()
+
+    fs = _lint_compute(f, (jnp.zeros((64, 128), jnp.bfloat16),))
+    assert _ids(fs) == ['SL008']
+    assert _ids(fs, 'error') == []  # chase list, not a gate failure
+    assert any('fused_norm' in f.message for f in fs)
+
+
+def test_sl008_needs_declared_narrow_compute():
+    def f(x):
+        return (x.astype(jnp.float32) * 2.0).sum()
+
+    x = jnp.zeros((64, 128), jnp.bfloat16)
+    # no declared compute dtype -> rule disabled; declared-f32
+    # compute -> upcasts are the design, not a finding
+    assert _lint_compute(f, (x,), compute_dtype=None) == []
+    assert _lint_compute(f, (x,), compute_dtype='float32') == []
+
+
+def test_sl008_small_tensors_are_silent():
+    def f(x):
+        return (x.astype(jnp.float32) * 2.0).sum()
+
+    assert _lint_compute(f, (jnp.zeros((8, 8), jnp.bfloat16),)) == []
+
+
+def test_sl008_master_weight_gradient_upcast_is_exempt():
+    # the mixed-precision master-weight pattern: a bf16 weight
+    # gradient upcast back to the f32 master's shape/dtype for the
+    # optimizer update -- declared design, not a materialization leak
+    def f(w, x):
+        g = (x * 2.0).astype(jnp.float32)  # (64,128) f32, w's shape
+        return w - 0.1 * g
+
+    fs = _lint_compute(
+        f, (jnp.zeros((64, 128), jnp.float32),
+            jnp.zeros((64, 128), jnp.bfloat16)))
+    assert fs == [], fs
+
+
+def test_sl008_kernel_layer_is_exempt():
+    # upcasts INSIDE the sanctioned kernel layer (ops/, and any
+    # custom-derivative scope) are VMEM-local on the TPU path
+    from chainermn_tpu.ops import batch_norm_act
+
+    def loss(x, scale, bias):
+        out, _, _ = batch_norm_act(x, scale, bias)
+        # reduce one (C,) row: a loss whose own bf16 sum would upcast
+        # an activation-sized tensor must not pollute the fixture
+        return out[0].astype(jnp.float32).sum()
+
+    # differentiated, like every real step target: under AD the
+    # custom_vjp stays a primitive scope the audit can exempt
+    fs = _lint_compute(jax.grad(loss, argnums=(0, 1, 2)),
+                       (jnp.zeros((64, 128), jnp.bfloat16),
+                        jnp.ones((128,), jnp.float32),
+                        jnp.zeros((128,), jnp.float32)))
+    assert fs == [], fs
+
+
+# ----------------------------------------------------------- memtraffic
+def test_memtraffic_jaxpr_traffic_counts_materializations():
+    from chainermn_tpu.analysis import memtraffic
+
+    def f(x):
+        y = x.astype(jnp.float32) * 2.0   # 32 KiB f32 materialization
+        return (y * y).sum()
+
+    t = memtraffic.jaxpr_traffic(
+        jax.make_jaxpr(f)(jnp.zeros((64, 128), jnp.bfloat16)))
+    assert t['f32_materialized_count'] == 1
+    assert t['f32_materialized_bytes'] == 64 * 128 * 4
+    assert t['jaxpr_intermediate_bytes'] > 0
+    assert t['top_intermediates'], t
+    top = t['top_intermediates'][0]
+    assert set(top) >= {'bytes', 'op', 'shape', 'dtype', 'scope'}
+
+
+def test_memtraffic_audit_target_reports_cost_and_items():
+    from chainermn_tpu.analysis import memtraffic
+
+    target = targets_mod.LintTarget(
+        'fixture', lambda x: (x * 2.0).sum(),
+        (jnp.zeros((64, 128), jnp.float32),), {}, items=16)
+    row = memtraffic.audit_target(target)
+    assert row['target'] == 'fixture'
+    assert row['bytes_accessed'] > 0
+    assert row['items_per_step'] == 16
+    assert row['bytes_per_item'] == round(row['bytes_accessed'] / 16, 1)
+
+
+def test_memtraffic_trace_failure_is_a_row_not_a_crash():
+    from chainermn_tpu.analysis import memtraffic
+
+    def boom(x):
+        raise RuntimeError('fixture')
+
+    rows = memtraffic.report([targets_mod.LintTarget(
+        'fixture', boom, (jnp.zeros((4,)),), {})])
+    assert rows[0]['target'] == 'fixture'
+    assert 'fixture' in rows[0]['trace_error']
+
+
+def test_memtraffic_mlp_step_in_report_json():
+    # the CLI's memtraffic section in miniature: the mlp example step
+    # audits clean (no f32 materializations) with bytes/item attached
+    import json
+    from chainermn_tpu.analysis import memtraffic
+
+    report = analysis.build_report([])
+    report.memtraffic = memtraffic.report([targets_mod.mlp_step_target()])
+    data = json.loads(report.to_json())
+    (row,) = data['memtraffic']
+    assert row['target'] == 'step:mlp_example'
+    assert row['f32_materialized_count'] == 0
+    assert row['bytes_per_item'] > 0
+    # and the human rendering mentions it
+    assert 'memtraffic step:mlp_example' in report.render_text()

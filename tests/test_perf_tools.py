@@ -393,6 +393,10 @@ def test_trace_report_host_fallback_and_degradation(tmp_path,
     # missing traces and empty tables degrade to explanatory stubs
     assert 'error' in tr.analyze_trace(str(tmp_path / 'nope'))
     monkeypatch.setattr(tr, '_tool_tables', lambda p, t: [])
+    # (the raw host-plane fallback is mocked empty too: the stub
+    # bytes above are not a parseable XSpace)
+    monkeypatch.setattr(tr, '_collect_host_events',
+                        lambda p: ({}, []))
     assert 'rows' in tr.analyze_trace(str(d))['error']
     monkeypatch.setattr(
         tr, '_tool_tables',
@@ -447,6 +451,39 @@ def test_trace_report_main_writes_jsonl(tmp_path, monkeypatch,
     monkeypatch.setattr(tr, 'RES', str(tmp_path / 'empty'))
     assert tr.main(['--latest']) == 0
     assert 'no trace dirs' in capsys.readouterr().out
+
+
+def test_trace_report_real_cpu_capture_produces_breakdown(tmp_path):
+    """END-TO-END, nothing mocked: jax.profiler capture on the CPU
+    backend -> the REAL xprof/tensorboard converter -> a non-stub
+    per-op breakdown.  This is the VERDICT r5 trace-tooling gap
+    ("never produced a real breakdown"): the converter's pybind entry
+    point moved between TF generations and the old import path died
+    on images like this one, so only a mocked parser was ever
+    exercised.  A converter regression now fails tier-1 instead of
+    surfacing as a silent stub after a paid TPU window."""
+    import jax
+    import jax.numpy as jnp
+
+    from benchmarks import trace_report as tr
+
+    td = tmp_path / 'trace'
+    f = jax.jit(lambda a: (a @ a).sum())
+    x = jnp.ones((128, 128))
+    f(x).block_until_ready()  # compile outside the capture
+    with jax.profiler.trace(str(td)):
+        for _ in range(3):
+            r = f(x)
+        r.block_until_ready()
+    rep = tr.analyze_trace(str(td))
+    assert 'error' not in rep, rep
+    # a CPU trace has no device plane: the designed degradation is a
+    # REAL host-side framework-op breakdown, not a stub
+    assert rep['total_self_time_us'] > 0
+    assert rep['buckets'] and rep['top_ops'], rep
+    assert sum(b['ops'] for b in rep['buckets'].values()) > 0
+    # and it renders without crashing on whatever cells came back
+    assert rep['trace_dir'] in tr.render(rep)
 
 
 def test_init_on_host_passthrough_on_cpu():
@@ -539,6 +576,37 @@ def test_banked_last_good_best_within_round(tmp_path, monkeypatch):
     value, tag, src = bench.banked_last_good('resnet50')
     assert (value, tag, src) == (
         4100.0, 'r5', 'bench_resnet50_b128_r5.out')
+
+
+def test_banked_last_good_row_carries_hbm_sidecars(tmp_path,
+                                                   monkeypatch):
+    # the backend_unavailable row surfaces the banked row's
+    # HBM-traffic / MFU diagnostics, not just the bare value
+    bench = _fake_results(tmp_path, monkeypatch, {
+        'bench_resnet50_r5.out': _rs_row(
+            2588.0, hbm_bytes_per_image=316.4e6, pct_of_hbm_peak=93.2,
+            pct_of_bf16_peak=16.2, step_time_ms=12.37,
+            fused_norm=False),
+    })
+    row, value, tag, src = bench.banked_last_good_row('resnet50')
+    assert value == 2588.0 and tag == 'r5'
+    for key in ('hbm_bytes_per_image', 'pct_of_hbm_peak',
+                'pct_of_bf16_peak', 'step_time_ms', 'fused_norm'):
+        assert key in bench.BANKED_SIDECAR_KEYS
+        assert row.get(key) == _rs_row(
+            2588.0, hbm_bytes_per_image=316.4e6, pct_of_hbm_peak=93.2,
+            pct_of_bf16_peak=16.2, step_time_ms=12.37,
+            fused_norm=False)[key]
+
+
+def test_parse_fused_norm():
+    from bench import parse_fused_norm
+    assert parse_fused_norm([], 'resnet50') is False
+    assert parse_fused_norm(['--fused-norm'], 'resnet50') is True
+    assert parse_fused_norm(['--fused-norm'], 'googlenetbn') is True
+    for model in ('vgg16', 'mlp', 'transformer'):
+        with pytest.raises(SystemExit):
+            parse_fused_norm(['--fused-norm'], model)
 
 
 def test_trustworthy_value_rejects_retracted_rows():
