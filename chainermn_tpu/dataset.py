@@ -87,6 +87,26 @@ class _Permuted:
         return self._dataset[int(self._order[i])]
 
 
+def epoch_position(epoch_detail, shard_len):
+    """``(epoch, in-shard position)`` for a fractional epoch on a
+    shard of ``shard_len`` items.
+
+    The elastic-resume rule: a checkpoint records the GLOBAL fraction
+    of the epoch consumed (``epoch_detail``); on restore --
+    potentially at a DIFFERENT process count, where
+    :func:`scatter_dataset` hands every process a different-length
+    shard -- that fraction is re-expressed in the new shard length,
+    so every process lands at the same global progress point and the
+    epoch boundary fires where it would have.  Used by the
+    iterators' ``restore_position``."""
+    if shard_len < 0:
+        raise ValueError('shard_len must be >= 0')
+    epoch = int(epoch_detail)
+    frac = float(epoch_detail) - epoch
+    pos = min(shard_len, int(round(frac * shard_len)))
+    return epoch, pos
+
+
 def get_n_iterations_for_one_epoch(dataset, local_batch_size, comm=None,
                                    size=None):
     """Iterations per epoch under even sharding (deprecated in the

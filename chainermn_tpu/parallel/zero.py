@@ -392,6 +392,68 @@ def unsqueeze_state(state):
         lambda x: x[None] if getattr(x, 'ndim', 0) >= 1 else x, state)
 
 
+def regather_stacked_leaf(stacked, size):
+    """Host-side inverse of the ZeRO-1 shard layout: the ``(n, k)``
+    stacked shards of one leaf -> the flat ``(size,)`` full leaf.
+
+    The stacked rows are exactly :func:`param_shard_leaf`'s rank-order
+    slices of the zero-padded flat leaf, so row-major flattening IS
+    the regather; only the trailing padding is dropped."""
+    import numpy as np
+    return np.asarray(stacked).reshape(-1)[:size]  # noqa: shardlint
+
+
+def reshard_flat_leaf(flat, new_n):
+    """Host-side re-split of a flat full leaf to ``new_n`` stacked
+    shards ``(new_n, k')`` under the :func:`shard_len` padding rule --
+    the layout :func:`param_shard_leaf` would cut on a ``new_n``-wide
+    mesh (pure numpy twin, checked against it in ``tests``)."""
+    import numpy as np
+    flat = np.asarray(flat).reshape(-1)  # noqa: shardlint
+    k = shard_len(flat.size, new_n)
+    pad = new_n * k - flat.size
+    if pad:
+        flat = np.concatenate([flat, np.zeros((pad,), flat.dtype)])
+    return flat.reshape(new_n, k)
+
+
+def reshard_stacked_state(saved, template):
+    """Elastic N->M reshard of a SAVED stacked ZeRO-1 optimizer state
+    against the LIVE updater's template (host-side; the resume layer
+    then places the result with the live shardings).
+
+    Array leaves are the ``(n_old, k_old)`` stacks
+    :func:`expand_state` lays out; scalar/replicated leaves pass
+    through.  Correctness leans on the padding invariant: shard
+    padding lanes are ZERO at init (:func:`shard_templates`) and stay
+    zero through training (padding gradients are zero, so every
+    elementwise/mesh-aware optimizer update keeps them zero) -- hence
+    truncating or zero-extending the row-major flattening of the old
+    stack to the new padded length reproduces exactly the layout a
+    fresh ``param_shard_leaf`` split at the new size would hold."""
+    import numpy as np
+
+    def one(s, t):
+        tshape = tuple(getattr(t, 'shape', ()))
+        s_arr = np.asarray(s)  # noqa: shardlint - host-side resume
+        if len(tshape) < 1 or s_arr.ndim < 1:
+            return s
+        if tuple(s_arr.shape) == tshape:
+            return s_arr
+        flat = s_arr.reshape(-1)
+        want = 1
+        for d in tshape:
+            want *= int(d)
+        if flat.size >= want:
+            flat = flat[:want]
+        else:
+            flat = np.concatenate(
+                [flat, np.zeros((want - flat.size,), flat.dtype)])
+        return flat.reshape(tshape)
+
+    return jax.tree_util.tree_map(one, saved, template)
+
+
 def traceable_shard_update(optimizer, params, comm):
     """``(fn, args)``: the bare ZeRO-1 scatter -> sharded-update ->
     gather cycle as a traceable ``shard_map`` over ``comm.mesh``.

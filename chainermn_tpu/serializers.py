@@ -1,4 +1,4 @@
-"""Checkpoint serialization.
+"""Checkpoint serialization and the checkpoint trust layer.
 
 The reference delegates checkpoint/resume entirely to Chainer's npz
 serializers (``--resume`` -> ``chainer.serializers.load_npz``,
@@ -7,12 +7,41 @@ serializers (``--resume`` -> ``chainer.serializers.load_npz``,
 :func:`save_checkpoint` / :func:`restore_checkpoint` via orbax, which
 writes sharded arrays per host (the genuine gap SURVEY.md 5 flags:
 rank-aware snapshots the reference never had).
+
+On top of both sits an integrity layer (SURVEY 5's elastic-resume
+gap): every snapshot carries a **topology-tagged manifest** -- world
+size, device count, mesh shape, per-leaf shape/dtype/crc32 and a
+write-complete sentinel -- npz writes are **atomic** (tmp + rename,
+so a crash mid-write never leaves a torn file under the final name),
+:func:`verify_checkpoint` probes a snapshot without restoring it, and
+every integrity failure raises the typed
+:class:`~chainermn_tpu.utils.failure.CheckpointCorruptError` naming
+the offending leaf instead of a bare ``KeyError`` /
+``zipfile.BadZipFile`` deep inside npz internals.
+:func:`resume_updater` is **elastic**: a checkpoint written at N
+processes restores at M -- ZeRO-1 optimizer partitions are regathered
+and re-split (:func:`chainermn_tpu.parallel.zero.reshard_stacked_state`),
+replicated state is re-placed through
+``placement.multihost_device_put``, and the iterator's epoch position
+is re-expressed at the new shard size.  See
+``docs/fault_tolerance.md``.
 """
 
+import json
 import os
+import zlib
 
 import jax
 import numpy as np
+
+from chainermn_tpu.utils import chaos as _chaos
+from chainermn_tpu.utils import failure as _failure
+
+#: Reserved npz key holding the JSON manifest (uint8 bytes); user
+#: trees must not use it as a top-level leaf name.
+MANIFEST_KEY = '__manifest__'
+
+MANIFEST_FORMAT = 1
 
 
 def _flatten_with_names(tree):
@@ -23,6 +52,28 @@ def _flatten_with_names(tree):
                        for p in path) or '_root'
         out[key] = np.asarray(leaf)
     return out, treedef
+
+
+def _flatten_spec(tree):
+    """Like :func:`_flatten_with_names` but WITHOUT materializing
+    leaves on the host -- safe for templates whose arrays are sharded
+    across processes (only ``.shape``/``.dtype`` are read)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out = {}
+    for path, leaf in flat:
+        key = '/'.join(str(getattr(p, 'key', getattr(p, 'idx', p)))
+                       for p in path) or '_root'
+        out[key] = leaf
+    return out, treedef
+
+
+def _leaf_shape(leaf):
+    return tuple(getattr(leaf, 'shape', np.shape(leaf)))
+
+
+def _leaf_dtype(leaf):
+    dt = getattr(leaf, 'dtype', None)
+    return np.dtype(dt) if dt is not None else np.asarray(leaf).dtype
 
 
 _WIDTH_EQUIV = {2: np.uint16, 1: np.uint8, 4: np.uint32}
@@ -38,63 +89,259 @@ def _to_native(arr):
     return arr.view(equiv), arr.dtype.name
 
 
-def save_npz(path, tree):
-    """Write a pytree to ``path``(.npz), keys = tree paths."""
+def _corrupt(message, path, leaf, kind):
+    return _failure.CheckpointCorruptError(
+        '%s [snapshot %s]' % (message, path), path=path, leaf=leaf,
+        kind=kind)
+
+
+def _manifest(leaves, mesh_shape=None):
+    return {
+        'format': MANIFEST_FORMAT,
+        'complete': True,
+        'world_size': jax.process_count(),
+        'device_count': jax.device_count(),
+        'mesh_shape': (dict(mesh_shape) if mesh_shape is not None
+                       else None),
+        'leaves': leaves,
+    }
+
+
+def save_npz(path, tree, mesh_shape=None):
+    """Write a pytree to ``path``(.npz), keys = tree paths.
+
+    The file additionally carries a topology-tagged manifest under
+    :data:`MANIFEST_KEY` -- world size, device count, ``mesh_shape``
+    (pass ``dict(comm.mesh.shape)`` to record it), per-leaf
+    shape/dtype/crc32 and the write-complete sentinel -- and is
+    written ATOMICALLY (temp file + ``os.replace``), so a crash
+    mid-write can never leave a torn snapshot under the final name.
+    """
     arrays, _ = _flatten_with_names(tree)
-    stored = {}
+    stored, leaves = {}, {}
     for key, arr in arrays.items():
         native, dtype_name = _to_native(arr)
         stored[key if dtype_name is None
                else key + '::' + dtype_name] = native
+        leaves[key] = {
+            'shape': list(arr.shape),
+            'dtype': str(arr.dtype),
+            'crc32': zlib.crc32(
+                np.ascontiguousarray(native).tobytes()),
+        }
+    blob = json.dumps(_manifest(leaves, mesh_shape)).encode()
+    stored[MANIFEST_KEY] = np.frombuffer(blob, np.uint8)
     if not path.endswith('.npz'):
         path = path + '.npz'
-    with open(path, 'wb') as f:
+    tmp = path + '.tmp'
+    with open(tmp, 'wb') as f:
         np.savez(f, **stored)
+        f.flush()
+        os.fsync(f.fileno())
+    if _chaos._active is not None:  # ckpt_kill: crash mid-write
+        _chaos.on_checkpoint_write(tmp)
+    os.replace(tmp, path)
+    if _chaos._active is not None:  # ckpt_truncate / ckpt_flip
+        _chaos.corrupt_checkpoint(path)
     return path
+
+
+def read_npz(path, verify=True):
+    """Read a :func:`save_npz` file into ``({key: array}, manifest)``.
+
+    ``manifest`` is ``None`` for legacy (pre-manifest) files.  Every
+    integrity failure -- zero-byte/truncated/unreadable file, a leaf
+    the manifest lists but the archive lacks, a per-leaf crc32
+    mismatch (bit rot) -- raises the typed
+    :class:`~chainermn_tpu.utils.failure.CheckpointCorruptError`.  A
+    MISSING file raises ``OSError`` unchanged: absence is a lookup
+    problem, not corruption.
+    """
+    if not path.endswith('.npz') and not os.path.exists(path):
+        path = path + '.npz'
+    if os.path.getsize(path) == 0:
+        raise _corrupt('zero-byte snapshot', path, None, 'unreadable')
+    by_key, crcs, manifest = {}, {}, None
+    try:
+        with np.load(path) as data:
+            if MANIFEST_KEY in data.files:
+                manifest = json.loads(bytes(data[MANIFEST_KEY]))
+            for stored_key in data.files:
+                if stored_key == MANIFEST_KEY:
+                    continue
+                key, _, dtype_name = stored_key.partition('::')
+                arr = data[stored_key]
+                if verify and manifest is not None:
+                    crcs[key] = zlib.crc32(
+                        np.ascontiguousarray(arr).tobytes())
+                if dtype_name:
+                    import ml_dtypes
+                    arr = arr.view(
+                        np.dtype(getattr(ml_dtypes, dtype_name)))
+                by_key[key] = arr
+    except _failure.CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise _corrupt('unreadable snapshot (%s: %s)'
+                       % (type(e).__name__, e), path, None,
+                       'unreadable')
+    if verify and manifest is not None:
+        for key, meta in manifest.get('leaves', {}).items():
+            if key not in by_key:
+                raise _corrupt(
+                    'manifest lists leaf %r but the archive lacks it'
+                    % key, path, key, 'missing')
+            if 'crc32' in meta and crcs.get(key) != meta['crc32']:
+                raise _corrupt(
+                    'crc32 mismatch for leaf %r (bit rot or torn '
+                    'write)' % key, path, key, 'crc')
+    return by_key, manifest
+
+
+def _fetch_tree(by_key, template, prefix, path, strict_shapes=True,
+                optional=False):
+    """Assemble ``template``'s structure from flat ``by_key`` arrays
+    under ``prefix``, with typed per-leaf shape/dtype validation.
+    ``strict_shapes=False`` admits shape mismatches (the elastic ZeRO
+    path reshards them afterwards); dtype is always strict.
+    ``optional=True`` returns None when the subtree is absent."""
+    spec, treedef = _flatten_spec(template)
+    leaves = []
+    for key, tmpl in spec.items():
+        if not prefix:
+            fkey = key
+        else:
+            fkey = prefix if key == '_root' else prefix + '/' + key
+        if fkey not in by_key:
+            if optional:
+                return None
+            raise _corrupt('checkpoint is missing leaf %r' % fkey,
+                           path, fkey, 'missing')
+        arr = by_key[fkey]
+        tshape = _leaf_shape(tmpl)
+        if strict_shapes and tuple(arr.shape) != tshape:
+            raise _corrupt(
+                'shape mismatch for %r: snapshot %r vs template %r'
+                % (fkey, tuple(arr.shape), tshape), path, fkey,
+                'shape')
+        tdtype = _leaf_dtype(tmpl)
+        if np.dtype(arr.dtype) != tdtype:
+            raise _corrupt(
+                'dtype mismatch for %r: snapshot %s vs template %s'
+                % (fkey, arr.dtype, tdtype), path, fkey, 'dtype')
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def load_npz(path, template):
     """Read arrays saved by :func:`save_npz` back into ``template``'s
-    structure (dtypes/shapes validated leaf-by-leaf)."""
-    if not path.endswith('.npz') and not os.path.exists(path):
-        path = path + '.npz'
-    with np.load(path) as data:
-        by_key = {}
-        for stored_key in data.files:
-            key, _, dtype_name = stored_key.partition('::')
-            arr = data[stored_key]
-            if dtype_name:
-                import ml_dtypes
-                arr = arr.view(np.dtype(getattr(ml_dtypes, dtype_name)))
-            by_key[key] = arr
-        arrays, treedef = _flatten_with_names(template)
-        leaves = []
-        for key, tmpl in arrays.items():
-            if key not in by_key:
-                raise KeyError('checkpoint missing %r' % key)
-            arr = by_key[key]
-            if tuple(arr.shape) != tuple(tmpl.shape):
-                raise ValueError('shape mismatch for %r: %r vs %r'
-                                 % (key, arr.shape, tmpl.shape))
-            leaves.append(arr.astype(tmpl.dtype))
-        return jax.tree_util.tree_unflatten(treedef, leaves)
+    structure.  Shapes and dtypes are validated leaf-by-leaf against
+    the template; any mismatch -- like any file-level corruption --
+    raises the typed
+    :class:`~chainermn_tpu.utils.failure.CheckpointCorruptError`
+    naming the offending leaf path."""
+    by_key, _ = read_npz(path)
+    return _fetch_tree(by_key, template, '', path)
+
+
+def checkpoint_complete(path):
+    """Cheap validity probe (no array data read): True iff ``path``
+    is a snapshot whose write COMMITTED -- a non-empty npz carrying
+    the manifest sentinel, or an orbax step dir whose manifest
+    sidecar exists and is complete.  A crash mid-write fails this
+    (tmp+rename means the final npz name never exists; the orbax
+    sidecar is written only after the save commits), so
+    ``latest_snapshot`` can never select a torn or half-written file
+    -- even outside elastic mode."""
+    try:
+        if os.path.isdir(path):
+            d, step = os.path.split(os.path.abspath(path))
+            m = read_orbax_manifest(d, step)
+            return bool(m and m.get('complete'))
+        p = path
+        if not p.endswith('.npz') and not os.path.exists(p):
+            p = p + '.npz'
+        if os.path.getsize(p) == 0:
+            return False
+        with np.load(p) as data:
+            if MANIFEST_KEY not in data.files:
+                return False
+            m = json.loads(bytes(data[MANIFEST_KEY]))
+            return bool(m.get('complete'))
+    except Exception:
+        return False
+
+
+def verify_checkpoint(path, template=None):
+    """Full integrity probe WITHOUT restoring; returns the manifest.
+
+    npz: the file must unzip, carry a complete manifest, and every
+    manifest leaf must match its stored crc32 (bit-rot detection);
+    with ``template``, per-leaf shape/dtype are checked too.  orbax
+    step dirs: the manifest sidecar must exist and be complete
+    (per-shard content is orbax's own job at restore time); with
+    ``template``, leaf specs are checked against the manifest.  Any
+    failure raises the typed
+    :class:`~chainermn_tpu.utils.failure.CheckpointCorruptError`.
+    """
+    if os.path.isdir(path):
+        d, step = os.path.split(os.path.abspath(path))
+        manifest = read_orbax_manifest(d, step)
+        if not (manifest and manifest.get('complete')):
+            raise _corrupt(
+                'missing or incomplete manifest sidecar (torn or '
+                'legacy orbax snapshot)', path, None, 'incomplete')
+        if template is not None:
+            _check_template(manifest, template, path)
+        return manifest
+    by_key, manifest = read_npz(path)  # crc-checked
+    if not (manifest and manifest.get('complete')):
+        raise _corrupt(
+            'no write-complete manifest sentinel (legacy or torn '
+            'snapshot)', path, None, 'incomplete')
+    if template is not None:
+        _fetch_tree(by_key, template, '', path)
+    return manifest
+
+
+def _check_template(manifest, template, path):
+    spec, _ = _flatten_spec(template)
+    leaves = manifest.get('leaves', {})
+    for key, tmpl in spec.items():
+        meta = leaves.get(key)
+        if meta is None:
+            raise _corrupt('checkpoint is missing leaf %r' % key,
+                           path, key, 'missing')
+        if list(meta.get('shape', [])) != list(_leaf_shape(tmpl)):
+            raise _corrupt(
+                'shape mismatch for %r: snapshot %r vs template %r'
+                % (key, meta.get('shape'), list(_leaf_shape(tmpl))),
+                path, key, 'shape')
+        if meta.get('dtype') != str(_leaf_dtype(tmpl)):
+            raise _corrupt(
+                'dtype mismatch for %r: snapshot %s vs template %s'
+                % (key, meta.get('dtype'), _leaf_dtype(tmpl)),
+                path, key, 'dtype')
 
 
 def updater_state(updater):
     """The canonical snapshot pytree of a live updater: params,
-    optimizer state, iteration/epoch counters, plus -- when present --
-    BatchNorm/model state, the pipeline's replicated prologue/epilogue
-    params (``extra``) and the mixed-precision loss-scale state
-    (``scale_state``, so a resumed f16 run continues at its adapted
-    scale instead of re-warming from the initial one).  Single source
-    of truth shared by ``extensions.snapshot()``, NanGuard's
-    divergence forensics and the preemption checkpoint
+    optimizer state, iteration/epoch counters, the fractional
+    ``epoch_detail`` (so an ELASTIC resume can re-express the
+    in-epoch position at a different shard size), plus -- when
+    present -- BatchNorm/model state, the pipeline's replicated
+    prologue/epilogue params (``extra``) and the mixed-precision
+    loss-scale state (``scale_state``, so a resumed f16 run continues
+    at its adapted scale instead of re-warming from the initial one).
+    Single source of truth shared by ``extensions.snapshot()``,
+    NanGuard's divergence forensics and the preemption checkpoint
     (:mod:`chainermn_tpu.training.recovery`)."""
     state = {
         'params': updater.params,
         'opt_state': updater.opt_state,
         'iteration': updater.iteration,
         'epoch': updater.epoch,
+        'epoch_detail': float(getattr(updater, 'epoch_detail', 0.0)),
     }
     if getattr(updater, 'model_state', None) is not None:
         state['model_state'] = updater.model_state
@@ -105,60 +352,224 @@ def updater_state(updater):
     return state
 
 
-def resume_updater(path, updater, comm=None):
-    """Restore a snapshot written by ``extensions.snapshot()`` into a
-    live updater: params, optimizer state, BatchNorm/model state,
+def gather_replicated(tree, mesh):
+    """Make every leaf of ``tree`` fully replicated -- a complete
+    copy on every process -- via ONE compiled all-gather program, so
+    the npz writer can ``np.asarray`` state that lives sharded across
+    processes (ZeRO-1 optimizer partitions above all).  COLLECTIVE:
+    every process in ``mesh`` must call this with the same tree.
+    Leaves that are already addressable or replicated pass through
+    untouched; a tree with none others returns as-is (zero cost in
+    single-controller runs)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    flat, treedef = jax.tree_util.tree_flatten(tree)
+    idx = [i for i, x in enumerate(flat)
+           if isinstance(x, jax.Array)
+           and not (x.is_fully_addressable or x.is_fully_replicated)]
+    if not idx:
+        return tree
+    repl = NamedSharding(mesh, P())
+    gathered = jax.jit(lambda xs: xs, out_shardings=repl)(
+        [flat[i] for i in idx])
+    jax.block_until_ready(gathered)
+    for i, g in zip(idx, gathered):
+        flat[i] = g
+    return jax.tree_util.tree_unflatten(treedef, flat)
+
+
+def restore_counters(updater, iteration, epoch=0, epoch_detail=None):
+    """Restore the step counter and the iterator's epoch position.
+
+    Elastic rule: when ``epoch_detail`` is available and the iterator
+    supports ``restore_position``, the GLOBAL fraction of the epoch
+    consumed is preserved -- re-expressed at the CURRENT topology's
+    shard length (``dataset.epoch_position``); otherwise the integer
+    epoch is restored as before."""
+    updater.iteration = int(iteration)
+    it = getattr(updater, 'iterator', None)
+    if it is None:
+        return
+    if epoch_detail is not None and hasattr(it, 'restore_position'):
+        it.restore_position(float(epoch_detail))
+    elif hasattr(it, 'restore_epoch'):
+        it.restore_epoch(int(epoch))
+    elif hasattr(it, 'epoch'):
+        it.epoch = int(epoch)
+
+
+def _maybe_reshard_opt(saved, live_opt, updater, elastic, path):
+    """``(state, resharded)``: pass the saved optimizer state through
+    -- or, when leaf shapes differ and the updater runs ZeRO-1 with
+    ``elastic`` on, regather+re-split the stacked partitions to the
+    live mesh size (``zero.reshard_stacked_state``)."""
+    mismatch = []
+
+    def chk(s, t):
+        if tuple(np.shape(s)) != _leaf_shape(t):
+            mismatch.append((tuple(np.shape(s)), _leaf_shape(t)))
+        return s
+
+    jax.tree_util.tree_map(chk, saved, live_opt)
+    if not mismatch:
+        return saved, False
+    if not (elastic and getattr(updater, '_zero', False)):
+        raise _corrupt(
+            'optimizer-state shape mismatch (snapshot %r vs live %r) '
+            'and no elastic ZeRO-1 reshard applies -- the snapshot '
+            'was written under a different topology'
+            % mismatch[0], path, 'opt_state', 'shape')
+    from chainermn_tpu.parallel import zero as zero_mod
+    return zero_mod.reshard_stacked_state(saved, live_opt), True
+
+
+def _restore_state(updater, by_key, manifest, path, elastic=True,
+                   require_manifest=False):
+    """Shared restore core of :func:`resume_updater` /
+    :func:`restore_updater_from_tree`: fetch every component with
+    typed validation, reshard ZeRO state on topology change, place
+    with the LIVE updater leaf's own sharding via the multihost-safe
+    path, restore counters.  Fetches everything BEFORE assigning
+    anything, so a corrupt leaf never leaves the updater
+    half-restored."""
+    from chainermn_tpu.training.placement import multihost_device_put
+
+    if require_manifest and not (manifest
+                                 and manifest.get('complete')):
+        raise _corrupt(
+            'no write-complete manifest sentinel (legacy or torn '
+            'snapshot)', path, None, 'incomplete')
+    live = updater_state(updater)
+
+    params = _fetch_tree(by_key, live['params'], 'params', path)
+    opt = _fetch_tree(by_key, live['opt_state'], 'opt_state', path,
+                      strict_shapes=False)
+    opt, resharded = _maybe_reshard_opt(opt, live['opt_state'],
+                                        updater, elastic, path)
+    subtrees = {}
+    for name in ('model_state', 'extra'):
+        if live.get(name) is not None:
+            subtrees[name] = _fetch_tree(by_key, live[name], name,
+                                         path)
+    scale = None
+    if live.get('scale_state') is not None:
+        # optional for backward compatibility: checkpoints written
+        # before loss-scale state was snapshot (or by a non-policy
+        # run) restore everything else; the live scale is kept as-is
+        scale = _fetch_tree(by_key, live['scale_state'],
+                            'scale_state', path, optional=True)
+    if 'iteration' not in by_key:
+        raise _corrupt('checkpoint is missing leaf %r' % 'iteration',
+                       path, 'iteration', 'missing')
+
+    def place(new_tree, cur_tree):
+        return jax.tree_util.tree_map(
+            lambda new, cur: (multihost_device_put(new, cur.sharding)
+                              if isinstance(cur, jax.Array) else new),
+            new_tree, cur_tree)
+
+    updater.params = place(params, updater.params)
+    updater.opt_state = place(opt, updater.opt_state)
+    for name, sub in subtrees.items():
+        setattr(updater, name, place(sub, getattr(updater, name)))
+    if scale is not None:
+        updater.scale_state = place(scale, updater.scale_state)
+    detail = by_key.get('epoch_detail')
+    restore_counters(updater, by_key['iteration'],
+                     by_key.get('epoch', 0),
+                     None if detail is None else float(detail))
+    return {'iteration': updater.iteration, 'resharded': resharded,
+            'manifest': manifest}
+
+
+def resume_updater(path, updater, comm=None, elastic=True,
+                   require_manifest=False):
+    """Restore a snapshot written by ``extensions.snapshot()`` /
+    :class:`~chainermn_tpu.training.recovery.PreemptionHandler` into
+    a live updater: params, optimizer state, BatchNorm/model state,
     loss-scale state, and the iteration/epoch counters (so stop
     triggers and log filenames continue rather than restart).
 
     Every restored leaf is placed with the LIVE updater leaf's own
-    sharding, so whatever layout the updater established at
-    construction is preserved: replicated (``StandardUpdater``),
-    mesh-sharded optimizer state (``zero=True``), stage-sharded
-    pipeline params (``PipelineUpdater``).  The loaded host arrays
-    never alias device buffers, so donation stays safe.  ``comm`` is
-    accepted for backward compatibility and unused."""
-    template = dict(updater_state(updater), iteration=0, epoch=0)
-    try:
-        state = load_npz(path, template)
-    except KeyError:
-        if 'scale_state' not in template:
-            raise
-        # checkpoints written before loss-scale state was snapshot
-        # (or by a non-policy run) restore everything else; the live
-        # scale state is kept as-is
-        template.pop('scale_state')
-        state = load_npz(path, template)
+    sharding through the multihost-safe
+    ``placement.multihost_device_put`` path, so whatever layout the
+    updater established at construction is preserved: replicated
+    (``StandardUpdater``), mesh-sharded optimizer state
+    (``zero=True``), stage-sharded pipeline params
+    (``PipelineUpdater``).  The loaded host arrays never alias device
+    buffers, so donation stays safe.
 
-    def place(new_tree, cur_tree):
-        return jax.tree_util.tree_map(
-            lambda new, cur: (jax.device_put(new, cur.sharding)
-                              if isinstance(cur, jax.Array) else new),
-            new_tree, cur_tree)
+    ELASTIC (default): when the snapshot was written under a
+    different topology -- its stacked ZeRO-1 optimizer-state shapes
+    disagree with the live mesh -- the partitions are regathered and
+    re-split N->M on the host
+    (:func:`chainermn_tpu.parallel.zero.reshard_stacked_state`) and
+    the iterator's epoch position is re-expressed at the new shard
+    size (``epoch_detail`` + ``restore_position``).
+    ``elastic=False`` turns any such mismatch into the typed
+    :class:`~chainermn_tpu.utils.failure.CheckpointCorruptError`.
 
-    updater.params = place(state['params'], updater.params)
-    updater.opt_state = place(state['opt_state'], updater.opt_state)
-    if 'model_state' in template:
-        updater.model_state = place(state['model_state'],
-                                    updater.model_state)
-    if 'extra' in template:
-        updater.extra = place(state['extra'], updater.extra)
-    if 'scale_state' in state:
-        updater.scale_state = place(state['scale_state'],
-                                    updater.scale_state)
-    updater.iteration = int(state['iteration'])
-    it = updater.iterator
-    if hasattr(it, 'restore_epoch'):
-        it.restore_epoch(int(state['epoch']))
-    elif hasattr(it, 'epoch'):
-        it.epoch = int(state['epoch'])
-    return state
+    ``require_manifest=True`` (used by ``auto_resume``) additionally
+    rejects snapshots without the write-complete manifest sentinel.
+    ``comm`` is accepted for backward compatibility and unused.
+    Returns ``{'iteration', 'resharded', 'manifest'}``."""
+    del comm
+    by_key, manifest = read_npz(path)
+    return _restore_state(updater, by_key, manifest, path,
+                          elastic=elastic,
+                          require_manifest=require_manifest)
+
+
+def restore_updater_from_tree(updater, state, manifest=None,
+                              elastic=True, path=None):
+    """Restore a live updater from an in-memory snapshot pytree whose
+    leaves are HOST arrays (e.g. a raw orbax restore) -- same typed
+    validation, elastic ZeRO reshard, multihost placement and counter
+    semantics as :func:`resume_updater`."""
+    by_key, _ = _flatten_with_names(state)
+    return _restore_state(updater, by_key, manifest,
+                          path or '<in-memory tree>', elastic=elastic)
 
 
 _async_ckptr = None
+_pending_manifests = []
 
 
-def save_checkpoint(directory, tree, step=0, async_=False):
+def _orbax_manifest_path(directory, step):
+    return os.path.join(os.path.abspath(directory),
+                        '%s.manifest.json' % step)
+
+
+def _write_orbax_manifest(directory, step, manifest):
+    if jax.process_index() != 0:
+        return
+    mpath = _orbax_manifest_path(directory, step)
+    tmp = mpath + '.tmp'
+    with open(tmp, 'w') as f:
+        json.dump(manifest, f)
+    os.replace(tmp, mpath)
+
+
+def read_orbax_manifest(directory, step):
+    """The manifest sidecar of an orbax step (written by process 0
+    AFTER the collective save commits -- it doubles as the
+    write-complete sentinel), or ``None`` for legacy/torn steps."""
+    try:
+        with open(_orbax_manifest_path(directory, step)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _tree_manifest(tree, mesh_shape=None):
+    spec, _ = _flatten_spec(tree)
+    leaves = {key: {'shape': list(_leaf_shape(leaf)),
+                    'dtype': str(_leaf_dtype(leaf))}
+              for key, leaf in spec.items()}
+    return _manifest(leaves, mesh_shape)
+
+
+def save_checkpoint(directory, tree, step=0, async_=False,
+                    mesh_shape=None):
     """Sharded checkpoint via orbax (each host writes its shards).
 
     ``async_=True`` returns as soon as the device arrays are snapshot
@@ -167,10 +578,19 @@ def save_checkpoint(directory, tree, step=0, async_=False):
     I/O.  A subsequent async save (or :func:`wait_checkpoints`) joins
     the previous write first, so at most one write is in flight and
     ordering is preserved.
+
+    Process 0 additionally writes a topology-tagged manifest sidecar
+    (``<step>.manifest.json`` next to the step dir -- per-leaf
+    shape/dtype, world size, device count, ``mesh_shape``) AFTER the
+    write commits; it is the write-complete sentinel
+    ``latest_snapshot``/``verify_checkpoint`` require, so a job
+    killed mid-save can never be selected as a resume point.  For
+    async saves the sidecar is deferred to the join point.
     """
     import orbax.checkpoint as ocp
     directory = os.path.abspath(directory)
     path = os.path.join(directory, str(step))
+    manifest = _tree_manifest(tree, mesh_shape)
     if async_:
         global _async_ckptr
         if _async_ckptr is None:
@@ -179,23 +599,44 @@ def save_checkpoint(directory, tree, step=0, async_=False):
                 ocp.PyTreeCheckpointHandler())
             atexit.register(wait_checkpoints)
         _async_ckptr.save(path, tree, force=True)
+        _pending_manifests.append((directory, step, manifest))
         return directory
     ckptr = ocp.PyTreeCheckpointer()
     ckptr.save(path, tree, force=True)
+    _write_orbax_manifest(directory, step, manifest)
     return directory
 
 
 def wait_checkpoints():
     """Block until any in-flight async checkpoint write has committed
     (call before reading a just-saved step or at shutdown; the atexit
-    hook does the latter automatically)."""
+    hook does the latter automatically), then write the deferred
+    manifest sidecars -- the sentinel only ever describes data that
+    is really on disk."""
     if _async_ckptr is not None:
         _async_ckptr.wait_until_finished()
+    while _pending_manifests:
+        directory, step, manifest = _pending_manifests.pop(0)
+        _write_orbax_manifest(directory, step, manifest)
 
 
 def restore_checkpoint(directory, template, step=0):
+    """Restore an orbax step into ``template``'s structure (pass
+    ``template=None`` for a raw restore to host numpy arrays -- the
+    elastic path reads a checkpoint written under a DIFFERENT
+    topology this way).  Unreadable/torn steps raise the typed
+    :class:`~chainermn_tpu.utils.failure.CheckpointCorruptError`."""
     wait_checkpoints()  # never read a step whose write is in flight
     import orbax.checkpoint as ocp
     ckptr = ocp.PyTreeCheckpointer()
-    return ckptr.restore(os.path.join(os.path.abspath(directory),
-                                      str(step)), item=template)
+    path = os.path.join(os.path.abspath(directory), str(step))
+    try:
+        if template is None:
+            return ckptr.restore(path)
+        return ckptr.restore(path, item=template)
+    except _failure.CheckpointCorruptError:
+        raise
+    except Exception as e:
+        raise _corrupt('unreadable orbax snapshot (%s: %s)'
+                       % (type(e).__name__, e), path, None,
+                       'unreadable')

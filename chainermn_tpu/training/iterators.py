@@ -40,6 +40,20 @@ class SerialIterator:
         """Continue epoch accounting from a checkpoint."""
         self.epoch = int(epoch)
 
+    def restore_position(self, epoch_detail):
+        """Elastic twin of :meth:`restore_epoch`: land at the same
+        GLOBAL epoch fraction re-expressed in THIS topology's shard
+        length (``dataset.epoch_position``), so a run resumed at a
+        different process count keeps its epoch boundary where the
+        interrupted run would have hit it.  The shuffle order is
+        freshly drawn -- the position, not the permutation, is the
+        contract."""
+        from chainermn_tpu.dataset import epoch_position
+        self.epoch, self._pos = epoch_position(
+            float(epoch_detail), len(self.dataset))
+        self.is_new_epoch = False
+        self._order = self._new_order()
+
     @property
     def epoch_detail(self):
         return self.epoch + self._pos / max(1, len(self.dataset))
@@ -103,6 +117,15 @@ class PipelineIterator:
 
     def restore_epoch(self, epoch):
         self.epoch = int(epoch)
+
+    def restore_position(self, epoch_detail):
+        """Same elastic contract as
+        :meth:`SerialIterator.restore_position`."""
+        from chainermn_tpu.dataset import epoch_position
+        self.epoch, self._pos = epoch_position(
+            float(epoch_detail), len(self.pipeline))
+        self.is_new_epoch = False
+        self._order = self._new_order()
 
     def _new_order(self):
         n = len(self.pipeline)
@@ -284,6 +307,18 @@ class MultiprocessIterator(_PrefetchingIterator):
         self._consumed_pos = 0  # epoch_detail == restored epoch exactly
         self._start_worker()
 
+    def restore_position(self, epoch_detail):
+        """Elastic restore: position the inner iterator at the saved
+        global epoch fraction (re-expressed at this shard length) and
+        rebase the consumer-side counters to match, discarding any
+        read-ahead from the pre-restore position."""
+        self._stop_worker()
+        self._source.restore_position(float(epoch_detail))
+        self.epoch = self._source.epoch
+        self._consumed_pos = self._source._pos
+        self.is_new_epoch = False
+        self._start_worker()
+
     def __next__(self):
         batch, self.epoch, self.iteration, self.is_new_epoch, \
             self._consumed_pos = self._next_item()
@@ -379,4 +414,21 @@ class DevicePrefetchIterator(_PrefetchingIterator):
         # epoch/epoch_detail agree in the first post-resume log entry
         self.epoch = int(epoch)
         self._consumed_detail = float(int(epoch))
+        self._start_worker()
+
+    def restore_position(self, epoch_detail):
+        """Elastic restore: delegate the fractional position to the
+        inner iterator (falling back to integer-epoch restore when it
+        cannot express one) and rebase the consumer-side counters,
+        discarding pre-restore read-ahead."""
+        self._stop_worker()
+        if hasattr(self.inner, 'restore_position'):
+            self.inner.restore_position(float(epoch_detail))
+        elif hasattr(self.inner, 'restore_epoch'):
+            self.inner.restore_epoch(int(epoch_detail))
+        else:
+            self.inner.epoch = int(epoch_detail)
+        self._rebase_counters()
+        self._consumed_detail = float(getattr(
+            self.inner, 'epoch_detail', float(epoch_detail)))
         self._start_worker()

@@ -18,11 +18,23 @@ checkpoint-and-resume event:
 
 Both work standalone (manual ``update_core`` loops -- the
 multi-controller chaos leg drives them this way) and as Trainer
-extensions.  Checkpoints use npz (host-size state) or orbax (sharded,
-every process participates -- the multi-controller path); the
-deterministic chaos injector fires SIGTERM at the same iteration on
-every rank, which is exactly what keeps the collective orbax save
-coherent.  See ``docs/fault_tolerance.md``.
+extensions.  Checkpoints use npz (host-size state; ZeRO-sharded
+optimizer partitions are collectively regathered first) or orbax
+(sharded, every process participates -- the multi-controller path);
+the deterministic chaos injector fires SIGTERM at the same iteration
+on every rank, which is exactly what keeps the collective save
+coherent.
+
+Resume is TRUSTED and ELASTIC: every snapshot carries the
+serializers manifest (topology tag + per-leaf crc32 + write-complete
+sentinel), :func:`latest_snapshot` ignores torn/zero-byte/
+sentinel-less files, and :func:`auto_resume` walks the snapshot
+chain newest-to-oldest -- skipping corrupt snapshots with a typed
+:class:`~chainermn_tpu.utils.failure.CheckpointSkippedWarning` --
+and reshards on restore when the saved world size differs from the
+current run (ZeRO partitions re-split N->M, replicated state
+re-placed, epoch position re-expressed).  See
+``docs/fault_tolerance.md``.
 """
 
 import json
@@ -100,24 +112,35 @@ class PreemptionHandler:
 
     def checkpoint(self):
         """Write the preemption snapshot now (regardless of the flag);
-        returns its path."""
+        returns its path.  npz mode first regathers any
+        process-spanning leaves (ZeRO-1 optimizer partitions) into
+        full host copies -- a COLLECTIVE step, which is why every
+        rank calls :meth:`maybe_checkpoint` at the same iteration --
+        then rank 0 writes atomically with the topology manifest."""
         import jax
         from chainermn_tpu import serializers
+        os.makedirs(self.out, exist_ok=True)
         u = self.updater
         state = serializers.updater_state(u)
+        mesh = getattr(getattr(u, 'comm', None), 'mesh', None)
+        mesh_shape = dict(mesh.shape) if mesh is not None else None
         if self.method == 'orbax':
             directory = os.path.join(self.out, 'preempt')
             serializers.save_checkpoint(directory, state,
-                                        step=u.iteration)
+                                        step=u.iteration,
+                                        mesh_shape=mesh_shape)
             path = os.path.join(directory, str(u.iteration))
         else:
+            if mesh is not None:
+                state = serializers.gather_replicated(state, mesh)
             path = None
             if self.all_ranks or jax.process_index() == 0:
                 name = '%s%d' % (PREEMPT_PREFIX, u.iteration)
                 if self.all_ranks and jax.process_count() > 1:
                     name += '.rank%d' % jax.process_index()
                 path = serializers.save_npz(
-                    os.path.join(self.out, name), state)
+                    os.path.join(self.out, name), state,
+                    mesh_shape=mesh_shape)
         if jax.process_index() == 0:
             with open(os.path.join(self.out, 'preempted.json'),
                       'w') as f:
@@ -146,66 +169,86 @@ class PreemptionHandler:
                          % self.received_signal)
 
 
-def latest_snapshot(out, extra_prefixes=('snapshot_iter_',)):
-    """Newest resumable snapshot under ``out``:
-    ``(kind, path, iteration)`` where kind is ``'npz'`` or
-    ``'orbax'``, or ``(None, None, None)``.  Considers preemption
+def snapshot_chain(out, extra_prefixes=('snapshot_iter_',)):
+    """Every snapshot candidate under ``out`` as a list of
+    ``(kind, path, iteration)``, newest first (ties prefer the
+    preemption snapshot, written last).  Considers preemption
     snapshots, periodic ``extensions.snapshot()`` files and orbax
-    preemption step dirs; the HIGHEST iteration wins (ties prefer the
-    preemption snapshot, written last)."""
-    best = (None, None, None, -1)
-
-    def consider(kind, path, it, prio):
-        nonlocal best
-        if best[2] is None or (it, prio) > (best[2], best[3]):
-            best = (kind, path, it, prio)
-
+    preemption step dirs.  NO validity probe -- :func:`auto_resume`
+    walks this chain and verifies each candidate in turn;
+    :func:`latest_snapshot` returns the first valid one."""
     prefixes = (PREEMPT_PREFIX,) + tuple(extra_prefixes)
+    cands = []  # (iteration, priority, kind, path)
     try:
         names = os.listdir(out)
     except OSError:
-        return None, None, None
+        return []
     for name in names:
         for prio, prefix in enumerate(reversed(prefixes)):
             m = re.match(re.escape(prefix) + r'(\d+)(\.rank0)?\.npz$',
                          name)
             if m:
-                consider('npz', os.path.join(out, name),
-                         int(m.group(1)), prio)
+                cands.append((int(m.group(1)), prio, 'npz',
+                              os.path.join(out, name)))
     orbax_dir = os.path.join(out, 'preempt')
     if os.path.isdir(orbax_dir):
         for name in os.listdir(orbax_dir):
             if name.isdigit():
-                consider('orbax', os.path.join(orbax_dir, name),
-                         int(name), len(prefixes))
-    return best[0], best[1], best[2]
+                cands.append((int(name), len(prefixes), 'orbax',
+                              os.path.join(orbax_dir, name)))
+    cands.sort(key=lambda c: (c[0], c[1]), reverse=True)
+    return [(kind, path, it) for it, _, kind, path in cands]
 
 
-def auto_resume(updater, out, extra_prefixes=('snapshot_iter_',)):
-    """Restore the newest snapshot under ``out`` into ``updater``
-    (params, optimizer state, model state, loss-scale state,
-    iteration/epoch) and return the restored iteration, or None when
-    there is nothing to resume from.  Every leaf is placed with the
-    live updater leaf's own sharding (replicated, ZeRO-sharded or
-    stage-sharded layouts all preserved -- same discipline as
-    ``serializers.resume_updater``)."""
+def latest_snapshot(out, extra_prefixes=('snapshot_iter_',)):
+    """Newest VALID resumable snapshot under ``out``:
+    ``(kind, path, iteration)`` where kind is ``'npz'`` or
+    ``'orbax'``, or ``(None, None, None)``.  The HIGHEST iteration
+    wins (ties prefer the preemption snapshot, written last) -- but
+    candidates that fail the cheap completeness probe (zero-byte
+    files, snapshots without the write-complete manifest sentinel:
+    the footprint of a crash mid-write) are never selected, even
+    outside elastic mode."""
+    from chainermn_tpu import serializers
+    for kind, path, it in snapshot_chain(out, extra_prefixes):
+        if serializers.checkpoint_complete(path):
+            return kind, path, it
+    return None, None, None
+
+
+def _resume_orbax(updater, path, it):
+    """Restore one orbax step into the live updater -- sharded
+    template restore when the topology matches the manifest, raw
+    (host numpy) restore + elastic reshard/re-place when it does
+    not."""
     import jax
     from chainermn_tpu import serializers
-    kind, path, it = latest_snapshot(out, extra_prefixes)
-    if kind is None:
-        return None
-    if kind == 'npz':
-        serializers.resume_updater(path, updater)
+    from chainermn_tpu.training.placement import multihost_device_put
+    from chainermn_tpu.utils import failure
+
+    dirname = os.path.dirname(path)
+    manifest = serializers.read_orbax_manifest(dirname, it)
+    if not (manifest and manifest.get('complete')):
+        raise failure.CheckpointCorruptError(
+            'missing or incomplete manifest sidecar (torn or legacy '
+            'orbax snapshot) [snapshot %s]' % path, path=path,
+            kind='incomplete')
+    if (manifest.get('world_size') != jax.process_count()
+            or manifest.get('device_count') != jax.device_count()):
+        # topology changed: raw host restore, then the shared elastic
+        # assembly (ZeRO reshard + multihost re-placement)
+        raw = serializers.restore_checkpoint(dirname, None, step=it)
+        serializers.restore_updater_from_tree(updater, raw, manifest,
+                                              path=path)
         return updater.iteration
-    # orbax: restore with the live updater's state as template, then
-    # place leaves with the live shardings
+    # same topology: restore with the live updater's state as
+    # template, then place leaves with the live shardings
     template = serializers.updater_state(updater)
-    state = serializers.restore_checkpoint(
-        os.path.dirname(path), template, step=it)
+    state = serializers.restore_checkpoint(dirname, template, step=it)
 
     def place(new, cur):
         return jax.tree_util.tree_map(
-            lambda n, c: (jax.device_put(n, c.sharding)
+            lambda n, c: (multihost_device_put(n, c.sharding)
                           if isinstance(c, jax.Array) else n),
             new, cur)
 
@@ -219,11 +262,48 @@ def auto_resume(updater, out, extra_prefixes=('snapshot_iter_',)):
     if 'scale_state' in state and state['scale_state'] is not None:
         updater.scale_state = place(state['scale_state'],
                                     updater.scale_state)
-    updater.iteration = int(state['iteration'])
-    itr = updater.iterator
-    epoch = int(state.get('epoch', 0))
-    if hasattr(itr, 'restore_epoch'):
-        itr.restore_epoch(epoch)
-    elif hasattr(itr, 'epoch'):
-        itr.epoch = epoch
+    serializers.restore_counters(
+        updater, state['iteration'], state.get('epoch', 0),
+        state.get('epoch_detail'))
     return updater.iteration
+
+
+def auto_resume(updater, out, extra_prefixes=('snapshot_iter_',)):
+    """Restore the newest VALID snapshot under ``out`` into
+    ``updater`` (params, optimizer state, model state, loss-scale
+    state, iteration/epoch position) and return the restored
+    iteration, or None when there is nothing to resume from.
+
+    Walks the snapshot chain newest-to-oldest: a corrupt, torn or
+    incomplete snapshot is SKIPPED with a typed
+    :class:`~chainermn_tpu.utils.failure.CheckpointSkippedWarning`
+    (never loaded silently, never a crash inside npz/orbax
+    internals) and the next-older candidate is tried -- so one
+    flipped bit costs one checkpoint interval, not the run.
+
+    ELASTIC: when the manifest says the snapshot was written at a
+    different world size, ZeRO-1 optimizer partitions are regathered
+    and re-split N->M, replicated/loss-scale state is re-placed via
+    the multihost path, and the iterator's epoch position is
+    re-expressed at the new shard size (see
+    ``serializers.resume_updater``).  Every leaf is placed with the
+    live updater leaf's own sharding (replicated, ZeRO-sharded or
+    stage-sharded layouts all preserved)."""
+    import warnings
+    from chainermn_tpu import serializers
+    from chainermn_tpu.utils import failure
+
+    for kind, path, it in snapshot_chain(out, extra_prefixes):
+        try:
+            if kind == 'npz':
+                serializers.resume_updater(path, updater,
+                                           require_manifest=True)
+                return updater.iteration
+            return _resume_orbax(updater, path, it)
+        except failure.CheckpointCorruptError as e:
+            warnings.warn(
+                'auto_resume: skipping corrupt snapshot %s (%s: %s)'
+                % (path, e.kind, e), failure.CheckpointSkippedWarning,
+                stacklevel=2)
+            continue
+    return None

@@ -10,6 +10,7 @@ from chainermn_tpu.utils.chaos import FaultInjector  # noqa
 from chainermn_tpu.utils.failure import (  # noqa
     NanGuard, DivergenceError, Heartbeat, check_finite, detect_stall,
     heartbeat_extension, CommFailure, ChannelTimeout, PeerDeadError,
-    Backoff, Deadline)
+    Backoff, Deadline, CheckpointCorruptError,
+    CheckpointSkippedWarning)
 from chainermn_tpu.utils.schedules import (  # noqa
     linear_scaled_lr, gradual_warmup, distributed_sgd_schedule)

@@ -61,6 +61,17 @@ Sites (the action is part of the site name):
                     of update_core occurrence N
 ``kill_recv``       hard-kill at recv_obj call occurrence N (receiver
                     death mid-conversation)
+``ckpt_kill``       hard-kill (``os._exit(ARG or 43)``) BETWEEN a
+                    checkpoint's temp-file write and its atomic
+                    rename -- the crash-mid-write case; the final
+                    file must never appear and the previous snapshot
+                    must survive intact
+``ckpt_truncate``   truncate the just-committed checkpoint file to
+                    ARG (default 0.5) of its size -- torn write /
+                    filesystem loss; verification must reject it
+``ckpt_flip``       XOR-flip ARG (default 8) evenly-spaced bytes of
+                    the just-committed checkpoint -- silent bit rot;
+                    crc verification must reject it
 ==================  ====================================================
 
 Example -- drop the first publish, delay half the rest, stall the
@@ -80,7 +91,8 @@ import zlib
 ENV_VAR = 'CHAINERMN_TPU_CHAOS'
 
 SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
-         'nan_batch', 'sigterm_step', 'kill_step', 'kill_recv')
+         'nan_batch', 'sigterm_step', 'kill_step', 'kill_recv',
+         'ckpt_kill', 'ckpt_truncate', 'ckpt_flip')
 
 
 class InjectedFault(RuntimeError):
@@ -292,6 +304,58 @@ def on_step(iteration):
     r = inj.fires('kill_step')
     if r is not None:
         os._exit(int(r.arg) if r.arg is not None else 42)
+
+
+def on_checkpoint_write(tmp_path):
+    """``ckpt_kill``: hard-kill this process BETWEEN writing a
+    checkpoint's temp file and the atomic rename -- the mid-write
+    crash.  With tmp+rename discipline the final filename never
+    appears, so the previous snapshot must remain the resume point
+    (``tests/test_chaos.py`` pins exactly that)."""
+    inj = _active
+    if inj is None:
+        return
+    r = inj.fires('ckpt_kill')
+    if r is not None:
+        os._exit(int(r.arg) if r.arg is not None else 43)
+    del tmp_path  # reserved for future partial-write faults
+
+
+def corrupt_checkpoint(path):
+    """``ckpt_truncate`` / ``ckpt_flip``: damage the just-committed
+    checkpoint file in place (AFTER the atomic rename -- the file is
+    "complete" on disk, so only content verification can reject it).
+
+    ``ckpt_truncate``: keep only ARG (default 0.5) of the bytes.
+    ``ckpt_flip``: XOR ARG (default 8) bytes spread evenly across
+    the file -- deterministic, so tests replay the identical bit
+    rot, and dense enough that at least one flip always lands in a
+    checked region (a single flip can disappear into npz alignment
+    padding).
+    """
+    inj = _active
+    if inj is None:
+        return
+    r = inj.fires('ckpt_truncate')
+    if r is not None:
+        frac = r.arg if r.arg is not None else 0.5
+        size = os.path.getsize(path)
+        with open(path, 'r+b') as f:
+            f.truncate(max(0, int(size * frac)))
+        return
+    r = inj.fires('ckpt_flip')
+    if r is not None:
+        n = max(1, int(r.arg) if r.arg is not None else 8)
+        size = os.path.getsize(path)
+        if size == 0:
+            return
+        with open(path, 'r+b') as f:
+            for i in range(n):
+                off = min(size - 1, (size * (i + 1)) // (n + 1))
+                f.seek(off)
+                byte = f.read(1)
+                f.seek(off)
+                f.write(bytes([byte[0] ^ 0xFF]))
 
 
 def corrupt_batch(arrays):

@@ -73,6 +73,43 @@ class PeerDeadError(CommFailure):
         self.process_index = process_index
 
 
+class CheckpointCorruptError(ValueError):
+    """A checkpoint failed integrity verification and must NOT be
+    restored: truncated/unreadable file, per-leaf crc32 mismatch,
+    missing write-complete sentinel, a leaf missing from the snapshot,
+    or a shape/dtype mismatch against the restore template.
+
+    The checkpoint-trust member of the failure taxonomy (see
+    ``docs/fault_tolerance.md``): where :class:`ChannelTimeout` /
+    :class:`PeerDeadError` make *communication* failure typed, this
+    makes *state* failure typed -- ``auto_resume`` catches it to walk
+    the snapshot chain to the newest VALID snapshot instead of
+    silently loading poison or dying inside npz/zipfile internals.
+
+    ``path`` names the snapshot, ``leaf`` the offending tree path
+    (when one is identifiable), and ``kind`` classifies the defect:
+    ``'unreadable'`` | ``'incomplete'`` | ``'crc'`` | ``'missing'`` |
+    ``'shape'`` | ``'dtype'`` | ``'topology'``.  Subclasses
+    ``ValueError`` so pre-taxonomy callers that caught the old bare
+    errors keep working.
+    """
+
+    status_name = 'CMN_CKPT_CORRUPT'
+
+    def __init__(self, message, path=None, leaf=None, kind=None):
+        super().__init__(message)
+        self.path = path
+        self.leaf = leaf
+        self.kind = kind
+
+
+class CheckpointSkippedWarning(UserWarning):
+    """Emitted (via ``warnings.warn``) each time ``auto_resume`` skips
+    a corrupt or incomplete snapshot while walking the chain
+    newest-to-oldest -- the typed, greppable record that a fallback
+    happened and why."""
+
+
 class Deadline:
     """Absolute time budget for a (possibly multi-step) blocking
     operation.  ``timeout=None`` means unbounded (every query reports

@@ -22,6 +22,20 @@ echo "=== slow tail: 8 virtual devices ==="
 python -m pytest tests/ -q --runslow -m slow \
   --ignore=tests/test_multiprocess.py
 
+# ELASTIC + CORRUPTION LEG (ISSUE 5): 3 real jax.distributed
+# processes train ZeRO-1, get SIGTERMed into a manifest-tagged
+# regathered npz checkpoint, and RESUME AT 2 PROCESSES with the
+# optimizer partitions re-split 6->4 devices, matching the
+# fixed-topology oracle trajectory; plus corrupt-newest ->
+# fallback-to-previous-valid (bit-rotted snapshot skipped with the
+# typed CheckpointSkippedWarning, never loaded silently).  Runs
+# here, in the full-coverage pass -- the fast (tier-1) halves of the
+# integrity layer live in tests/test_chaos.py, so tier-1 wall time
+# stays inside its budget.
+echo "=== elastic topology-change + checkpoint-corruption leg ==="
+python -m pytest tests/test_multiprocess.py -q --runslow \
+  -k 'elastic or corrupt'
+
 # MULTI-CONTROLLER CHAOS LEG (VERDICT r5 items 5-6): 2-3 REAL
 # jax.distributed CPU processes (gloo collectives, one coordination
 # service) run the multiprocess suite once CLEAN and once UNDER
@@ -32,7 +46,8 @@ python -m pytest tests/ -q --runslow -m slow \
 # collective orbax checkpoint that auto-resumes to the exact
 # uninterrupted loss trajectory.  See docs/fault_tolerance.md.
 echo "=== multi-controller chaos leg: real jax.distributed CPU processes ==="
-python -m pytest tests/test_multiprocess.py -q --runslow
+python -m pytest tests/test_multiprocess.py -q --runslow \
+  -k 'not elastic and not corrupt'
 
 # REAL-DATA convergence gate (VERDICT r4 next #8): the same positive
 # gate, fed genuine handwritten digits (sklearn's vendored UCI scans,

@@ -1,0 +1,71 @@
+"""Single-process child for the kill-mid-write chaos test.
+
+Builds a tiny deterministic MLP run, writes a CLEAN preemption
+snapshot at iteration 2, trains on, then arms the ``ckpt_kill`` chaos
+site and checkpoints again at iteration 4: the process hard-dies
+between the temp-file write and the atomic rename (exit code 43).
+The parent test (``tests/test_chaos.py``) asserts the iteration-2
+snapshot survives intact and remains the ``auto_resume`` point while
+the iteration-4 snapshot never commits.
+"""
+
+import os
+import sys
+
+
+def main():
+    out = sys.argv[1]
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = \
+        '--xla_force_host_platform_device_count=2'
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    import numpy as np
+    import optax
+    import jax.numpy as jnp
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+    from chainermn_tpu.training import recovery
+    from chainermn_tpu.utils import chaos
+
+    comm = chainermn_tpu.create_communicator('xla')
+    model = MLP(n_units=8, n_out=3)
+    params0 = model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 6)))['params']
+    loss_fn = classifier_loss(
+        lambda p, x: model.apply({'params': p}, x))
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm)
+    rs = np.random.RandomState(0)
+    n = comm.size * 2
+    batch = [(rs.randn(6).astype(np.float32), int(rs.rand() * 3))
+             for _ in range(n)]
+
+    class _It:
+        epoch = 0
+        epoch_detail = 0.0
+        is_new_epoch = False
+
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            return batch
+
+    upd = training.StandardUpdater(_It(), opt, loss_fn, params0,
+                                   comm, has_aux=True, donate=False)
+    handler = recovery.PreemptionHandler(upd, out=out, signals=())
+    os.makedirs(out, exist_ok=True)
+    for _ in range(2):
+        upd.update()
+    handler.checkpoint()  # clean snapshot at iteration 2
+    for _ in range(2):
+        upd.update()
+    chaos.install(chaos.FaultInjector('ckpt_kill=@0'))
+    handler.checkpoint()  # dies mid-write: never returns
+    os._exit(99)  # NOT reached when the fault fires
+
+
+if __name__ == '__main__':
+    main()

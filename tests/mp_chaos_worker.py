@@ -30,6 +30,18 @@ Scenarios (``CMN_MP_SCENARIO``):
 - ``nan_guard``     chaos NaN burst in the host batch -> NanGuard
                     raises DivergenceError and writes the forensic
                     divergence checkpoint on every rank
+- ``train_elastic`` ELASTIC topology change: ZeRO-1 train loop over
+                    a topology-independent global batch; SIGTERM
+                    mid-step -> regathered npz checkpoint with the
+                    topology manifest -> clean exit; relaunch at a
+                    DIFFERENT process count (``CMN_MP_PHASE=resume``)
+                    auto-resumes with the optimizer partitions
+                    re-split N->M and must complete the exact
+                    fixed-topology oracle trajectory
+- ``train_fallback`` two preemption snapshots are written; the
+                    parent corrupts the newest between phases; the
+                    resume phase must skip it with a typed warning
+                    and continue from the previous valid one
 """
 
 import json
@@ -284,6 +296,135 @@ def scenario_train_preempt(rank, nprocs, outdir, res):
     serializers.wait_checkpoints()
 
 
+GLOBAL_ROWS = 12  # divisible by 4 and 6 devices: 2 and 3 procs
+
+
+def _build_train_global(rank, nprocs, comm, zero=False):
+    """Topology-INDEPENDENT training setup: the global batch is a
+    fixed 12-row matrix drawn from ONE seed, each process feeding its
+    slice -- so the loss trajectory is identical at ANY process
+    count.  That is the elastic-resume oracle property: a run
+    preempted at 3 processes and resumed at 2 must continue the same
+    curve.  ``zero=True`` shards the optimizer state over the mesh
+    (raw optax optimizer; broadcast-first is built in)."""
+    import jax
+    import numpy as np
+    import optax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    model = MLP(n_units=16, n_out=4)
+    x0 = jnp.zeros((1, 8), jnp.float32)
+    params0 = model.init(jax.random.PRNGKey(0), x0)['params']
+    loss_fn = classifier_loss(
+        lambda p, x: model.apply({'params': p}, x))
+    raw = optax.sgd(0.1, momentum=0.9)
+    opt = (raw if zero else
+           chainermn_tpu.create_multi_node_optimizer(raw, comm))
+    upd = training.StandardUpdater(
+        iter([]), opt, loss_fn, params0, comm, has_aux=True,
+        donate=False, zero=zero)
+    jax.block_until_ready((upd.params, upd.opt_state))
+    rs = np.random.RandomState(1234)  # same at every topology
+    gx_full = rs.randn(GLOBAL_ROWS, 8).astype(np.float32)
+    gy_full = (rs.rand(GLOBAL_ROWS) * 4).astype(np.int32)
+    lo = GLOBAL_ROWS * rank // nprocs
+    hi = GLOBAL_ROWS * (rank + 1) // nprocs
+    sh = NamedSharding(comm.mesh, comm.batch_spec())
+    gx = jax.make_array_from_process_local_data(
+        sh, gx_full[lo:hi], (GLOBAL_ROWS, 8))
+    gy = jax.make_array_from_process_local_data(
+        sh, gy_full[lo:hi], (GLOBAL_ROWS,))
+    return upd, (gx, gy)
+
+
+def _oracle_losses(rank, nprocs, comm, batch, zero):
+    """The fixed-topology oracle: the same model/global batch stepped
+    N_STEPS uninterrupted AT THIS SIZE.  Shielded from the injector
+    (its update_core calls must not consume fault occurrences meant
+    for the real run)."""
+    from chainermn_tpu.utils import chaos
+    saved = chaos.active()
+    chaos.uninstall()
+    oracle_upd, _ = _build_train_global(rank, nprocs, comm, zero=zero)
+    oracle = [_step_sync(oracle_upd, batch) for _ in range(N_STEPS)]
+    if saved is not None:
+        chaos.install(saved)
+    return oracle
+
+
+def _elastic_like_scenario(rank, nprocs, outdir, res, ckname, zero):
+    import jax
+    import numpy as np
+    import warnings
+    from chainermn_tpu import serializers
+    from chainermn_tpu.training import recovery
+    from chainermn_tpu.utils import failure
+
+    phase = os.environ.get('CMN_MP_PHASE', 'first')
+    comm = _comm(nprocs)
+    ckdir = os.path.join(outdir, ckname)
+    upd, batch = _build_train_global(rank, nprocs, comm, zero=zero)
+    handler = recovery.PreemptionHandler(upd, out=ckdir, method='npz')
+    if phase == 'resume':
+        res['oracle'] = _oracle_losses(rank, nprocs, comm, batch,
+                                       zero)
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter('always')
+            res['resumed_at'] = recovery.auto_resume(upd, ckdir)
+        res['skip_warnings'] = [
+            str(x.message) for x in w
+            if issubclass(x.category, failure.CheckpointSkippedWarning)]
+        # newest FULLY-verified snapshot (crc included -- the cheap
+        # latest_snapshot probe only checks the sentinel)
+        man, it_valid = None, None
+        for kind, path, it in recovery.snapshot_chain(ckdir):
+            try:
+                man = serializers.verify_checkpoint(path)
+                it_valid = it
+                break
+            except failure.CheckpointCorruptError:
+                continue
+        res['valid_snapshot_iter'] = it_valid
+        res['saved_world'] = man['world_size'] if man else None
+        res['cur_world'] = jax.process_count()
+    losses, checkpoints = [], []
+    while upd.iteration < N_STEPS:
+        losses.append(_step_sync(upd, batch))
+        if ckname == 'fb_state' and upd.iteration in (2, 4):
+            handler.checkpoint()  # periodic snapshots for fallback
+            checkpoints.append(upd.iteration)
+        if handler.maybe_checkpoint():
+            res['preempted_at'] = upd.iteration
+            break
+    res['losses'] = losses
+    res['checkpoints'] = checkpoints
+    res['final_iteration'] = upd.iteration
+    res['param_sum'] = float(sum(
+        np.asarray(jax.device_get(leaf)).sum()
+        for leaf in jax.tree_util.tree_leaves(upd.params)))
+
+
+def scenario_train_elastic(rank, nprocs, outdir, res):
+    """Train ZeRO-1 at N procs, SIGTERM -> manifest-tagged npz
+    checkpoint (optimizer partitions collectively regathered);
+    relaunched at M procs it elastically resumes -- partitions
+    re-split N->M -- and completes the fixed-topology oracle."""
+    _elastic_like_scenario(rank, nprocs, outdir, res, 'elastic_state',
+                           zero=True)
+
+
+def scenario_train_fallback(rank, nprocs, outdir, res):
+    """Write snapshots at iterations 2 and 4; the parent corrupts the
+    newest between phases; resume must skip it (typed warning) and
+    continue from iteration 2, matching the oracle."""
+    _elastic_like_scenario(rank, nprocs, outdir, res, 'fb_state',
+                           zero=False)
+
+
 def scenario_nan_guard(rank, nprocs, outdir, res):
     import jax
     import numpy as np
@@ -353,6 +494,8 @@ SCENARIOS = {
     'gc_orphan': scenario_gc_orphan,
     'cursor_rewind': scenario_cursor_rewind,
     'train_preempt': scenario_train_preempt,
+    'train_elastic': scenario_train_elastic,
+    'train_fallback': scenario_train_fallback,
     'nan_guard': scenario_nan_guard,
 }
 

@@ -14,6 +14,7 @@ import os
 import signal
 import time
 
+import jax
 import numpy as np
 import pytest
 
@@ -467,17 +468,267 @@ def test_auto_resume_without_snapshots_is_none(tmp_path):
 
 
 def test_latest_snapshot_prefers_highest_iteration(tmp_path):
+    from chainermn_tpu import serializers
     from chainermn_tpu.training import recovery
-    for name in ('snapshot_iter_3.npz', 'preempt_iter_7.npz',
-                 'snapshot_iter_5.npz'):
-        (tmp_path / name).write_bytes(b'x')
+    tree = {'x': np.arange(4.0)}
+    for name in ('snapshot_iter_3', 'preempt_iter_7',
+                 'snapshot_iter_5'):
+        serializers.save_npz(str(tmp_path / name), tree)
     kind, path, it = recovery.latest_snapshot(str(tmp_path))
     assert (kind, it) == ('npz', 7)
     assert path.endswith('preempt_iter_7.npz')
     # ties prefer the preemption snapshot (written after the periodic)
-    (tmp_path / 'snapshot_iter_7.npz').write_bytes(b'x')
+    serializers.save_npz(str(tmp_path / 'snapshot_iter_7'), tree)
     kind, path, it = recovery.latest_snapshot(str(tmp_path))
     assert path.endswith('preempt_iter_7.npz')
+    # the chain lists every candidate, newest first
+    chain = recovery.snapshot_chain(str(tmp_path))
+    assert [c[2] for c in chain] == [7, 7, 5, 3]
+
+
+def test_latest_snapshot_ignores_torn_and_sentinel_less_files(
+        tmp_path):
+    """A crash mid-write (zero-byte or sentinel-less file) can never
+    be selected as the resume point -- even outside elastic mode."""
+    from chainermn_tpu import serializers
+    from chainermn_tpu.training import recovery
+    serializers.save_npz(str(tmp_path / 'preempt_iter_2'),
+                         {'x': np.arange(4.0)})
+    # newest candidates are garbage: zero-byte and legacy/torn files
+    # without the write-complete manifest sentinel
+    (tmp_path / 'preempt_iter_9.npz').write_bytes(b'')
+    with open(str(tmp_path / 'preempt_iter_7.npz'), 'wb') as f:
+        np.savez(f, x=np.arange(4.0))  # valid zip, no sentinel
+    (tmp_path / 'preempt_iter_5.npz').write_bytes(b'not a zip')
+    kind, path, it = recovery.latest_snapshot(str(tmp_path))
+    assert (kind, it) == ('npz', 2)
+    # the raw chain still lists them (auto_resume walks + verifies)
+    assert [c[2] for c in recovery.snapshot_chain(str(tmp_path))] \
+        == [9, 7, 5, 2]
+
+
+# ----------------------------------------------------------------------
+# checkpoint integrity layer: manifest, atomic write, typed corruption
+# detection, fallback chain, kill-mid-write, elastic ZeRO resume
+
+def _small_tree():
+    return {'a': np.arange(6, dtype=np.float32).reshape(2, 3),
+            'b': {'c': np.ones(4, np.int32)}, 'it': 3}
+
+
+def test_save_npz_manifest_topology_tag_and_atomic_write(tmp_path):
+    from chainermn_tpu import serializers
+    path = serializers.save_npz(str(tmp_path / 'ck'), _small_tree(),
+                                mesh_shape={'inter': 1, 'intra': 8})
+    man = serializers.verify_checkpoint(path)
+    assert man['complete'] is True
+    assert man['world_size'] == 1
+    assert man['device_count'] == 8
+    assert man['mesh_shape'] == {'inter': 1, 'intra': 8}
+    assert man['leaves']['a']['shape'] == [2, 3]
+    assert man['leaves']['a']['dtype'] == 'float32'
+    assert isinstance(man['leaves']['a']['crc32'], int)
+    assert man['leaves']['b/c']['shape'] == [4]
+    # atomic write: no temp droppings under the final name
+    assert not [f for f in os.listdir(str(tmp_path))
+                if f.endswith('.tmp')]
+    # template probe passes for the matching tree, names a mismatch
+    serializers.verify_checkpoint(path, _small_tree())
+    with pytest.raises(failure.CheckpointCorruptError) as ei:
+        serializers.verify_checkpoint(
+            path, dict(_small_tree(), a=np.zeros((9,), np.float32)))
+    assert ei.value.leaf == 'a' and ei.value.kind == 'shape'
+
+
+def test_corruption_detected_typed_and_leaf_named(tmp_path):
+    from chainermn_tpu import serializers
+    tree = _small_tree()
+    path = serializers.save_npz(str(tmp_path / 'ck'), tree)
+    # truncation -> typed, never a bare zipfile error
+    blob = open(path, 'rb').read()
+    with open(path, 'wb') as f:
+        f.write(blob[:len(blob) // 2])
+    with pytest.raises(failure.CheckpointCorruptError) as ei:
+        serializers.load_npz(path, tree)
+    assert ei.value.kind in ('unreadable', 'crc', 'missing')
+    # bit rot -> typed (zip-member or manifest crc32 catches it)
+    with open(path, 'wb') as f:
+        f.write(blob)
+    serializers.verify_checkpoint(path)  # restored blob is clean
+    rot = bytearray(blob)
+    for i in range(8):
+        rot[(len(rot) * (i + 1)) // 9] ^= 0xFF
+    with open(path, 'wb') as f:
+        f.write(bytes(rot))
+    with pytest.raises(failure.CheckpointCorruptError):
+        serializers.verify_checkpoint(path)
+    # missing leaf -> typed with the leaf path
+    with open(path, 'wb') as f:
+        f.write(blob)
+    with pytest.raises(failure.CheckpointCorruptError) as ei:
+        serializers.load_npz(path, dict(tree, extra=np.zeros(2)))
+    assert ei.value.kind == 'missing' and ei.value.leaf == 'extra'
+    # dtype mismatch -> typed with the leaf path
+    with pytest.raises(failure.CheckpointCorruptError) as ei:
+        serializers.load_npz(
+            path, dict(tree, a=np.zeros((2, 3), np.float64)))
+    assert ei.value.kind == 'dtype' and ei.value.leaf == 'a'
+
+
+def test_chaos_ckpt_corruption_sites_detected(tmp_path):
+    from chainermn_tpu import serializers
+    for spec in ('ckpt_flip=@0', 'ckpt_truncate=@0'):
+        chaos.install(chaos.FaultInjector(spec))
+        try:
+            path = serializers.save_npz(
+                str(tmp_path / spec.split('=')[0]), _small_tree())
+            assert any(hit for _, _, hit in chaos.active().log)
+        finally:
+            chaos.uninstall()
+        with pytest.raises(failure.CheckpointCorruptError):
+            serializers.verify_checkpoint(path)
+        assert serializers.checkpoint_complete(path) is False
+
+
+def test_auto_resume_skips_corrupt_newest_with_typed_warning(
+        tmp_path):
+    """Corrupt-newest -> fallback-to-previous-valid: the chain walk
+    skips the poisoned snapshot with a typed warning and lands on
+    the newest VALID one instead of loading garbage or crashing."""
+    from chainermn_tpu.training import recovery
+    out = str(tmp_path / 'run')
+    trainer, upd = _mlp_trainer(out, n_iters=4)
+    trainer.run()
+    handler = recovery.PreemptionHandler(upd, out=out, signals=())
+    handler.checkpoint()  # VALID snapshot at iteration 4
+    upd.update()
+    upd.update()
+    # the newest snapshot (iteration 6) is bit-rotted at write time
+    chaos.install(chaos.FaultInjector('ckpt_flip=@0'))
+    try:
+        handler.checkpoint()
+    finally:
+        chaos.uninstall()
+    trainer2, upd2 = _mlp_trainer(str(tmp_path / 'fresh'), n_iters=4)
+    with pytest.warns(failure.CheckpointSkippedWarning,
+                      match='skipping corrupt snapshot'):
+        assert recovery.auto_resume(upd2, out) == 4
+    # latest_snapshot's cheap probe cannot see bit rot (crc is the
+    # expensive check), but the chain walk above never loads it
+    sums = [float(np.asarray(x).sum()) for x in
+            jax.tree_util.tree_leaves(upd2.params)]
+    live = [float(np.asarray(x).sum()) for x in
+            jax.tree_util.tree_leaves(upd.params)]
+    assert not np.allclose(sums, live)  # iteration-6 state NOT loaded
+
+
+def test_preemption_kill_mid_write_preserves_prior_snapshot(
+        tmp_path):
+    """PreemptionHandler.checkpoint() under the chaos kill-mid-write
+    fault: the process dies between temp write and atomic rename, so
+    the prior snapshot survives intact and auto_resume lands on it."""
+    import subprocess
+    import sys
+    from chainermn_tpu import serializers
+    from chainermn_tpu.training import recovery
+    out = str(tmp_path / 'run')
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'ckpt_kill_worker.py')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS',
+                        'CHAINERMN_TPU_CHAOS')}
+    env['PYTHONPATH'] = root + os.pathsep + env.get('PYTHONPATH', '')
+    proc = subprocess.run([sys.executable, worker, out], env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=240)
+    assert proc.returncode == 43, proc.stdout  # ckpt_kill exit code
+    # the mid-write snapshot never committed under its final name
+    assert not os.path.exists(
+        os.path.join(out, 'preempt_iter_4.npz'))
+    assert os.path.exists(
+        os.path.join(out, 'preempt_iter_4.npz.tmp'))
+    # the prior snapshot is intact and IS the resume point
+    man = serializers.verify_checkpoint(
+        os.path.join(out, 'preempt_iter_2.npz'))
+    assert man['complete'] is True and man['device_count'] == 2
+    kind, path, it = recovery.latest_snapshot(out)
+    assert (kind, it) == ('npz', 2)
+    trainer, upd = _mlp_trainer(str(tmp_path / 'fresh'), n_iters=2)
+    assert recovery.auto_resume(upd, out) == 2
+
+
+def _zero_updater(n_devices, mesh_shape, batch_rows=12):
+    """ZeRO-1 updater on a SUB-mesh of the 8 virtual devices, fed a
+    topology-independent global batch -- the single-controller
+    analogue of an elastic topology change."""
+    import jax.numpy as jnp
+    import optax
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    comm = chainermn_tpu.create_communicator(
+        'xla', devices=jax.devices()[:n_devices],
+        mesh_shape=mesh_shape)
+    model = MLP(n_units=8, n_out=3)
+    params0 = model.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 6)))['params']
+    loss_fn = classifier_loss(
+        lambda p, x: model.apply({'params': p}, x))
+    upd = training.StandardUpdater(
+        iter([]), optax.sgd(0.1, momentum=0.9), loss_fn, params0,
+        comm, has_aux=True, donate=False, zero=True)
+    rs = np.random.RandomState(7)  # SAME batch at any mesh size
+    bx = rs.randn(batch_rows, 6).astype(np.float32)
+    by = (rs.rand(batch_rows) * 3).astype(np.int32)
+    batch = upd.shard_batch(
+        [(x, int(y)) for x, y in zip(bx, by)])
+    return upd, batch
+
+
+def _run_losses(upd, batch, n):
+    return [float(np.asarray(jax.device_get(
+        upd.update_core(batch)['loss']))) for _ in range(n)]
+
+
+def test_elastic_zero_resume_across_device_counts(tmp_path):
+    """Elastic tentpole, single-controller: a ZeRO-1 checkpoint
+    written on a 6-device mesh resumes on a 4-device mesh -- stacked
+    optimizer partitions regathered and re-split 6->4 -- and the
+    post-resume trajectory matches an uninterrupted 4-device oracle
+    on the same global batch (momentum state survives exactly)."""
+    from chainermn_tpu import serializers
+    from chainermn_tpu.training import recovery
+    out = str(tmp_path / 'run')
+    upd6, batch6 = _zero_updater(6, (3, 2))
+    losses6 = _run_losses(upd6, batch6, 3)
+    handler = recovery.PreemptionHandler(upd6, out=out, signals=())
+    handler.preempt_requested = True
+    assert handler.maybe_checkpoint()
+
+    upd4, batch4 = _zero_updater(4, (2, 2))
+    assert recovery.auto_resume(upd4, out) == 3
+    losses4 = _run_losses(upd4, batch4, 3)
+
+    oracle_upd, oracle_batch = _zero_updater(4, (2, 2))
+    oracle = _run_losses(oracle_upd, oracle_batch, 6)
+    np.testing.assert_allclose(losses6 + losses4, oracle,
+                               rtol=0, atol=1e-4)
+
+    # the restore really took the reshard path, and the manifest
+    # recorded the writing topology
+    kind, path, it = recovery.latest_snapshot(out)
+    upd4b, _ = _zero_updater(4, (2, 2))
+    info = serializers.resume_updater(path, upd4b,
+                                      require_manifest=True)
+    assert info['resharded'] is True
+    assert info['manifest']['mesh_shape'] == {'inter': 3, 'intra': 2}
+    # elastic=False refuses the topology change, typed
+    upd4c, _ = _zero_updater(4, (2, 2))
+    with pytest.raises(failure.CheckpointCorruptError) as ei:
+        serializers.resume_updater(path, upd4c, elastic=False)
+    assert ei.value.kind == 'shape' and ei.value.leaf == 'opt_state'
 
 
 def test_nan_guard_divergence_checkpoint_via_chaos(tmp_path):

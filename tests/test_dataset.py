@@ -62,3 +62,19 @@ def test_epoch_helpers():
     assert n_iter == 3  # ceil(ceil(100/8)/5)
     assert chainermn_tpu.dataset.get_epoch_trigger(2, ds, 5, comm) == \
         (6, 'iteration')
+
+
+def test_epoch_position_preserved_across_shard_sizes():
+    """Elastic-resume rule: the GLOBAL epoch fraction survives a
+    topology change, re-expressed at the new shard length."""
+    from chainermn_tpu.dataset import epoch_position
+    assert epoch_position(2.6, 100) == (2, 60)
+    # the SAME global fraction on a different-length shard
+    epoch, pos = epoch_position(2.6, 67)
+    assert epoch == 2 and abs(pos / 67 - 0.6) < 1 / 67
+    assert epoch_position(3.0, 50) == (3, 0)
+    # position clamps to the shard (never indexes past the end)
+    assert epoch_position(0.999999, 4) == (0, 4)
+    assert epoch_position(0.0, 0) == (0, 0)
+    with pytest.raises(ValueError):
+        epoch_position(1.0, -1)

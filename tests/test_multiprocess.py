@@ -295,6 +295,71 @@ def test_sigterm_midstep_checkpoints_and_auto_resumes(tmp_path):
 
 
 @pytest.mark.slow
+def test_elastic_topology_change_resume_3_to_2_procs(tmp_path):
+    """THE elastic tentpole, end to end over real jax.distributed
+    processes: a ZeRO-1 run over a topology-independent global batch
+    is preempted at 3 processes (6 devices) -- the deterministic
+    injector SIGTERMs every rank at step 3, the handler regathers the
+    optimizer partitions and writes one manifest-tagged npz -- then
+    RELAUNCHED AT 2 PROCESSES (4 devices): auto_resume re-splits the
+    ZeRO partitions 6->4, re-places replicated state, and the
+    combined loss trajectory equals the uninterrupted fixed-topology
+    oracle (momentum state survives the reshard exactly)."""
+    first = _chaos(3, tmp_path, 'train_elastic',
+                   chaos_spec='seed=1;sigterm_step=@3')
+    for r in range(3):
+        assert first[r]['preempted_at'] == 4, first[r]
+        assert len(first[r]['losses']) == 4
+    # every rank of phase 1 observed the same (allreduced) losses
+    for r in (1, 2):
+        np.testing.assert_allclose(first[0]['losses'],
+                                   first[r]['losses'], atol=1e-6)
+    second = _chaos(2, tmp_path, 'train_elastic', phase='resume')
+    for r in (0, 1):
+        res = second[r]
+        assert res['resumed_at'] == 4, res
+        assert res['saved_world'] == 3 and res['cur_world'] == 2
+        assert res['skip_warnings'] == []  # nothing corrupt here
+        assert res['final_iteration'] == 6
+        full = first[0]['losses'] + res['losses']
+        np.testing.assert_allclose(full, res['oracle'],
+                                   rtol=0, atol=1e-4)
+    assert abs(second[0]['param_sum']
+               - second[1]['param_sum']) < 1e-5
+
+
+@pytest.mark.slow
+def test_corrupt_newest_snapshot_falls_back_to_previous(tmp_path):
+    """Corrupt-newest -> fallback-to-previous-valid, multi
+    controller: snapshots exist at iterations 2 and 4; the newest is
+    bit-rotted between phases; every rank's auto_resume must skip it
+    with the typed warning, resume from iteration 2 and still match
+    the oracle -- corrupt state is NEVER silently loaded."""
+    first = _chaos(2, tmp_path, 'train_fallback')
+    for r in (0, 1):
+        assert first[r]['checkpoints'] == [2, 4], first[r]
+        assert first[r]['final_iteration'] == 6
+    newest = os.path.join(str(tmp_path), 'fb_state',
+                          'preempt_iter_4.npz')
+    blob = bytearray(open(newest, 'rb').read())
+    for i in range(8):  # spread bit rot across the file
+        blob[(len(blob) * (i + 1)) // 9] ^= 0xFF
+    with open(newest, 'wb') as f:
+        f.write(bytes(blob))
+    second = _chaos(2, tmp_path, 'train_fallback', phase='resume')
+    for r in (0, 1):
+        res = second[r]
+        assert res['resumed_at'] == 2, res
+        assert res['valid_snapshot_iter'] == 2
+        assert any('skipping corrupt snapshot' in w
+                   for w in res['skip_warnings']), res
+        assert res['final_iteration'] == 6
+        # steps 2..5 continue the uninterrupted oracle exactly
+        np.testing.assert_allclose(res['losses'], res['oracle'][2:],
+                                   rtol=0, atol=1e-4)
+
+
+@pytest.mark.slow
 def test_nan_burst_divergence_checkpoint_all_ranks(tmp_path):
     # chaos NaN burst in the host batch -> NanGuard stops the run
     # with a DivergenceError and writes the forensic checkpoint on

@@ -54,6 +54,34 @@ def test_multiprocess_iterator_prefetch():
     it.finalize()
 
 
+def test_serial_iterator_restore_position_across_shard_sizes():
+    """Elastic resume: the saved GLOBAL epoch fraction lands at the
+    equivalent position of a DIFFERENT-length shard, so the epoch
+    boundary fires where the interrupted run would have hit it."""
+    it = training.SerialIterator(list(range(10)), 2, shuffle=False)
+    for _ in range(3):
+        next(it)
+    assert it.epoch_detail == 0.6
+    # resume on a 5-item shard (e.g. 2x the process count)
+    it2 = training.SerialIterator(list(range(5)), 1, shuffle=False)
+    it2.restore_position(it.epoch_detail)
+    assert it2.epoch == 0
+    assert it2.epoch_detail == 0.6
+    next(it2)
+    next(it2)
+    assert it2.is_new_epoch and it2.epoch == 1
+
+
+def test_multiprocess_iterator_restore_position():
+    it = training.iterators.MultiprocessIterator(
+        list(range(8)), 2, shuffle=False)
+    it.restore_position(1.5)
+    assert it.epoch == 1
+    assert it.epoch_detail == 1.5
+    assert len(next(it)) == 2  # still serves batches after rebase
+    it.finalize()
+
+
 def test_concat_examples_padding():
     batch = [(np.ones((3,), np.float32), 1), (np.zeros((3,), np.float32),
                                               2)]
@@ -70,11 +98,15 @@ def test_serializers_roundtrip(tmp_path):
     np.testing.assert_array_equal(np.asarray(loaded['a']),
                                   np.asarray(tree['a']))
     assert loaded['nested']['b'].dtype == jnp.bfloat16
-    # template mismatch is detected
+    # template mismatch raises the TYPED error (a ValueError
+    # subclass) naming the offending leaf path
+    from chainermn_tpu.utils import failure
     bad = {'a': jnp.zeros((3, 2)), 'nested': {'b': jnp.ones((4,))},
            'step': 0}
-    with pytest.raises(ValueError):
+    with pytest.raises(failure.CheckpointCorruptError) as ei:
         serializers.load_npz(path, bad)
+    assert ei.value.leaf == 'a' and ei.value.kind == 'shape'
+    assert isinstance(ei.value, ValueError)
 
 
 def _small_trainer(tmp_path, n_epoch=1):

@@ -403,3 +403,37 @@ def test_zero_composes_with_accum_steps():
 
     assert n_while(2) > n_while(1), \
         'accum_steps=2 zero step lowered without the micro-batch scan'
+
+
+def test_elastic_reshard_helpers_match_param_shard_layout():
+    """regather/re-split round-trips exactly, and the host-side split
+    reproduces what ``param_shard_leaf`` cuts on-device -- the
+    invariant the elastic N->M optimizer-state reshard leans on."""
+    from chainermn_tpu.parallel import zero
+    full = np.arange(10.0, dtype=np.float32)
+    st3 = zero.reshard_flat_leaf(full, 3)
+    assert st3.shape == (3, zero.shard_len(10, 3))
+    np.testing.assert_array_equal(
+        zero.regather_stacked_leaf(st3, 10), full)
+    # tree-level elastic reshard 3 -> 4 == direct split at 4
+    tmpl = {'m': np.zeros((4, zero.shard_len(10, 4)), np.float32),
+            'count': np.int32(0)}
+    out = zero.reshard_stacked_state(
+        {'m': st3, 'count': np.int32(5)}, tmpl)
+    np.testing.assert_array_equal(out['m'],
+                                  zero.reshard_flat_leaf(full, 4))
+    assert out['count'] == 5  # replicated scalars pass through
+    # shrink direction too (4 -> 2), padding truncated exactly
+    st4 = zero.reshard_flat_leaf(full, 4)
+    out2 = zero.reshard_stacked_state(
+        {'m': st4},
+        {'m': np.zeros((2, zero.shard_len(10, 2)), np.float32)})
+    np.testing.assert_array_equal(out2['m'],
+                                  zero.reshard_flat_leaf(full, 2))
+    # the numpy split matches param_shard_leaf's on-device slices
+    for n in (2, 3, 4):
+        st = zero.reshard_flat_leaf(full, n)
+        for r in range(n):
+            got = np.asarray(zero.param_shard_leaf(
+                jnp.asarray(full), n, r))
+            np.testing.assert_array_equal(got, st[r])
