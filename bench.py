@@ -767,6 +767,65 @@ BUILDERS = dict(
 assert set(BUILDERS) == set(MODELS)
 
 
+def phase_stats(cfg, quick, trace_steps=3):
+    """Per-step evidence for the row (ISSUE 6): individually timed
+    ``update_core`` calls give step-time p50/p99 (the scan-based
+    headline measures the mean only, and a claim without tails is
+    half a claim), and a short ``jax.profiler`` capture of the same
+    steps runs through ``benchmarks/trace_report.py``'s overlap
+    computation -- collective span time hidden behind compute vs
+    exposed -- so every future perf number ships with its own
+    overlap evidence.  Best-effort by contract: a converter/profiler
+    failure yields a partial dict with ``phase_stats_error``, never a
+    dead row."""
+    import shutil
+    import tempfile
+
+    import jax
+
+    out = {}
+    upd, arrays = cfg['upd'], cfg['arrays']
+    n_steps = 5 if quick else 10
+    try:
+        jax.block_until_ready(upd.update_core(arrays))  # warm/compile
+        times = []
+        for _ in range(n_steps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(upd.update_core(arrays))
+            times.append((time.perf_counter() - t0) * 1e3)
+        times.sort()
+        n = len(times)
+        out['step_time_p50_ms'] = round(times[n // 2], 3)
+        out['step_time_p99_ms'] = round(
+            times[min(n - 1, int(n * 0.99))], 3)
+    except Exception as e:
+        out['phase_stats_error'] = 'step timing: %r' % e
+        return out
+    td = tempfile.mkdtemp(prefix='bench_overlap_')
+    try:
+        with jax.profiler.trace(td):
+            for _ in range(trace_steps):
+                metrics = upd.update_core(arrays)
+            jax.block_until_ready(metrics)
+        from benchmarks import trace_report
+        import glob as _glob
+        paths = sorted(_glob.glob(
+            os.path.join(td, '**', '*.xplane.pb'), recursive=True))
+        ov = trace_report.overlap_stats_from_paths(paths)
+        out['overlap_fraction'] = ov['overlap_fraction']
+        exposed = ov['exposed_collective_ms']
+        out['exposed_collective_ms'] = (
+            round(exposed / trace_steps, 3) if exposed is not None
+            else None)
+    except Exception as e:
+        out.setdefault('overlap_fraction', None)
+        out.setdefault('exposed_collective_ms', None)
+        out['phase_stats_error'] = 'overlap capture: %r' % e
+    finally:
+        shutil.rmtree(td, ignore_errors=True)
+    return out
+
+
 def measure(argv):
     """The actual benchmark (runs inside the watchdogged child)."""
     quick = '--quick' in argv
@@ -999,6 +1058,11 @@ def measure(argv):
             suspect_reasons.append(
                 'achieved %.1f TF/s exceeds self-calibrated matmul '
                 'roofline %.1f TF/s' % (gate_tf, matmul_tflops))
+    if ('--no-phase-stats' not in argv and 'upd' in cfg
+            and 'arrays' in cfg):
+        _log('phase stats: per-step p50/p99 + overlap capture')
+        result.update(phase_stats(cfg, quick))
+
     noise = _noise_estimate(times, reps)
     if per_step * (ks[-1] - ks[0]) < SIGNAL_MULT * noise:
         suspect_reasons.append(

@@ -177,6 +177,70 @@ def _xplane_pb2():
         return xplane_pb2
 
 
+def _collect_intervals(paths):
+    """``{plane_name: [(start_us, end_us, bucket), ...]}`` from the
+    raw XSpace protos -- the timestamped view the overlap computation
+    needs (op-stats tables carry self-times only, no concurrency
+    information).  Spans from every line of a plane are pooled: a
+    collective on one line overlaps compute on another line of the
+    same plane (async collective streams / other cores)."""
+    pb = _xplane_pb2()
+    out = {}
+    for path in paths:
+        space = pb.XSpace()
+        with open(path, 'rb') as f:
+            space.ParseFromString(f.read())
+        for plane in space.planes:
+            meta = plane.event_metadata
+            ivs = out.setdefault(plane.name, [])
+            for line in plane.lines:
+                for ev in line.events:
+                    name = meta[ev.metadata_id].name
+                    if name.startswith('$'):
+                        continue  # python tracing scaffolding
+                    start = ev.offset_ps / 1e6
+                    ivs.append((start, start + ev.duration_ps / 1e6,
+                                bucket_of(name)))
+    return out
+
+
+#: buckets whose spans count as compute a collective can hide behind
+OVERLAP_COMPUTE = ('conv/matmul', 'fusion/elementwise', 'reduction')
+
+
+def overlap_stats_from_paths(paths):
+    """Trace-wide overlap statistics: per plane, the ``collective``-
+    bucket intervals vs the union of compute-bucket intervals, summed
+    across planes.  Uses the SAME interval arithmetic and definition
+    as the runtime telemetry layer
+    (:mod:`chainermn_tpu.telemetry.report`): ``overlap_fraction =
+    1 - exposed/total``, None when the trace has no collective spans
+    (absence of evidence is reported as absence)."""
+    from chainermn_tpu.telemetry.report import overlap_from_intervals
+
+    total = exposed = 0.0
+    seen = False
+    for ivs in _collect_intervals(paths).values():
+        coll = [(a, b) for a, b, bk in ivs if bk == 'collective']
+        if not coll:
+            continue
+        comp = [(a, b) for a, b, bk in ivs
+                if bk in OVERLAP_COMPUTE]
+        st = overlap_from_intervals(coll, comp)
+        total += st['total_collective_s']   # _us actually; see below
+        exposed += st['exposed_collective_s']
+        seen = True
+    # intervals above are in MICROSECONDS, so the "seconds" fields of
+    # overlap_from_intervals come back in us; normalize to ms here
+    return {
+        'total_collective_ms': round(total / 1e3, 3),
+        'exposed_collective_ms': round(exposed / 1e3, 3),
+        'overlap_fraction': (
+            None if not seen or total <= 0.0
+            else round(max(0.0, min(1.0, 1.0 - exposed / total)), 4)),
+    }
+
+
 def _collect_host_events(paths, min_self_us=1.0):
     """(buckets, ops) from the raw XSpace host planes.
 
@@ -303,6 +367,16 @@ def analyze_trace(trace_dir):
         out['error'] = ('trace has no device-op, framework-op or '
                         'host-plane rows')
         return out
+    # overlap column (ISSUE 6 / ROADMAP item 5): collective span time
+    # hidden behind compute vs exposed, from the raw xplane intervals
+    # (best-effort: op-stats-only traces carry no timestamps)
+    try:
+        out['overlap'] = overlap_stats_from_paths(paths)
+    except Exception as e:
+        out['overlap'] = {'total_collective_ms': None,
+                          'exposed_collective_ms': None,
+                          'overlap_fraction': None,
+                          'error': repr(e)}
     util = device_utilization(paths)
     if util:
         out['device_utilization'] = util
@@ -335,6 +409,17 @@ def render(report):
         return '\n'.join(lines)
     lines.append('  total device self time: %.1f us'
                  % report['total_self_time_us'])
+    ov = report.get('overlap') or {}
+    if ov.get('overlap_fraction') is not None:
+        lines.append(
+            '  overlap fraction: %.3f  (collective %.3f ms, '
+            '%.3f ms exposed)'
+            % (ov['overlap_fraction'], ov['total_collective_ms'],
+               ov['exposed_collective_ms']))
+    elif ov:
+        lines.append('  overlap: no collective spans in trace%s'
+                     % (' (%s)' % ov['error'] if ov.get('error')
+                        else ''))
     for key, val in (report.get('device_utilization') or {}).items():
         lines.append('  %s: %s' % (key, val))
     for name, b in report['buckets'].items():
@@ -376,7 +461,13 @@ def main(argv):
         # failed capture dirs) -- rewrite the artifact with an
         # explanatory stub so it always reflects the LATEST capture
         # state instead of contradicting a jsonl row's trace_error
+        # SAME row shape as the banked-artifact path (one JSONL row,
+        # 'trace_dir' key always present, errors under 'error'): JSON
+        # consumers iterate rows and read row['trace_dir'] / .get(
+        # 'error') uniformly -- the old stub omitted trace_dir and
+        # diverged from the per-dir schema
         stub = {
+            'trace_dir': None,
             'error': 'no trace dirs found',
             'detail': ('no capture dirs under %s at report time; any '
                        'previous per-op breakdown is superseded (its '

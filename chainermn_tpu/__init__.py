@@ -29,6 +29,7 @@ from chainermn_tpu.multi_node_evaluator import create_multi_node_evaluator  # no
 from chainermn_tpu.multi_node_optimizer import create_multi_node_optimizer  # noqa
 from chainermn_tpu import precision  # noqa
 from chainermn_tpu.precision import Policy  # noqa
+from chainermn_tpu import telemetry  # noqa
 from chainermn_tpu import utils  # noqa
 
 __version__ = '0.1.0'

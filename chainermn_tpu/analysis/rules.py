@@ -39,19 +39,25 @@ class RuleContext:
         model's native compute dtype); SL008 audits f32
         materializations only in declared-narrow graphs.  None
         disables that rule.
+      overlap_check: run the SL009 collective-overlap audit on this
+        target.  True for train-step targets only: a standalone
+        collective helper (a strategy's bare ``allreduce_grad``) has
+        nothing to overlap with BY CONSTRUCTION and would always
+        read as serialized.
       trace_error: exception raised while tracing, if any.
     """
 
     def __init__(self, target_name, jaxpr=None, mesh_axes=None,
                  reduction_axes=None, signatures=None,
                  trace_error=None, declared_dtypes=None,
-                 compute_dtype=None):
+                 compute_dtype=None, overlap_check=False):
         self.target_name = target_name
         self.jaxpr = jaxpr
         self.mesh_axes = dict(mesh_axes or {})
         self.reduction_axes = reduction_axes
         self.declared_dtypes = declared_dtypes
         self.compute_dtype = compute_dtype
+        self.overlap_check = overlap_check
         self.signatures = signatures
         self.trace_error = trace_error
 
@@ -363,6 +369,155 @@ def rule_f32_materialization(ctx):
     return out
 
 
+# ---------------------------------------------------------------------
+# SL009: a gradient-sized reduce collective must be SCHEDULABLE before
+# its last consumer -- i.e. the program level containing it must hold
+# work that neither feeds the collective nor consumes its result, so
+# XLA's latency-hiding scheduler has something to hide the collective
+# behind.  A step whose whole reduction is one fused buffer (flat /
+# one-bucket strategies) serializes as
+#   full backward -> pack -> ONE collective -> unpack -> optimizer:
+# every equation is an ancestor or a descendant of the collective and
+# the communication time is fully EXPOSED.  The bucketed strategy with
+# >= 2 buckets is the clean state: each bucket's collective overlaps
+# the other buckets' packing/reduction and the optimizer math of
+# already-reduced buckets.  Scope: step targets only
+# (ctx.overlap_check; see RuleContext).  Severity WARNING by design --
+# like SL008 this is the chase list for ROADMAP item 5, and the
+# dynamic twin (the telemetry/trace overlap fraction) measures what
+# this rule predicts.
+
+#: data-movement / dtype plumbing that cannot hide a collective's
+#: latency (pack/unpack around a fused reduce is exactly this)
+_SL009_TRIVIAL = frozenset((
+    'convert_element_type', 'reshape', 'broadcast_in_dim', 'squeeze',
+    'expand_dims', 'transpose', 'copy', 'slice', 'dynamic_slice',
+    'dynamic_update_slice', 'concatenate', 'bitcast_convert_type',
+    'stop_gradient', 'select_n'))
+#: audit only reductions moving at least this many bytes: scalar
+#: metric/loss psums are latency-bound either way and would drown the
+#: report in noise
+_SL009_MIN_BYTES = 4096
+#: the level must hold at least this much other substantial work for
+#: "nothing is independent" to mean "serialized" rather than "tiny
+#: helper jaxpr"
+_SL009_MIN_LEVEL_WORK = 3
+
+
+def _sl009_work_floor(nbytes):
+    """Bytes an equation must touch to count as work that could hide
+    a collective of ``nbytes``: non-negligible RELATIVE to the
+    collective (1/64th), floored at 512 B.  Without the relative
+    scaling, scalar bookkeeping (adam's bias-correction powers) would
+    count as 'independent work' and mask a fully serialized multi-MB
+    reduction."""
+    return max(512, nbytes // 64)
+
+
+def _aval_bytes(aval):
+    try:
+        size = 1
+        for d in aval.shape:
+            size *= int(d)
+        return size * np.dtype(aval.dtype).itemsize
+    except (TypeError, AttributeError):
+        return 0
+
+
+def rule_collective_overlap(ctx):
+    out = []
+    if ctx.jaxpr is None or not getattr(ctx, 'overlap_check', False):
+        return out
+    for jx, _path in walker.iter_jaxprs(ctx.jaxpr):
+        eqns = walker.raw_jaxpr(jx).eqns
+        n = len(eqns)
+        if n < 2:
+            continue
+        producer = {}
+        for i, eqn in enumerate(eqns):
+            for var in eqn.outvars:
+                producer[var] = i
+        # ancestor bitsets in one forward pass (eqn order is a
+        # topological order of the level's def-use graph); direct
+        # consumers collected for the reverse (descendant) pass
+        anc = [0] * n
+        consumers = [[] for _ in range(n)]
+        for i, eqn in enumerate(eqns):
+            mask = 0
+            for var in eqn.invars:
+                if hasattr(var, 'val'):
+                    continue  # Literal constant: no producer
+                p = producer.get(var)
+                if p is not None:
+                    mask |= anc[p] | (1 << p)
+                    consumers[p].append(i)
+            anc[i] = mask
+        desc = [0] * n
+        for i in range(n - 1, -1, -1):
+            mask = 0
+            for j in consumers[i]:
+                mask |= desc[j] | (1 << j)
+            desc[i] = mask
+        def eqn_bytes(eqn):
+            vals = [_aval_bytes(v.aval) for v in
+                    list(eqn.invars) + list(eqn.outvars)
+                    if hasattr(v, 'aval')]
+            return max(vals, default=0)
+
+        axis_index_mask = 0
+        nontrivial = []
+        for i, eqn in enumerate(eqns):
+            if eqn.primitive.name == 'axis_index':
+                axis_index_mask |= 1 << i
+            if eqn.primitive.name not in _SL009_TRIVIAL:
+                nontrivial.append((i, eqn_bytes(eqn)))
+        # the level's schedulable reduce collectives (>= 512 B so a
+        # genuinely bucketed sibling counts even when small, but
+        # scalar metric psums do not), excluding rank-addressed ones
+        # (the root-select psum lowering broadcast_data is a sync
+        # primitive, not a gradient-reduction schedule)
+        reduces = [
+            i for i, eqn in enumerate(eqns)
+            if eqn.primitive.name in walker.REDUCE_PRIMS
+            and walker.eqn_axes(eqn)
+            and not (anc[i] & axis_index_mask)
+            and eqn_bytes(eqn) >= 512]
+        for i in reduces:
+            eqn = eqns[i]
+            nbytes = max((_aval_bytes(v.aval) for v in eqn.invars
+                          if hasattr(v, 'aval')), default=0)
+            if nbytes < _SL009_MIN_BYTES:
+                continue
+            related = anc[i] | desc[i]
+            # a SIBLING reduce neither feeding nor consuming this one
+            # is exactly what bucketed/per-leaf strategies create: the
+            # collectives pipeline with one another and with the
+            # pack/unpack + optimizer math of already-reduced buckets,
+            # so each is schedulable before its last consumer
+            if any(j != i and not (related >> j) & 1
+                   for j in reduces):
+                continue
+            floor = _sl009_work_floor(nbytes)
+            big_rest = [j for j, b in nontrivial
+                        if j != i and b >= floor]
+            if len(big_rest) < _SL009_MIN_LEVEL_WORK:
+                continue  # tiny helper level, nothing to judge
+            out.append(ctx.finding(
+                'SL009', SEV_WARNING,
+                '%s of %.1f KB is the ONLY schedulable reduce at its '
+                'program level: every gradient must exist before the '
+                'fused collective starts and its %d consumers-and-'
+                'producers serialize around it, so its wire time is '
+                'exposed in the step.  Split the reduction into '
+                'buckets issued as gradients complete (the '
+                "'bucketed' strategy with bucket_mb sized for >= 2 "
+                'buckets) so each collective overlaps the remaining '
+                'backward/optimizer work'
+                % (eqn.primitive.name, nbytes / 1e3, len(big_rest)),
+                eqn))
+    return out
+
+
 #: rule id -> (callable, one-line description)
 RULES = {
     'SL001': (rule_axis_topology,
@@ -387,6 +542,10 @@ RULES = {
               'no f32-materialized activation-sized intermediates '
               'inside declared-bf16/f16 compute graphs (outside the '
               'kernel layer)'),
+    'SL009': (rule_collective_overlap,
+              'gradient-sized reduce collectives are schedulable '
+              'before their last consumer (independent work exists '
+              'to overlap them with; step targets only)'),
 }
 
 

@@ -33,6 +33,7 @@ def lint_target(target, only=None):
         reduction_axes=target.reduction_axes,
         declared_dtypes=getattr(target, 'declared_dtypes', None),
         compute_dtype=getattr(target, 'compute_dtype', None),
+        overlap_check=getattr(target, 'overlap_check', False),
         signatures=signatures, trace_error=err)
     findings = rules_mod.run_rules(ctx, only=only)
     # a trace failure no rule claimed (SL001 claims unbound-axis

@@ -37,11 +37,15 @@ class LintTarget:
       make_args: ``make_args(iteration) -> args`` for targets with an
         iteration-dependent signature (recompilation rule); None
         disables that rule.
+      overlap_check: run the SL009 collective-overlap audit.  True
+        for train-step targets (set by ``_updater_target`` /
+        ``zero_core_target``); a strategy's bare collective surface
+        has nothing to overlap with by construction and is excluded.
     """
 
     def __init__(self, name, fn, args, mesh_axes, reduction_axes=None,
                  make_args=None, declared_dtypes=None,
-                 compute_dtype=None, items=None):
+                 compute_dtype=None, items=None, overlap_check=False):
         self.name = name
         self.fn = fn
         self.args = tuple(args)
@@ -51,6 +55,7 @@ class LintTarget:
                                 if declared_dtypes else None)
         self.compute_dtype = compute_dtype
         self.items = items
+        self.overlap_check = overlap_check
         self.make_args = make_args
 
     def __repr__(self):
@@ -142,7 +147,7 @@ def _updater_target(name, updater, batch, mesh_axes,
                        lambda: None)()
     return LintTarget(
         name, fn, args, mesh_axes, declared_dtypes=declared,
-        compute_dtype=compute_dtype, items=items,
+        compute_dtype=compute_dtype, items=items, overlap_check=True,
         make_args=lambda it: updater.traceable_step(
             batch, iteration=it)[1])
 
@@ -189,6 +194,48 @@ def mlp_step_target(comm=None, policy=None):
                            items=16)
 
 
+def bucketed_overlap_step_target(policy=None):
+    """The bucketed-overlap reference step: the mnist-shaped train
+    step on the ``bucketed`` strategy with ``bucket_mb`` sized so the
+    MLP's gradients split into >= 2 fused buckets.  This is the SL009
+    clean state -- each bucket's collective has the other buckets'
+    reduction and optimizer math as independently schedulable work --
+    whereas the fused single-buffer strategies (``xla``/``flat``, and
+    ``bucketed`` with everything in one bucket) read as serialized.
+    ``ci/run_staticcheck.sh`` pins exactly this split: SL009 silent
+    here, firing on the fused ``step:mlp_example``."""
+    import optax
+    import chainermn_tpu
+    from chainermn_tpu import communicators, training
+    from chainermn_tpu.communicators import mesh_utility
+    from chainermn_tpu.models import MLP, Classifier
+
+    n = len(jax.devices())
+    # 0.01 MB buckets: the 784x16 first-layer weight (~50 KB f32)
+    # overflows into its own bucket, everything else shares one
+    comm = communicators.create_communicator(
+        'bucketed', mesh_shape=mesh_utility.balanced_2d(n),
+        bucket_mb=0.01,
+        reduce_dtype=policy.reduce_dtype if policy is not None
+        else None)
+    model = MLP(n_units=16, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))
+    clf = Classifier(model.apply)
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    updater = training.StandardUpdater(
+        iter([]), optimizer, clf, params, comm, has_aux=True,
+        policy=policy)
+    batch = _policy_batch(policy, (
+        jnp.zeros((16, 784), jnp.float32),
+        jnp.zeros((16,), jnp.int32)))
+    return _updater_target('step:bucketed_overlap', updater, batch,
+                           dict(comm.mesh.shape),
+                           compute_dtype=_policy_compute(policy),
+                           items=16)
+
+
 def zero_step_target(comm=None, policy=None):
     """The full ZeRO-1 train step (``StandardUpdater(zero=True)``)."""
     import optax
@@ -223,7 +270,7 @@ def zero_core_target(comm=None):
     fn, args = zero.traceable_shard_update(
         optax.adam(1e-3), params, comm)
     return LintTarget('step:zero_core', fn, args,
-                      dict(comm.mesh.shape))
+                      dict(comm.mesh.shape), overlap_check=True)
 
 
 def pipeline_step_target(policy=None):
@@ -299,6 +346,7 @@ def resnet50_step_target(comm=None, insize=32, batch=8, policy=None,
 def step_targets(include_resnet50=True, policy=None):
     out = [mlp_step_target(policy=policy), zero_core_target(),
            zero_step_target(policy=policy),
+           bucketed_overlap_step_target(policy=policy),
            pipeline_step_target(policy=policy)]
     if include_resnet50:
         # unfused (flax-oracle) AND fused train steps: the SL008 /

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import optax
 from jax import lax
 
+from chainermn_tpu import telemetry as _telemetry
+
 
 class MultiNodeOptimizerState(NamedTuple):
     needs_broadcast: jnp.ndarray  # bool scalar
@@ -96,12 +98,23 @@ def create_multi_node_optimizer(actual_optimizer, communicator,
         def first_call(_):
             # Initial weight sync in place of a step (reference :23-26);
             # like the reference, no gradient allreduce is paid here.
+            if _telemetry._active is not None:
+                # trace-time mark: the L4 wrapper's broadcast is in
+                # the program.  Fires once per COMPILATION -- the
+                # broadcast-appears-exactly-once regression test pins
+                # both the wrapper semantics and the no-recompile
+                # contract on this event's count.
+                _telemetry.event('multi_node_optimizer:broadcast_data',
+                                 kind='collective_trace')
             synced = communicator.broadcast_data(params)
             updates = jax.tree_util.tree_map(
                 lambda s, p: (s - p).astype(p.dtype), synced, params)
             return updates, state.actual_state
 
         def reduce_now():
+            if _telemetry._active is not None:
+                _telemetry.event('multi_node_optimizer:allreduce_grad',
+                                 kind='collective_trace')
             g = grads
             if allreduce_dtype is not None:
                 g = jax.tree_util.tree_map(

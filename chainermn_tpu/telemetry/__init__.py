@@ -1,0 +1,135 @@
+"""Unified runtime telemetry: per-step event timeline, collective
+spans, metrics export (ROADMAP item 5's evidence layer).
+
+The reference stack has no observability subsystem at all; here the
+runtime narrates itself.  A low-overhead per-process recorder
+(:mod:`chainermn_tpu.telemetry.recorder`) is threaded through the
+layers that matter -- communicator eager collectives and the object
+p2p channel (``communicators/base.py``), step phases in both updaters
+(host batch prep / H2D / jitted step / metrics sync), checkpoint
+write/verify/resume (``training/recovery.py``), and chaos fault
+injections (``utils/chaos.py``) -- so a fault and its latency
+consequences correlate in ONE timeline.  On top: a metrics registry
+(counters / gauges / histograms with p50/p99), per-rank JSONL event
+logs, an aggregated ``metrics.json``, and a Prometheus text exporter;
+``python -m chainermn_tpu.telemetry report`` merges per-rank logs
+into a step timeline and computes the **overlap fraction** (collective
+time hidden behind compute vs exposed) -- the dynamic twin of the
+static shardlint rule SL009.  See ``docs/observability.md``.
+
+Activation (exactly the chaos discipline -- zero cost when off)::
+
+    CHAINERMN_TPU_TELEMETRY=/path/to/outdir python train.py
+    # optional: device-sync fences (spans measure completion, not
+    # dispatch; serializes the device -- a measurement mode)
+    CHAINERMN_TPU_TELEMETRY_SYNC=1
+
+or programmatically::
+
+    from chainermn_tpu import telemetry
+    rec = telemetry.enable('/tmp/tele')   # or enable() for in-memory
+    ...
+    rec.flush()                           # also registered atexit
+
+Hot call sites guard on ``telemetry._active is not None`` (one
+attribute load + identity check); :func:`span`/:func:`event` are
+additionally safe to call unconditionally -- disabled, they cost one
+function call and return a preallocated no-op context.
+"""
+
+import os
+
+from chainermn_tpu.telemetry.recorder import (  # noqa: F401
+    Counter, Gauge, Histogram, NULL_SPAN, Recorder, Registry,
+    snapshot_to_prometheus)
+
+ENV_VAR = 'CHAINERMN_TPU_TELEMETRY'
+ENV_SYNC = 'CHAINERMN_TPU_TELEMETRY_SYNC'
+
+_active = None
+_env_checked = False
+
+
+def active():
+    """The installed :class:`Recorder`, or None."""
+    return _active
+
+
+def enabled():
+    return _active is not None
+
+
+def enable(outdir=None, sync_fences=None):
+    """Install a recorder (idempotent per process: re-enabling with a
+    different outdir re-points the existing recorder's flush so spans
+    recorded before ``enable`` are not lost)."""
+    global _active
+    if sync_fences is None:
+        sync_fences = os.environ.get(ENV_SYNC, '') not in ('', '0')
+    if _active is None:
+        _active = Recorder(outdir=outdir, sync_fences=sync_fences)
+        if outdir is not None:
+            import atexit
+            atexit.register(_flush_at_exit)
+    elif outdir is not None and _active.outdir is None:
+        _active.outdir = outdir
+        import atexit
+        atexit.register(_flush_at_exit)
+    return _active
+
+
+def disable():
+    """Uninstall (testing hook; does NOT flush)."""
+    global _active, _env_checked
+    _active, _env_checked = None, False
+
+
+def _flush_at_exit():
+    rec = _active
+    if rec is not None and rec.outdir is not None:
+        try:
+            rec.flush()
+        except Exception:
+            pass  # interpreter teardown: never mask the real exit
+
+
+def maybe_enable_from_env(env_var=ENV_VAR):
+    """Install a recorder from ``CHAINERMN_TPU_TELEMETRY`` once per
+    process (no-op when unset or already checked).  The value is the
+    session output directory; the literal ``1`` enables an in-memory
+    recorder (programmatic flush only)."""
+    global _env_checked
+    if _active is not None or _env_checked:
+        return _active
+    _env_checked = True
+    value = os.environ.get(env_var)
+    if not value:
+        return None
+    return enable(outdir=None if value == '1' else value)
+
+
+def span(name, kind='generic', **attrs):
+    """Context manager timing the enclosed block into the active
+    recorder; the disabled path returns a no-op singleton."""
+    rec = _active
+    if rec is None:
+        return NULL_SPAN
+    return rec.span(name, kind=kind, **attrs)
+
+
+def event(name, kind='event', **attrs):
+    """Record a point-in-time event (no-op when disabled)."""
+    rec = _active
+    if rec is not None:
+        rec.event(name, kind=kind, **attrs)
+
+
+def registry():
+    """The active recorder's metrics registry, or None."""
+    rec = _active
+    return rec.registry if rec is not None else None
+
+
+def flush(outdir=None):
+    rec = _active
+    return rec.flush(outdir) if rec is not None else None

@@ -1,0 +1,67 @@
+"""``python -m chainermn_tpu.telemetry``: merge and report a
+telemetry capture.
+
+``report DIR`` merges every rank's ``events-rank*.jsonl`` +
+``metrics-rank*.json`` under ``DIR`` into one step timeline, prints
+it with the overlap fraction, and writes the merged artifacts
+(``merged_report.json``, aggregated ``metrics.json``,
+``metrics.prom``) back into ``DIR``.  Exit codes: 0 on a non-empty
+timeline, 2 when the directory holds no telemetry events (so CI
+smoke legs fail loudly on an accidentally-disabled capture), 1 on a
+malformed Prometheus export (never expected; guards the exporter).
+"""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog='python -m chainermn_tpu.telemetry',
+        description='merge per-rank telemetry logs into a step '
+                    'timeline with overlap fraction and metrics '
+                    'exports')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+    rep = sub.add_parser('report', help='merge + report one session '
+                                        'directory')
+    rep.add_argument('outdir', help='telemetry session directory '
+                                    '(the CHAINERMN_TPU_TELEMETRY '
+                                    'value of the run)')
+    rep.add_argument('--json', action='store_true',
+                     help='print the merged report as JSON instead '
+                          'of text')
+    rep.add_argument('--steps', type=int, default=24,
+                     help='max step-timeline rows to print')
+    rep.add_argument('--no-export', action='store_true',
+                     help='print only; do not write merged_report/'
+                          'metrics.json/metrics.prom into the '
+                          'session dir')
+    args = parser.parse_args(argv)
+
+    from chainermn_tpu.telemetry import report as report_mod
+    from chainermn_tpu.telemetry.recorder import snapshot_to_prometheus
+
+    report = report_mod.build_report(args.outdir)
+    if not args.no_export:
+        report_mod.export(args.outdir, report)
+    if args.json:
+        import json
+        print(json.dumps(report, indent=1))
+    else:
+        print(report_mod.render_text(report, max_steps=args.steps))
+    if report['n_spans'] + report['n_events'] == 0:
+        print('telemetry: EMPTY capture under %s (was '
+              'CHAINERMN_TPU_TELEMETRY set, and did the run flush?)'
+              % args.outdir, file=sys.stderr)
+        return 2
+    bad = report_mod.validate_prometheus(
+        snapshot_to_prometheus(report['metrics']))
+    if bad:
+        print('telemetry: malformed Prometheus line(s): %r' % bad[:5],
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

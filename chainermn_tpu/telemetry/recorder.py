@@ -1,0 +1,370 @@
+"""Span/event recorder and metrics registry for runtime telemetry.
+
+Design constraints (mirroring :mod:`chainermn_tpu.utils.chaos`, the
+other env-activated runtime layer):
+
+- **Zero cost when off.**  Nothing in this module runs on a
+  telemetry-free hot path; call sites guard on the package-level
+  ``telemetry._active is None`` (one attribute load + identity check)
+  or go through :func:`chainermn_tpu.telemetry.span`, whose off path
+  returns a preallocated no-op context.
+- **Monotonic spans, wall-aligned at record time.**  Durations come
+  from ``time.perf_counter()`` (immune to NTP steps); every recorded
+  timestamp is expressed on the wall clock via a per-recorder anchor
+  pair captured at construction, so per-rank logs from one machine
+  (the CPU multi-controller harness) merge into one timeline without
+  post-hoc skew fitting.
+- **Optional device-sync fences.**  A span wrapping device work
+  measures DISPATCH unless the telemetry session requests fences
+  (``CHAINERMN_TPU_TELEMETRY_SYNC=1``): then ``span.sync(out)``
+  blocks on the device values before the span closes and the span is
+  tagged ``synced=True``.  Fences serialize the device -- they are a
+  measurement mode, not a default.
+
+Event-log schema (JSONL, one file per rank, first line is ``meta``)::
+
+    {"type": "meta", "rank": 0, "pid": 123, "wall0": ..., "argv": ...}
+    {"type": "span", "name": "jitted_step", "kind": "compute",
+     "t0": <wall s>, "t1": <wall s>, "rank": 0, ...attrs}
+    {"type": "event", "name": "chaos:drop_send", "kind": "chaos",
+     "t": <wall s>, "rank": 0, ...attrs}
+
+``kind`` is the timeline vocabulary the overlap computation consumes:
+``compute`` (the jitted step), ``collective`` (eager collectives /
+bounded rendezvous), ``p2p`` (eager object channel), ``host`` (batch
+collation), ``h2d`` (host-to-device placement), ``checkpoint``,
+``chaos``, and ``collective_trace`` (trace-time collective-issue
+marks -- they fire once per compilation, not per step).
+"""
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+
+#: histogram sample retention cap -- long trainings must not grow
+#: memory without bound; percentile accuracy over the newest samples
+#: is what the exporters need
+MAX_SAMPLES = 65536
+#: event-log retention cap per rank (a week-long run with telemetry
+#: left on must not OOM the host; the newest window wins)
+MAX_EVENTS = 1 << 20
+
+
+def _percentile(sorted_vals, q):
+    """Nearest-rank percentile of an ascending list (the convention
+    ``StepTimer.summary`` always used)."""
+    if not sorted_vals:
+        return None
+    n = len(sorted_vals)
+    return sorted_vals[min(n - 1, int(n * q))]
+
+
+class Counter:
+    """Monotonically increasing count (Prometheus ``counter``)."""
+
+    kind = 'counter'
+
+    def __init__(self, name, help=''):
+        self.name = name
+        self.help = help
+        self.value = 0.0
+
+    def inc(self, n=1.0):
+        self.value += n
+
+    def snapshot(self):
+        return {'type': 'counter', 'value': self.value}
+
+
+class Gauge:
+    """Last-written value (Prometheus ``gauge``)."""
+
+    kind = 'gauge'
+
+    def __init__(self, name, help=''):
+        self.name = name
+        self.help = help
+        self.value = None
+
+    def set(self, v):
+        self.value = float(v)
+
+    def snapshot(self):
+        return {'type': 'gauge', 'value': self.value}
+
+
+class Histogram:
+    """Sample-retaining distribution with p50/p99 summaries.
+
+    Retains raw samples (newest :data:`MAX_SAMPLES`) so per-rank
+    snapshots can be MERGED exactly -- aggregated percentiles are
+    recomputed from the union of samples, not averaged from per-rank
+    percentiles (which would be wrong for skewed step times).
+    """
+
+    kind = 'histogram'
+
+    def __init__(self, name, help=''):
+        self.name = name
+        self.help = help
+        self.samples = []
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, v):
+        v = float(v)
+        self.count += 1
+        self.total += v
+        self.samples.append(v)
+        if len(self.samples) > MAX_SAMPLES:
+            del self.samples[:len(self.samples) - MAX_SAMPLES]
+
+    def summary(self):
+        s = sorted(self.samples)
+        if not s:
+            return {'count': 0, 'sum': 0.0}
+        return {
+            'count': self.count,
+            'sum': self.total,
+            'min': s[0],
+            'max': s[-1],
+            'mean': sum(s) / len(s),
+            'p50': _percentile(s, 0.50),
+            'p90': _percentile(s, 0.90),
+            'p99': _percentile(s, 0.99),
+        }
+
+    def snapshot(self):
+        return {'type': 'histogram', 'count': self.count,
+                'sum': self.total, 'samples': list(self.samples),
+                'summary': self.summary()}
+
+
+class Registry:
+    """Named metrics, one instance per recorder (plus standalone use
+    by :class:`~chainermn_tpu.utils.profiling.StepTimer` when
+    telemetry is off)."""
+
+    def __init__(self):
+        self._metrics = {}
+        self._lock = threading.Lock()
+
+    def _get(self, cls, name, help):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    'metric %r already registered as %s, requested %s'
+                    % (name, type(m).__name__, cls.__name__))
+            return m
+
+    def counter(self, name, help=''):
+        return self._get(Counter, name, help)
+
+    def gauge(self, name, help=''):
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name, help=''):
+        return self._get(Histogram, name, help)
+
+    def names(self):
+        return sorted(self._metrics)
+
+    def snapshot(self):
+        return {name: m.snapshot()
+                for name, m in sorted(self._metrics.items())}
+
+    def to_prometheus(self, prefix='chainermn_tpu_'):
+        """Prometheus text exposition (0.0.4).  Histograms export as
+        summaries: ``<name>{quantile="0.5"}``, ``_count``, ``_sum``.
+        """
+        return snapshot_to_prometheus(self.snapshot(), prefix=prefix)
+
+
+def _prom_name(prefix, name):
+    out = []
+    for ch in prefix + name:
+        out.append(ch if (ch.isalnum() and ch.isascii()) or ch in '_:'
+                   else '_')
+    head = out[0] if out else '_'
+    if not (head.isalpha() or head in '_:'):
+        out.insert(0, '_')
+    return ''.join(out)
+
+
+def snapshot_to_prometheus(snapshot, prefix='chainermn_tpu_'):
+    """Render a (possibly merged) registry snapshot as Prometheus
+    text.  Shared by the live registry and the offline aggregator in
+    :mod:`chainermn_tpu.telemetry.report`."""
+    lines = []
+    for name, snap in sorted(snapshot.items()):
+        pname = _prom_name(prefix, name)
+        kind = snap.get('type')
+        if kind in ('counter', 'gauge'):
+            v = snap.get('value')
+            if v is None:
+                continue
+            lines.append('# TYPE %s %s' % (pname, kind))
+            lines.append('%s %s' % (pname, repr(float(v))))
+        elif kind == 'histogram':
+            summ = snap.get('summary') or {}
+            lines.append('# TYPE %s summary' % pname)
+            for q in ('p50', 'p90', 'p99'):
+                if summ.get(q) is not None:
+                    lines.append('%s{quantile="0.%s"} %s'
+                                 % (pname, q[1:], repr(summ[q])))
+            lines.append('%s_count %s'
+                         % (pname, repr(float(snap.get('count', 0)))))
+            lines.append('%s_sum %s'
+                         % (pname, repr(float(snap.get('sum', 0.0)))))
+    return '\n'.join(lines) + '\n' if lines else ''
+
+
+class _SpanHandle:
+    """What ``with recorder.span(...) as sp`` yields: lets the caller
+    attach attributes discovered mid-span and request the device-sync
+    fence."""
+
+    __slots__ = ('_recorder', 'attrs', 'synced')
+
+    def __init__(self, recorder, attrs):
+        self._recorder = recorder
+        self.attrs = attrs
+        self.synced = False
+
+    def set(self, **attrs):
+        self.attrs.update(attrs)
+
+    def sync(self, value):
+        """Block on device values before the span closes -- only when
+        the telemetry session requested fences; otherwise a no-op, so
+        call sites need no conditional."""
+        if self._recorder.sync_fences and value is not None:
+            import jax
+            jax.block_until_ready(value)
+            self.synced = True
+        return value
+
+
+class _NullSpan:
+    """Preallocated no-op context for the disabled path."""
+
+    __slots__ = ()
+    attrs = None
+    synced = False
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        pass
+
+    def sync(self, value):
+        return value
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Recorder:
+    """One process's telemetry session: spans, events, metrics, and
+    the per-rank JSONL/JSON flush."""
+
+    def __init__(self, outdir=None, sync_fences=False):
+        self.outdir = outdir
+        self.sync_fences = bool(sync_fences)
+        self.registry = Registry()
+        self.events = []
+        self._lock = threading.Lock()
+        # wall-clock anchor: every recorded time is
+        # wall0 + (perf_counter() - mono0)
+        self._mono0 = time.perf_counter()
+        self._wall0 = time.time()
+        self._flushed_upto = 0
+        self._meta_written = False
+
+    # -- clock ---------------------------------------------------------
+    def now(self):
+        return self._wall0 + (time.perf_counter() - self._mono0)
+
+    # -- recording -----------------------------------------------------
+    def _append(self, rec):
+        with self._lock:
+            self.events.append(rec)
+            if len(self.events) > MAX_EVENTS:
+                # drop the oldest UNFLUSHED window is wrong -- flushed
+                # records are already on disk, so trim from the front
+                # and move the flush cursor with it
+                drop = len(self.events) - MAX_EVENTS
+                del self.events[:drop]
+                self._flushed_upto = max(0, self._flushed_upto - drop)
+
+    @contextlib.contextmanager
+    def span(self, name, kind='generic', **attrs):
+        handle = _SpanHandle(self, attrs)
+        t0 = self.now()
+        try:
+            yield handle
+        finally:
+            rec = {'type': 'span', 'name': name, 'kind': kind,
+                   't0': t0, 't1': self.now()}
+            if handle.synced:
+                rec['synced'] = True
+            if handle.attrs:
+                rec.update(handle.attrs)
+            self._append(rec)
+
+    def event(self, name, kind='event', **attrs):
+        rec = {'type': 'event', 'name': name, 'kind': kind,
+               't': self.now()}
+        if attrs:
+            rec.update(attrs)
+        self._append(rec)
+
+    # -- flush ---------------------------------------------------------
+    def _rank(self):
+        try:
+            import jax
+            return int(jax.process_index())
+        except Exception:
+            return 0
+
+    def flush(self, outdir=None):
+        """Append unwritten events to ``events-rank<N>.jsonl`` and
+        rewrite ``metrics-rank<N>.json`` under the session directory.
+        Idempotent and incremental; safe to call repeatedly (the
+        enable path registers it atexit)."""
+        outdir = outdir or self.outdir
+        if outdir is None:
+            return None
+        os.makedirs(outdir, exist_ok=True)
+        rank = self._rank()
+        epath = os.path.join(outdir, 'events-rank%d.jsonl' % rank)
+        with self._lock:
+            pending = self.events[self._flushed_upto:]
+            self._flushed_upto = len(self.events)
+        with open(epath, 'a') as f:
+            if not self._meta_written:
+                f.write(json.dumps({
+                    'type': 'meta', 'rank': rank, 'pid': os.getpid(),
+                    'wall0': self._wall0,
+                    'sync_fences': self.sync_fences,
+                    'argv': list(sys.argv)}) + '\n')
+                self._meta_written = True
+            for rec in pending:
+                f.write(json.dumps(dict(rec, rank=rank)) + '\n')
+        mpath = os.path.join(outdir, 'metrics-rank%d.json' % rank)
+        tmp = mpath + '.tmp'
+        with open(tmp, 'w') as f:
+            json.dump({'rank': rank,
+                       'metrics': self.registry.snapshot()}, f)
+        os.replace(tmp, mpath)
+        return epath

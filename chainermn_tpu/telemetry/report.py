@@ -1,0 +1,351 @@
+"""Offline side of the telemetry subsystem: merge per-rank event
+logs into one step timeline, compute the overlap fraction, aggregate
+metrics, and export Prometheus text.
+
+Overlap definition (the number ROADMAP item 5 asks for, and the
+dynamic twin of shardlint SL009):
+
+- **total collective time**: the summed wall duration of every
+  ``kind='collective'`` span (eager collectives, bounded rendezvous);
+- **exposed collective time**: the part of that duration during which
+  NO ``kind='compute'`` span was running on the same rank -- i.e. the
+  device had nothing dispatched to hide the communication behind;
+- ``overlap_fraction = 1 - exposed / total`` (``None`` when the
+  capture recorded no collective spans at all: absence of evidence is
+  reported as absence, never as a fabricated 0 or 1).
+
+The same interval arithmetic is exported as pure functions
+(:func:`merge_intervals`, :func:`exposed_time`,
+:func:`overlap_from_intervals`) so ``benchmarks/trace_report.py`` can
+apply the identical definition to banked device profiles.
+"""
+
+import glob
+import json
+import os
+import re
+
+from chainermn_tpu.telemetry.recorder import (
+    _percentile, snapshot_to_prometheus)
+
+#: span names the per-step table columns come from (issue order)
+STEP_PHASES = ('host_batch_prep', 'h2d', 'jitted_step',
+               'metrics_sync')
+
+#: span kinds whose time counts as "compute the collective could
+#: hide behind"
+COMPUTE_KINDS = ('compute',)
+#: span kinds audited for exposure
+COLLECTIVE_KINDS = ('collective',)
+
+
+# ---------------------------------------------------------------------
+# interval arithmetic (shared with benchmarks/trace_report.py)
+
+def merge_intervals(intervals):
+    """Union of ``(t0, t1)`` pairs as a sorted disjoint list."""
+    ivs = sorted((t0, t1) for t0, t1 in intervals if t1 > t0)
+    out = []
+    for t0, t1 in ivs:
+        if out and t0 <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], t1))
+        else:
+            out.append((t0, t1))
+    return out
+
+
+def exposed_time(span, merged):
+    """Length of ``span`` not covered by the merged interval union."""
+    t0, t1 = span
+    exposed = t1 - t0
+    for m0, m1 in merged:
+        if m1 <= t0:
+            continue
+        if m0 >= t1:
+            break
+        exposed -= min(t1, m1) - max(t0, m0)
+    return max(exposed, 0.0)
+
+
+def overlap_from_intervals(collective, compute):
+    """Overlap statistics for two interval lists (seconds in, seconds
+    out).  ``overlap_fraction`` is None when there are no collective
+    intervals.  Collective intervals are UNIONED first so nested or
+    concurrent spans (an evaluator wrapper around per-key
+    allreduces, two async buckets in flight) count wall time once."""
+    coll = merge_intervals(collective)
+    total = sum(t1 - t0 for t0, t1 in coll)
+    merged = merge_intervals(compute)
+    exposed = sum(exposed_time((t0, t1), merged) for t0, t1 in coll)
+    return {
+        'total_collective_s': total,
+        'exposed_collective_s': exposed,
+        'hidden_collective_s': max(total - exposed, 0.0),
+        'overlap_fraction': (None if total <= 0.0
+                             else max(0.0, min(1.0, 1.0 - exposed
+                                               / total))),
+    }
+
+
+def overlap_stats(spans):
+    """Overlap statistics over merged telemetry spans, exposure
+    judged per rank (a collective is hidden only by compute running
+    on the SAME rank)."""
+    ranks = sorted({s.get('rank', 0) for s in spans})
+    total = exposed = 0.0
+    for rank in ranks:
+        coll = [(s['t0'], s['t1']) for s in spans
+                if s.get('rank', 0) == rank
+                and s.get('kind') in COLLECTIVE_KINDS]
+        comp = [(s['t0'], s['t1']) for s in spans
+                if s.get('rank', 0) == rank
+                and s.get('kind') in COMPUTE_KINDS]
+        st = overlap_from_intervals(coll, comp)
+        total += st['total_collective_s']
+        exposed += st['exposed_collective_s']
+    return {
+        'total_collective_s': total,
+        'exposed_collective_s': exposed,
+        'hidden_collective_s': max(total - exposed, 0.0),
+        'overlap_fraction': (None if total <= 0.0
+                             else max(0.0, min(1.0,
+                                               1.0 - exposed / total))),
+    }
+
+
+# ---------------------------------------------------------------------
+# loading + merging
+
+def load_rank_logs(outdir):
+    """``(metas, spans, events)`` from every ``events-rank*.jsonl``
+    under a session directory.  Unparseable lines are counted, not
+    fatal (a crashed rank leaves a torn tail)."""
+    metas, spans, events = [], [], []
+    bad = 0
+    for path in sorted(glob.glob(
+            os.path.join(outdir, 'events-rank*.jsonl'))):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    bad += 1
+                    continue
+                t = rec.get('type')
+                if t == 'meta':
+                    metas.append(rec)
+                elif t == 'span':
+                    spans.append(rec)
+                elif t == 'event':
+                    events.append(rec)
+    return metas, spans, events, bad
+
+
+def load_rank_metrics(outdir):
+    """Per-rank metrics snapshots (``metrics-rank*.json``)."""
+    out = []
+    for path in sorted(glob.glob(
+            os.path.join(outdir, 'metrics-rank*.json'))):
+        try:
+            with open(path) as f:
+                out.append(json.load(f))
+        except (ValueError, OSError):
+            continue
+    return out
+
+
+def aggregate_metrics(rank_metrics):
+    """One merged snapshot from per-rank snapshots: counters sum,
+    gauges keep per-rank values plus the max, histograms merge raw
+    samples and recompute the percentile summary (averaging per-rank
+    percentiles would be wrong for skewed distributions)."""
+    merged = {}
+    for rm in rank_metrics:
+        for name, snap in (rm.get('metrics') or {}).items():
+            kind = snap.get('type')
+            cur = merged.get(name)
+            if kind == 'counter':
+                if cur is None:
+                    cur = merged[name] = {'type': 'counter',
+                                          'value': 0.0}
+                cur['value'] += snap.get('value') or 0.0
+            elif kind == 'gauge':
+                if cur is None:
+                    cur = merged[name] = {'type': 'gauge',
+                                          'value': None,
+                                          'per_rank': []}
+                v = snap.get('value')
+                cur['per_rank'].append(v)
+                if v is not None:
+                    cur['value'] = (v if cur['value'] is None
+                                    else max(cur['value'], v))
+            elif kind == 'histogram':
+                if cur is None:
+                    cur = merged[name] = {'type': 'histogram',
+                                          'count': 0, 'sum': 0.0,
+                                          'samples': []}
+                cur['count'] += snap.get('count') or 0
+                cur['sum'] += snap.get('sum') or 0.0
+                cur['samples'].extend(snap.get('samples') or [])
+    for snap in merged.values():
+        if snap.get('type') == 'histogram':
+            s = sorted(snap['samples'])
+            snap['summary'] = ({} if not s else {
+                'count': snap['count'], 'sum': snap['sum'],
+                'min': s[0], 'max': s[-1],
+                'mean': sum(s) / len(s),
+                'p50': _percentile(s, 0.50),
+                'p90': _percentile(s, 0.90),
+                'p99': _percentile(s, 0.99)})
+    return merged
+
+
+def step_table(spans):
+    """Per-(rank, iteration) phase durations from the step-phase
+    spans both updaters emit.  Rows sorted by (iteration, rank)."""
+    rows = {}
+    for s in spans:
+        if s.get('name') not in STEP_PHASES or 'iteration' not in s:
+            continue
+        key = (int(s['iteration']), int(s.get('rank', 0)))
+        row = rows.setdefault(key, {'iteration': key[0],
+                                    'rank': key[1], 't0': s['t0']})
+        row[s['name'] + '_ms'] = round((s['t1'] - s['t0']) * 1e3, 3)
+        row['t0'] = min(row['t0'], s['t0'])
+    return [rows[k] for k in sorted(rows)]
+
+
+def build_report(outdir):
+    """The merged session report: timeline summary, per-step phase
+    table, overlap statistics, aggregated metrics, chaos events."""
+    metas, spans, events, bad = load_rank_logs(outdir)
+    rank_metrics = load_rank_metrics(outdir)
+    spans.sort(key=lambda s: s.get('t0', 0.0))
+    events.sort(key=lambda e: e.get('t', 0.0))
+    by_kind = {}
+    for s in spans:
+        k = by_kind.setdefault(s.get('kind', '?'),
+                               {'spans': 0, 'total_s': 0.0})
+        k['spans'] += 1
+        k['total_s'] += max(s['t1'] - s['t0'], 0.0)
+    steps = step_table(spans)
+    step_ms = sorted((s['t1'] - s['t0']) * 1e3 for s in spans
+                     if s.get('name') == 'jitted_step')
+    chaos_events = [e for e in events if e.get('kind') == 'chaos']
+    report = {
+        'outdir': outdir,
+        'ranks': sorted({m.get('rank', 0) for m in metas}
+                        | {s.get('rank', 0) for s in spans}),
+        'n_spans': len(spans),
+        'n_events': len(events),
+        'n_unparseable_lines': bad,
+        'kinds': {k: {'spans': v['spans'],
+                      'total_ms': round(v['total_s'] * 1e3, 3)}
+                  for k, v in sorted(by_kind.items())},
+        'steps': steps,
+        'step_time_ms': ({} if not step_ms else {
+            'count': len(step_ms),
+            'p50': round(_percentile(step_ms, 0.50), 3),
+            'p99': round(_percentile(step_ms, 0.99), 3),
+            'mean': round(sum(step_ms) / len(step_ms), 3)}),
+        'overlap': overlap_stats(spans),
+        'chaos_events': [
+            {'t': e['t'], 'rank': e.get('rank', 0),
+             'name': e.get('name')} for e in chaos_events],
+        'metrics': aggregate_metrics(rank_metrics),
+    }
+    return report
+
+
+# ---------------------------------------------------------------------
+# rendering + export
+
+def render_text(report, max_steps=24):
+    lines = ['telemetry session: %s' % report['outdir'],
+             'ranks: %s   spans: %d   events: %d'
+             % (report['ranks'], report['n_spans'],
+                report['n_events'])]
+    for kind, agg in report['kinds'].items():
+        lines.append('  %-18s %6d spans  %10.3f ms total'
+                     % (kind, agg['spans'], agg['total_ms']))
+    if report['steps']:
+        lines.append('step timeline (first %d of %d rows):'
+                     % (min(max_steps, len(report['steps'])),
+                        len(report['steps'])))
+        hdr = ('  %6s %4s' % ('iter', 'rank')
+               + ''.join(' %16s' % p for p in STEP_PHASES))
+        lines.append(hdr)
+        for row in report['steps'][:max_steps]:
+            cells = ''.join(
+                ' %13.3f ms' % row[p + '_ms']
+                if p + '_ms' in row else ' %16s' % '-'
+                for p in STEP_PHASES)
+            lines.append('  %6d %4d%s' % (row['iteration'],
+                                          row['rank'], cells))
+    st = report.get('step_time_ms') or {}
+    if st:
+        lines.append('jitted step: %d samples, p50 %.3f ms, '
+                     'p99 %.3f ms' % (st['count'], st['p50'],
+                                      st['p99']))
+    ov = report['overlap']
+    if ov['overlap_fraction'] is None:
+        lines.append('overlap: no collective spans in capture')
+    else:
+        lines.append(
+            'overlap fraction: %.3f  (collective %.3f ms total, '
+            '%.3f ms exposed, %.3f ms hidden behind compute)'
+            % (ov['overlap_fraction'], ov['total_collective_s'] * 1e3,
+               ov['exposed_collective_s'] * 1e3,
+               ov['hidden_collective_s'] * 1e3))
+    if report['chaos_events']:
+        lines.append('chaos events in timeline: %d (%s)'
+                     % (len(report['chaos_events']),
+                        ', '.join(sorted({e['name'] for e in
+                                          report['chaos_events']}))))
+    for name, snap in report['metrics'].items():
+        if snap.get('type') == 'histogram':
+            summ = snap.get('summary') or {}
+            if summ:
+                lines.append(
+                    '  metric %-28s n=%-6d p50=%.6g p99=%.6g'
+                    % (name, summ['count'], summ['p50'], summ['p99']))
+        else:
+            lines.append('  metric %-28s %s=%s'
+                         % (name, snap.get('type'), snap.get('value')))
+    return '\n'.join(lines)
+
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
+    r'[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[Nn]a[Nn]|[Ii]nf)$')
+
+
+def validate_prometheus(text):
+    """Offending lines of a Prometheus text exposition (empty list =
+    valid).  Deliberately strict: the CI smoke leg treats ANY
+    malformed sample line as a failure."""
+    bad = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith('#'):
+            continue
+        if not _PROM_LINE.match(line):
+            bad.append(line)
+    return bad
+
+
+def export(outdir, report=None):
+    """Write the merged artifacts next to the per-rank logs:
+    ``merged_report.json``, ``metrics.json`` (aggregated) and
+    ``metrics.prom`` (Prometheus text).  Returns the report."""
+    report = report or build_report(outdir)
+    with open(os.path.join(outdir, 'merged_report.json'), 'w') as f:
+        json.dump(report, f, indent=1)
+    with open(os.path.join(outdir, 'metrics.json'), 'w') as f:
+        json.dump(report['metrics'], f, indent=1)
+    prom = snapshot_to_prometheus(report['metrics'])
+    with open(os.path.join(outdir, 'metrics.prom'), 'w') as f:
+        f.write(prom)
+    return report

@@ -42,6 +42,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from chainermn_tpu import telemetry as _telemetry
 from chainermn_tpu.parallel import zero as zero_helpers
 from chainermn_tpu.parallel.pipeline import (
     Pipeline, assert_collective_free, microbatch, pipeline_1f1b_grads)
@@ -277,6 +278,7 @@ class PipelineUpdater:
                         'per layer of the unstacked model.  '
                         'Probe result: %s  Pass schedule_check=False '
                         'to bypass.' % e) from e
+        _telemetry.maybe_enable_from_env()
         self.iterator = iterator
         self.optimizer = optimizer
         self.mesh = mesh
@@ -693,13 +695,18 @@ class PipelineUpdater:
         (x, y) contract of the train step follows that order (same
         convention as ``StandardUpdater.shard_batch``, including the
         host-side compute-dtype cast under a policy)."""
-        arrays = concat_examples(
-            batch, dtype=(self._policy.compute_dtype
-                          if self._policy is not None else None))
-        if isinstance(arrays, dict):
-            arrays = tuple(arrays.values())
+        with _telemetry.span('host_batch_prep', kind='host',
+                             iteration=self.iteration):
+            arrays = concat_examples(
+                batch, dtype=(self._policy.compute_dtype
+                              if self._policy is not None else None))
+            if isinstance(arrays, dict):
+                arrays = tuple(arrays.values())
         data_sharding = NamedSharding(self.mesh, P(AXIS_DATA))
-        return tuple(jax.device_put(a, data_sharding) for a in arrays)
+        with _telemetry.span('h2d', kind='h2d',
+                             iteration=self.iteration) as sp:
+            return sp.sync(tuple(jax.device_put(a, data_sharding)
+                                 for a in arrays))
 
     def traceable_step(self, arrays, iteration=None):
         """``(fn, args)`` of the jitted pipeline train step for
@@ -713,8 +720,16 @@ class PipelineUpdater:
                             self.opt_state) + tuple(arrays)
 
     def update_core(self, arrays):
-        self.params, self.extra, self.opt_state, metrics = self._step(
-            self.params, self.extra, self.opt_state, *arrays)
+        if _telemetry._active is not None:
+            with _telemetry.span('jitted_step', kind='compute',
+                                 iteration=self.iteration) as sp:
+                out = self._step(self.params, self.extra,
+                                 self.opt_state, *arrays)
+                sp.sync(out)
+        else:
+            out = self._step(self.params, self.extra, self.opt_state,
+                             *arrays)
+        self.params, self.extra, self.opt_state, metrics = out
         self.iteration += 1
         return metrics
 
@@ -726,7 +741,9 @@ class PipelineUpdater:
         metrics = self.update_core(self.shard_batch(next(self.iterator)))
         if not sync:
             return dict(metrics)
-        return {k: float(v) for k, v in metrics.items()}
+        with _telemetry.span('metrics_sync', kind='host',
+                             iteration=self.iteration - 1):
+            return {k: float(v) for k, v in metrics.items()}
 
     def evaluate(self, arrays):
         """Forward-only metrics on already-sharded arrays: runs the

@@ -43,6 +43,8 @@ import re
 import signal
 import sys
 
+from chainermn_tpu import telemetry as _telemetry
+
 PREEMPT_PREFIX = 'preempt_iter_'
 
 
@@ -121,6 +123,12 @@ class PreemptionHandler:
         from chainermn_tpu import serializers
         os.makedirs(self.out, exist_ok=True)
         u = self.updater
+        with _telemetry.span('checkpoint_write', kind='checkpoint',
+                             method=self.method,
+                             iteration=u.iteration):
+            return self._checkpoint_impl(jax, serializers, u)
+
+    def _checkpoint_impl(self, jax, serializers, u):
         state = serializers.updater_state(u)
         mesh = getattr(getattr(u, 'comm', None), 'mesh', None)
         mesh_shape = dict(mesh.shape) if mesh is not None else None
@@ -211,7 +219,11 @@ def latest_snapshot(out, extra_prefixes=('snapshot_iter_',)):
     outside elastic mode."""
     from chainermn_tpu import serializers
     for kind, path, it in snapshot_chain(out, extra_prefixes):
-        if serializers.checkpoint_complete(path):
+        with _telemetry.span('checkpoint_verify', kind='checkpoint',
+                             path=path) as sp:
+            complete = serializers.checkpoint_complete(path)
+            sp.set(complete=bool(complete))
+        if complete:
             return kind, path, it
     return None, None, None
 
@@ -295,12 +307,20 @@ def auto_resume(updater, out, extra_prefixes=('snapshot_iter_',)):
 
     for kind, path, it in snapshot_chain(out, extra_prefixes):
         try:
-            if kind == 'npz':
-                serializers.resume_updater(path, updater,
-                                           require_manifest=True)
-                return updater.iteration
-            return _resume_orbax(updater, path, it)
+            with _telemetry.span('checkpoint_resume',
+                                 kind='checkpoint', path=path,
+                                 snapshot_kind=kind) as sp:
+                if kind == 'npz':
+                    serializers.resume_updater(path, updater,
+                                               require_manifest=True)
+                    sp.set(iteration=updater.iteration)
+                    return updater.iteration
+                restored = _resume_orbax(updater, path, it)
+                sp.set(iteration=restored)
+                return restored
         except failure.CheckpointCorruptError as e:
+            _telemetry.event('checkpoint_skipped', kind='checkpoint',
+                             path=path, reason=e.kind)
             warnings.warn(
                 'auto_resume: skipping corrupt snapshot %s (%s: %s)'
                 % (path, e.kind, e), failure.CheckpointSkippedWarning,

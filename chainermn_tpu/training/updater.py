@@ -15,6 +15,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from chainermn_tpu import telemetry as _telemetry
 from chainermn_tpu.training.convert import concat_examples
 from chainermn_tpu.utils import chaos as _chaos
 
@@ -111,6 +112,7 @@ class StandardUpdater:
         metrics then carry ``loss_scale`` and ``grads_finite``.
         See ``docs/mixed_precision.md``.
         """
+        _telemetry.maybe_enable_from_env()
         self.iterator = iterator
         self.optimizer = optimizer
         self.comm = comm
@@ -467,19 +469,29 @@ class StandardUpdater:
         """Collate a list of examples and place it sharded on the mesh
         (under a policy, floating columns are cast to compute dtype on
         the HOST first, halving the host->device bytes)."""
-        arrays = concat_examples(
-            batch, dtype=(self._policy.compute_dtype
-                          if self._policy is not None else None))
-        if isinstance(arrays, dict):
-            arrays = tuple(arrays.values())
-        if _chaos._active is not None:  # nan_batch fault injection
-            arrays = _chaos.corrupt_batch(arrays)
-        n = arrays[0].shape[0]
-        if n % (self.comm.size * self._accum_steps):
-            raise ValueError(
-                'global batch size %d must be divisible by mesh size %d'
-                ' x accum_steps %d'
-                % (n, self.comm.size, self._accum_steps))
+        with _telemetry.span('host_batch_prep', kind='host',
+                             iteration=self.iteration):
+            arrays = concat_examples(
+                batch, dtype=(self._policy.compute_dtype
+                              if self._policy is not None else None))
+            if isinstance(arrays, dict):
+                arrays = tuple(arrays.values())
+            if _chaos._active is not None:  # nan_batch fault injection
+                arrays = _chaos.corrupt_batch(arrays)
+            n = arrays[0].shape[0]
+            if n % (self.comm.size * self._accum_steps):
+                raise ValueError(
+                    'global batch size %d must be divisible by mesh '
+                    'size %d x accum_steps %d'
+                    % (n, self.comm.size, self._accum_steps))
+        # comm.shard_batch records its own 'h2d' span; tag the step
+        # index on a sibling so the timeline groups H2D per iteration
+        if _telemetry._active is not None:
+            with _telemetry.span('h2d', kind='h2d',
+                                 iteration=self.iteration) as sp:
+                out = self.comm.shard_batch(arrays)
+                sp.sync(out)
+            return out
         return self.comm.shard_batch(arrays)
 
     def _step_args(self, arrays, iteration=None):
@@ -516,7 +528,16 @@ class StandardUpdater:
         overlap)."""
         if _chaos._active is not None:  # sigterm_step / kill_step
             _chaos.on_step(self.iteration)
-        out = self._step(*self._step_args(arrays))
+        if _telemetry._active is not None:
+            # measures DISPATCH unless the session requested fences
+            # (CHAINERMN_TPU_TELEMETRY_SYNC=1): sp.sync then blocks on
+            # the step's outputs so the span covers device completion
+            with _telemetry.span('jitted_step', kind='compute',
+                                 iteration=self.iteration) as sp:
+                out = self._step(*self._step_args(arrays))
+                sp.sync(out)
+        else:
+            out = self._step(*self._step_args(arrays))
         if self._loss_scale is not None:
             (self.params, self.model_state, self.opt_state,
              self.scale_state, metrics) = out
@@ -539,6 +560,11 @@ class StandardUpdater:
             batch if self._device_prefetch else self.shard_batch(batch))
         if not sync:
             return dict(metrics)
+        if _telemetry._active is not None:
+            # the host-device round trip the sync=True contract pays
+            with _telemetry.span('metrics_sync', kind='host',
+                                 iteration=self.iteration - 1):
+                return {k: float(v) for k, v in metrics.items()}
         return {k: float(v) for k, v in metrics.items()}
 
     def compiled_cost_analysis(self, arrays):

@@ -193,6 +193,23 @@ class FaultInjector:
         else:
             hit = rule.always
         self.log.append((site, idx, hit))
+        if hit:
+            # emit the injection into the telemetry timeline so a
+            # fault and its latency consequences (retry spans, typed
+            # timeouts, checkpoint writes) correlate in one place.
+            # Lazy import: chaos must stay importable standalone, and
+            # the kill/exit sites flush below before the process dies.
+            from chainermn_tpu import telemetry
+            if telemetry._active is not None:
+                telemetry.event('chaos:' + site, kind='chaos',
+                                occurrence=idx, arg=rule.arg)
+                if site in ('kill_step', 'kill_recv', 'ckpt_kill'):
+                    # os._exit skips atexit: flush the timeline NOW
+                    # or the fatal injection is invisible in it
+                    try:
+                        telemetry.flush()
+                    except Exception:
+                        pass
         return rule if hit else None
 
     def counts(self):

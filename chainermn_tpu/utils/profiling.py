@@ -6,6 +6,15 @@ the dummy communicator built to time pack/unpack overhead,
 ``jax.profiler`` device traces (viewable in TensorBoard/Perfetto), a
 step timer with throughput accounting, and a pack/unpack-style
 microbenchmark helper that fills the dummy communicator's role.
+
+Timing source of truth: :mod:`chainermn_tpu.telemetry`.  ``StepTimer``
+and ``benchmark_op`` record into a telemetry
+:class:`~chainermn_tpu.telemetry.Histogram` -- the ACTIVE session's
+registry when telemetry is enabled (so step times ride the same
+metrics export as everything else: ``metrics.json``, Prometheus), a
+standalone histogram otherwise.  ``StepTimer`` additionally emits one
+``step`` span per tick into the event timeline when a session is
+active.
 """
 
 import contextlib
@@ -14,6 +23,8 @@ import os
 import time
 
 import jax
+
+from chainermn_tpu import telemetry as _telemetry
 
 
 @contextlib.contextmanager
@@ -40,32 +51,54 @@ class StepTimer:
     Trainer extension AND standalone: call ``tick(n_items)`` per step;
     ``summary()`` gives steps/sec, items/sec and latency percentiles
     (compile-affected first steps excluded via ``warmup``).
+
+    Step durations land in a telemetry histogram (the active
+    session's registry under ``metric_name`` when telemetry is
+    enabled -- one timing source of truth, exported with everything
+    else -- or a standalone :class:`~chainermn_tpu.telemetry.Histogram`
+    otherwise); each tick additionally emits a ``step`` span into the
+    active event timeline.
     """
 
     trigger = (1, 'iteration')
     priority = 150
     name = 'step_timer'
 
-    def __init__(self, items_per_step=None, warmup=2):
+    def __init__(self, items_per_step=None, warmup=2,
+                 metric_name='step_time_seconds'):
         self.items_per_step = items_per_step
         self.warmup = warmup
-        self._times = []
+        self.metric_name = metric_name
+        reg = _telemetry.registry()
+        self._hist = (reg.histogram(metric_name) if reg is not None
+                      else _telemetry.Histogram(metric_name))
         self._last = None
+        self._ticks = 0
 
     def __call__(self, trainer):  # extension protocol
         self.tick()
-        if self._times:
+        if self._hist.samples:
             trainer.observation.setdefault(
-                'steps_per_sec', 1.0 / self._times[-1])
+                'steps_per_sec', 1.0 / self._hist.samples[-1])
 
     def tick(self, n_items=None):
         now = time.perf_counter()
         if self._last is not None:
-            self._times.append(now - self._last)
+            dt = now - self._last
+            self._hist.observe(dt)
+            rec = _telemetry.active()
+            if rec is not None:
+                rec._append({'type': 'span', 'name': 'step',
+                             'kind': 'compute',
+                             't0': rec.now() - dt, 't1': rec.now(),
+                             'timer': self.metric_name,
+                             'tick': self._ticks})
         self._last = now
+        self._ticks += 1
 
     def summary(self):
-        times = self._times[self.warmup:] or self._times
+        times = (self._hist.samples[self.warmup:]
+                 or self._hist.samples)
         if not times:
             return {}
         times = sorted(times)
@@ -87,10 +120,13 @@ class StepTimer:
             json.dump(self.summary(), f, indent=1)
 
 
-def benchmark_op(fn, *args, n_steps=20, warmup=3):
+def benchmark_op(fn, *args, n_steps=20, warmup=3,
+                 metric_name='benchmark_op_seconds'):
     """Time a jitted callable end-to-end (the role the reference's
     dummy communicator plays for pack/unpack overhead).  Returns
-    mean seconds per call."""
+    mean seconds per call; the mean is also recorded into the active
+    telemetry registry's ``metric_name`` histogram when a session is
+    enabled."""
     for _ in range(warmup):
         out = fn(*args)
     jax.block_until_ready(out)
@@ -98,7 +134,11 @@ def benchmark_op(fn, *args, n_steps=20, warmup=3):
     for _ in range(n_steps):
         out = fn(*args)
     jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / n_steps
+    mean = (time.perf_counter() - t0) / n_steps
+    reg = _telemetry.registry()
+    if reg is not None:
+        reg.histogram(metric_name).observe(mean)
+    return mean
 
 
 def memory_stats(device=None):

@@ -42,11 +42,41 @@ print('memtraffic OK: unfused %.2f MB f32-materialized -> fused %d'
 " "$1"
 }
 
+# SL009 overlap gate (ISSUE 6 / ROADMAP item 5): the collective-
+# schedulability rule must (a) stay SILENT on the bucketed-overlap
+# reference step -- >= 2 fused buckets give every collective an
+# independently schedulable sibling -- and (b) FIRE on the fused
+# single-buffer mlp step (the xla strategy's monolithic psum is the
+# deliberately serialized baseline: the whole backward completes
+# before the one collective starts).  Enforced in BOTH precision
+# sweeps, so an overlap regression fails CI the way dtype regressions
+# (SL004/SL008) already do.
+check_sl009() {
+  python -c "
+import json, sys
+report = json.load(open(sys.argv[1]))
+sl9 = [f for f in report['findings'] if f['rule'] == 'SL009']
+bucketed = [f for f in sl9 if f['target'] == 'step:bucketed_overlap']
+assert not bucketed, (
+    'bucketed-overlap step must lint clean under SL009: %r' % bucketed)
+assert 'step:bucketed_overlap' in report['targets'], report['targets']
+serialized = [f for f in sl9 if f['target'] == 'step:mlp_example']
+assert serialized, (
+    'SL009 no longer fires on the fused single-buffer mlp step -- '
+    'either overlap was actually fixed (update this check and the '
+    'docs) or the rule went blind')
+print('SL009 OK: bucketed_overlap clean, fused mlp flagged (%d '
+      'finding(s) total)' % len(sl9))
+" "$1"
+}
+
 out_f32=$(mktemp)
 out_bf16=$(mktemp)
 trap 'rm -f "$out_f32" "$out_bf16"' EXIT
 
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json | tee "$out_f32"
 check_memtraffic "$out_f32"
+check_sl009 "$out_f32"
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16 | tee "$out_bf16"
 check_memtraffic "$out_bf16"
+check_sl009 "$out_bf16"

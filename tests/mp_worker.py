@@ -279,6 +279,15 @@ def main():
         out_specs=P(), check_vma=False))(garr, restored['x'])
     res['ckpt_roundtrip_err'] = float(err)
 
+    # telemetry: when the parent test armed CHAINERMN_TPU_TELEMETRY,
+    # every eager collective / p2p / step above recorded spans; flush
+    # the per-rank JSONL + metrics explicitly (atexit also fires, but
+    # the parent reads the files right after the workers exit)
+    from chainermn_tpu import telemetry
+    if telemetry.enabled():
+        telemetry.flush()
+        res['telemetry_flushed'] = True
+
     with open(os.path.join(outdir, 'rank%d.json' % rank), 'w') as fh:
         json.dump(res, fh)
     print('worker %d OK' % rank, flush=True)

@@ -1,0 +1,489 @@
+"""Unified runtime telemetry (``chainermn_tpu/telemetry/``): the
+recorder/metrics core, the per-rank log merge + overlap fraction, the
+Prometheus exporter, the instrumentation threaded through updaters /
+communicators / recovery / chaos, and the disabled-by-default
+overhead pin (ISSUE 6 acceptance: < 2% on the mlp step, measured by
+``benchmark_op``)."""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import chainermn_tpu
+from chainermn_tpu import telemetry
+from chainermn_tpu import training
+from chainermn_tpu.models import MLP, Classifier
+from chainermn_tpu.telemetry import recorder as rec_mod
+from chainermn_tpu.telemetry import report as rep_mod
+from chainermn_tpu.utils import profiling
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts and ends with telemetry OFF (the production
+    default); tests that enable it do so explicitly."""
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+def _mlp_updater(n_units=16, batch=16, comm=None, donate=True):
+    comm = comm or chainermn_tpu.create_communicator(
+        'xla', mesh_shape=(2, 4))
+    model = MLP(n_units=n_units, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))
+    clf = Classifier(model.apply)
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.1), comm)
+    upd = training.StandardUpdater(iter([]), opt, clf, params, comm,
+                                   has_aux=True, donate=donate)
+    rs = np.random.RandomState(0)
+    batch_list = [(rs.randn(784).astype(np.float32), i % 10)
+                  for i in range(batch)]
+    return upd, batch_list
+
+
+# ---------------------------------------------------------------------
+# recorder core
+
+def test_disabled_by_default_nullspan_and_noop_event():
+    assert telemetry.active() is None and not telemetry.enabled()
+    sp = telemetry.span('x', kind='compute')
+    assert sp is rec_mod.NULL_SPAN
+    with sp as handle:
+        assert handle.sync('value') == 'value'  # passthrough
+    telemetry.event('x')  # no-op, no crash
+    assert telemetry.registry() is None
+    assert telemetry.flush() is None
+
+
+def test_recorder_spans_events_and_flush(tmp_path):
+    rec = telemetry.enable(outdir=None)
+    with telemetry.span('jitted_step', kind='compute', iteration=3):
+        time.sleep(0.002)
+    telemetry.event('chaos:drop_send', kind='chaos', occurrence=0)
+    path = rec.flush(str(tmp_path))
+    lines = [json.loads(ln) for ln in open(path)]
+    assert lines[0]['type'] == 'meta' and lines[0]['rank'] == 0
+    span = next(ln for ln in lines if ln['type'] == 'span')
+    assert span['name'] == 'jitted_step'
+    assert span['iteration'] == 3
+    assert span['t1'] - span['t0'] >= 0.002
+    event = next(ln for ln in lines if ln['type'] == 'event')
+    assert event['kind'] == 'chaos'
+    # incremental: a second flush appends nothing new
+    n0 = len(open(path).readlines())
+    rec.flush(str(tmp_path))
+    assert len(open(path).readlines()) == n0
+
+
+def test_enable_is_idempotent_and_repoints_outdir(tmp_path):
+    rec = telemetry.enable()
+    assert telemetry.enable() is rec
+    telemetry.enable(outdir=str(tmp_path))
+    assert rec.outdir == str(tmp_path)
+
+
+def test_maybe_enable_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv(telemetry.ENV_VAR, str(tmp_path))
+    assert telemetry.maybe_enable_from_env() is not None
+    assert telemetry.active().outdir == str(tmp_path)
+    telemetry.disable()
+    monkeypatch.delenv(telemetry.ENV_VAR)
+    assert telemetry.maybe_enable_from_env() is None
+
+
+def test_sync_fences_block_and_tag(monkeypatch):
+    monkeypatch.setenv(telemetry.ENV_SYNC, '1')
+    rec = telemetry.enable()
+    assert rec.sync_fences
+    with rec.span('jitted_step', kind='compute') as sp:
+        out = jax.jit(lambda x: x * 2)(jnp.ones(8))
+        sp.sync(out)
+    assert rec.events[-1]['synced'] is True
+
+
+# ---------------------------------------------------------------------
+# metrics registry + Prometheus
+
+def test_histogram_percentiles_and_summary():
+    h = telemetry.Histogram('t')
+    for v in range(1, 101):
+        h.observe(float(v))
+    s = h.summary()
+    assert s['count'] == 100 and s['min'] == 1.0 and s['max'] == 100.0
+    assert s['p50'] == 51.0 and s['p99'] == 100.0
+
+
+def test_registry_kind_clash_raises():
+    reg = telemetry.Registry()
+    reg.counter('a')
+    with pytest.raises(TypeError):
+        reg.gauge('a')
+
+
+def test_prometheus_text_is_valid_and_sanitized():
+    reg = telemetry.Registry()
+    reg.counter('steps.total').inc(3)
+    reg.gauge('loss-scale').set(1024)
+    h = reg.histogram('step_time_seconds')
+    for v in (0.1, 0.2, 0.3):
+        h.observe(v)
+    text = reg.to_prometheus()
+    assert rep_mod.validate_prometheus(text) == []
+    assert 'chainermn_tpu_steps_total 3.0' in text
+    assert 'chainermn_tpu_step_time_seconds{quantile="0.50"}' in text
+
+
+def test_validate_prometheus_catches_malformed():
+    assert rep_mod.validate_prometheus('ok_metric 1.0\n') == []
+    assert rep_mod.validate_prometheus('bad metric 1.0\n')
+    assert rep_mod.validate_prometheus('no_value\n')
+
+
+# ---------------------------------------------------------------------
+# interval arithmetic + overlap
+
+def test_merge_intervals_and_exposed_time():
+    merged = rep_mod.merge_intervals([(0, 2), (1, 3), (5, 6), (6, 6)])
+    assert merged == [(0, 3), (5, 6)]
+    assert rep_mod.exposed_time((0, 4), merged) == 1.0   # [3,4)
+    assert rep_mod.exposed_time((5, 6), merged) == 0.0
+
+
+def test_overlap_from_intervals_half_hidden():
+    st = rep_mod.overlap_from_intervals(
+        collective=[(0.0, 10.0)], compute=[(0.0, 5.0)])
+    assert st['total_collective_s'] == 10.0
+    assert st['exposed_collective_s'] == 5.0
+    assert st['overlap_fraction'] == 0.5
+
+
+def test_overlap_nested_collectives_count_once():
+    # an evaluator wrapper span around an inner allreduce span must
+    # not double the collective wall time
+    st = rep_mod.overlap_from_intervals(
+        collective=[(0.0, 10.0), (2.0, 8.0)], compute=[])
+    assert st['total_collective_s'] == 10.0
+    assert st['overlap_fraction'] == 0.0
+
+
+def test_overlap_without_collectives_is_none_not_fabricated():
+    st = rep_mod.overlap_from_intervals([], [(0.0, 5.0)])
+    assert st['overlap_fraction'] is None
+
+
+def test_overlap_stats_is_per_rank():
+    spans = [
+        {'rank': 0, 'kind': 'collective', 't0': 0.0, 't1': 1.0},
+        # rank 1's compute must NOT hide rank 0's collective
+        {'rank': 1, 'kind': 'compute', 't0': 0.0, 't1': 1.0},
+    ]
+    st = rep_mod.overlap_stats(spans)
+    assert st['overlap_fraction'] == 0.0
+    spans.append(
+        {'rank': 0, 'kind': 'compute', 't0': 0.0, 't1': 1.0})
+    assert rep_mod.overlap_stats(spans)['overlap_fraction'] == 1.0
+
+
+# ---------------------------------------------------------------------
+# merge + report + CLI
+
+def _write_rank_log(tmp_path, rank, records):
+    path = tmp_path / ('events-rank%d.jsonl' % rank)
+    with open(str(path), 'w') as f:
+        f.write(json.dumps({'type': 'meta', 'rank': rank,
+                            'wall0': 0.0}) + '\n')
+        for r in records:
+            f.write(json.dumps(dict(r, rank=rank)) + '\n')
+
+
+def test_build_report_merges_ranks_and_steps(tmp_path):
+    for rank in (0, 1):
+        _write_rank_log(tmp_path, rank, [
+            {'type': 'span', 'name': 'host_batch_prep', 'kind': 'host',
+             't0': 0.0, 't1': 0.01, 'iteration': 0},
+            {'type': 'span', 'name': 'jitted_step', 'kind': 'compute',
+             't0': 0.02, 't1': 0.10, 'iteration': 0},
+            {'type': 'span', 'name': 'allreduce_obj',
+             'kind': 'collective', 't0': 0.04, 't1': 0.08},
+            {'type': 'event', 'name': 'chaos:stall_kv',
+             'kind': 'chaos', 't': 0.05},
+        ])
+    report = rep_mod.build_report(str(tmp_path))
+    assert report['ranks'] == [0, 1]
+    assert len(report['steps']) == 2  # (iter 0, rank 0), (iter 0, rank 1)
+    assert report['steps'][0]['jitted_step_ms'] == 80.0
+    # each rank's 40 ms collective sits fully inside its compute span
+    assert report['overlap']['overlap_fraction'] == 1.0
+    assert len(report['chaos_events']) == 2
+    text = rep_mod.render_text(report)
+    assert 'overlap fraction: 1.000' in text
+    assert 'chaos events in timeline: 2' in text
+
+
+def test_report_tolerates_torn_tail(tmp_path):
+    _write_rank_log(tmp_path, 0, [
+        {'type': 'span', 'name': 'jitted_step', 'kind': 'compute',
+         't0': 0.0, 't1': 1.0}])
+    with open(str(tmp_path / 'events-rank0.jsonl'), 'a') as f:
+        f.write('{"type": "span", "name": "torn')  # crashed mid-write
+    report = rep_mod.build_report(str(tmp_path))
+    assert report['n_spans'] == 1
+    assert report['n_unparseable_lines'] == 1
+
+
+def test_aggregate_metrics_merges_histogram_samples(tmp_path):
+    for rank, samples in ((0, [0.1, 0.2]), (1, [0.3, 0.4])):
+        with open(str(tmp_path / ('metrics-rank%d.json' % rank)),
+                  'w') as f:
+            json.dump({'rank': rank, 'metrics': {
+                'step_time_seconds': {
+                    'type': 'histogram', 'count': 2,
+                    'sum': sum(samples), 'samples': samples},
+                'steps_total': {'type': 'counter', 'value': 2.0},
+            }}, f)
+    merged = rep_mod.aggregate_metrics(
+        rep_mod.load_rank_metrics(str(tmp_path)))
+    assert merged['steps_total']['value'] == 4.0
+    h = merged['step_time_seconds']
+    assert h['count'] == 4
+    assert h['summary']['min'] == 0.1 and h['summary']['max'] == 0.4
+
+
+def test_cli_report_empty_capture_exits_2(tmp_path, capsys):
+    from chainermn_tpu.telemetry.__main__ import main
+    assert main(['report', str(tmp_path)]) == 2
+
+
+def test_cli_report_writes_artifacts(tmp_path, capsys):
+    from chainermn_tpu.telemetry.__main__ import main
+    _write_rank_log(tmp_path, 0, [
+        {'type': 'span', 'name': 'jitted_step', 'kind': 'compute',
+         't0': 0.0, 't1': 0.5, 'iteration': 0},
+        {'type': 'span', 'name': 'allreduce_obj', 'kind': 'collective',
+         't0': 0.1, 't1': 0.2}])
+    assert main(['report', str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert 'overlap fraction' in out
+    assert os.path.exists(str(tmp_path / 'merged_report.json'))
+    assert os.path.exists(str(tmp_path / 'metrics.json'))
+    assert rep_mod.validate_prometheus(
+        open(str(tmp_path / 'metrics.prom')).read()) == []
+
+
+# ---------------------------------------------------------------------
+# instrumentation integration
+
+def test_updater_emits_step_phase_spans(tmp_path):
+    telemetry.enable(outdir=str(tmp_path))
+    upd, batch = _mlp_updater()
+    for _ in range(2):
+        upd.update_core(upd.shard_batch(batch))
+    rec = telemetry.active()
+    names = [e['name'] for e in rec.events if e['type'] == 'span']
+    for phase in ('host_batch_prep', 'h2d', 'jitted_step'):
+        assert names.count(phase) == 2, (phase, names)
+    # iteration attrs group the phases per step
+    its = sorted(e['iteration'] for e in rec.events
+                 if e.get('name') == 'jitted_step')
+    assert its == [0, 1]
+    # the strategy's trace-time collective-issue mark fired ONCE (one
+    # compilation), as did the L4 wrapper's broadcast/allreduce marks
+    marks = [e['name'] for e in rec.events
+             if e.get('kind') == 'collective_trace']
+    assert marks.count('XlaCommunicator:allreduce_grad') == 1
+    assert marks.count('multi_node_optimizer:broadcast_data') == 1
+    # the merged report computes a step table from the capture
+    telemetry.flush()
+    report = rep_mod.build_report(str(tmp_path))
+    assert len(report['steps']) == 2
+    assert report['step_time_ms']['count'] == 2
+
+
+def test_pipeline_updater_emits_step_spans():
+    from chainermn_tpu.training.pipeline_updater import (
+        PipelineUpdater, pipeline_mesh)
+
+    telemetry.enable()
+    mesh = pipeline_mesh(2)
+    d = 8
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p['w'])
+
+    def loss_on_last(outs, y):
+        loss = jnp.mean((outs - y) ** 2)
+        return loss, {'mse': loss}
+
+    upd = PipelineUpdater(
+        iter([]), optax.sgd(0.1), stage_fn, loss_on_last,
+        {'w': jnp.zeros((2, d, d), jnp.float32)}, mesh, n_micro=2)
+    n_data = mesh.shape['data']
+    rs = np.random.RandomState(0)
+    batch = [(rs.randn(d).astype(np.float32),
+              rs.randn(d).astype(np.float32))
+             for _ in range(4 * n_data)]
+    upd.update_core(upd.shard_batch(batch))
+    names = [e['name'] for e in telemetry.active().events
+             if e['type'] == 'span']
+    assert 'host_batch_prep' in names
+    assert 'h2d' in names
+    assert 'jitted_step' in names
+
+
+def test_multi_node_optimizer_broadcast_appears_exactly_once():
+    """Satellite regression (ISSUE 6): over several optimizer steps
+    the first-call broadcast mark appears EXACTLY once in the
+    timeline -- once because the wrapper traces the broadcast branch
+    a single time (one compilation), and not more, which would be the
+    footprint of a recompilation leak re-tracing the step."""
+    telemetry.enable()
+    upd, batch = _mlp_updater()
+    arrays = upd.shard_batch(batch)
+    for _ in range(3):
+        upd.update_core(arrays)
+    events = telemetry.active().events
+    marks = [e['name'] for e in events
+             if e.get('kind') == 'collective_trace']
+    assert marks.count('multi_node_optimizer:broadcast_data') == 1
+    assert marks.count('multi_node_optimizer:allreduce_grad') == 1
+
+
+def test_evaluator_wrapper_emits_collective_span():
+    comm = chainermn_tpu.create_communicator('xla', mesh_shape=(2, 4))
+    telemetry.enable()
+    ev = chainermn_tpu.create_multi_node_evaluator(
+        lambda: {'accuracy': 0.5, 'loss': 1.0}, comm)
+    out = ev.evaluate()
+    assert out['accuracy'] == 0.5
+    spans = [e for e in telemetry.active().events
+             if e['type'] == 'span']
+    (span,) = [s for s in spans
+               if s['name'] == 'multi_node_evaluator:allreduce']
+    assert span['kind'] == 'collective'
+    assert span['keys'] == 2
+
+
+def test_chaos_faults_land_in_timeline():
+    from chainermn_tpu.utils import chaos
+
+    telemetry.enable()
+    inj = chaos.install(chaos.FaultInjector('stall_kv=@0:0.0'))
+    try:
+        chaos.before_kv_wait()   # occurrence 0: fires
+        chaos.before_kv_wait()   # occurrence 1: does not
+    finally:
+        chaos.uninstall()
+    events = [e for e in telemetry.active().events
+              if e.get('kind') == 'chaos']
+    assert [e['name'] for e in events] == ['chaos:stall_kv']
+    assert events[0]['occurrence'] == 0
+    assert inj.counts()['stall_kv'] == 2
+
+
+def test_recovery_checkpoint_spans(tmp_path):
+    from chainermn_tpu.training import recovery
+
+    telemetry.enable()
+    upd, batch = _mlp_updater(donate=False)
+    upd.update_core(upd.shard_batch(batch))
+    handler = recovery.PreemptionHandler(upd, out=str(tmp_path),
+                                         signals=())
+    path = handler.checkpoint()
+    assert path and os.path.exists(path)
+    upd2, _ = _mlp_updater(donate=False)
+    it = recovery.auto_resume(upd2, str(tmp_path))
+    assert it == 1
+    names = [e['name'] for e in telemetry.active().events
+             if e['type'] == 'span' and e['kind'] == 'checkpoint']
+    assert 'checkpoint_write' in names
+    assert 'checkpoint_resume' in names
+
+
+def test_step_timer_records_into_active_registry_and_timeline():
+    telemetry.enable()
+    t = profiling.StepTimer(items_per_step=8, warmup=0)
+    for _ in range(3):
+        t.tick()
+        time.sleep(0.002)
+    s = t.summary()
+    assert s['steps'] == 2 and s['p50_step_s'] >= 0.001
+    # one timing source of truth: the session registry holds the
+    # histogram and the timeline holds one 'step' span per interval
+    reg = telemetry.registry()
+    assert reg.histogram('step_time_seconds').count == 2
+    steps = [e for e in telemetry.active().events
+             if e.get('name') == 'step']
+    assert len(steps) == 2
+
+
+def test_step_timer_standalone_without_telemetry():
+    t = profiling.StepTimer(items_per_step=8, warmup=0)
+    for _ in range(3):
+        t.tick()
+        time.sleep(0.002)
+    s = t.summary()
+    assert s['steps'] == 2 and s['items_per_sec'] > 0
+
+
+def test_benchmark_op_records_metric_when_enabled():
+    telemetry.enable()
+    f = jax.jit(lambda x: x * 2 + 1)
+    dt = profiling.benchmark_op(f, jnp.ones(64), n_steps=2, warmup=1)
+    assert dt > 0
+    assert telemetry.registry().histogram(
+        'benchmark_op_seconds').count == 1
+
+
+# ---------------------------------------------------------------------
+# the acceptance pin: telemetry disabled-by-default adds no
+# measurable per-step overhead
+
+def test_disabled_overhead_under_2pct_on_mlp_step():
+    """ISSUE 6 acceptance: telemetry disabled-by-default adds no
+    per-step overhead measurable by ``benchmark_op`` on the mlp step,
+    pinned at < 2%.  Measured as the STRONGER claim: the identical
+    ``update_core`` path with a live in-memory recorder (spans
+    actually recorded, no fences) stays within 2% of the disabled
+    path -- the disabled path does strictly less work (one attribute
+    load + identity check per guard), so the pin bounds it too.  A
+    large-ish mlp keeps the step in the tens-of-milliseconds range so
+    scheduler noise cannot fake a 2% delta; each arm takes the best
+    of three ``benchmark_op`` runs."""
+    assert not telemetry.enabled()
+    upd, batch = _mlp_updater(n_units=256, batch=1024, donate=False)
+    arrays = upd.shard_batch(batch)
+    jax.block_until_ready(upd.update_core(arrays))  # compile
+
+    def step():
+        return upd.update_core(arrays)
+
+    # INTERLEAVED arms: off/on alternate within each round, so
+    # ambient machine load lands on both equally and the min-of-rounds
+    # compares like with like (a sequential A-then-B layout flakes
+    # whenever a background process spans only one arm)
+    t_off, t_on = [], []
+    try:
+        for _ in range(4):
+            telemetry.disable()
+            t_off.append(profiling.benchmark_op(step, n_steps=8,
+                                                warmup=1))
+            telemetry.enable()  # in-memory recorder, fences off
+            t_on.append(profiling.benchmark_op(step, n_steps=8,
+                                               warmup=1))
+    finally:
+        telemetry.disable()
+    overhead = min(t_on) / min(t_off) - 1.0
+    assert overhead < 0.02, (
+        'telemetry-enabled update_core overhead %.2f%% (off %.3f ms, '
+        'on %.3f ms): the disabled-by-default path is bounded by '
+        'this and must stay unmeasurable'
+        % (overhead * 100, min(t_off) * 1e3, min(t_on) * 1e3))
