@@ -70,7 +70,12 @@ so the A/B pair against the default row is self-describing; see
 weight-mapped equivalent of the 7x7/2 stem -- ``models/resnet50.py``),
 ``--no-adopt`` (resnet50 only: keep the default batch-32 config even
 when a banked MFU-sweep artifact crowns a faster one; see
-``adopt_tuned_config``).
+``adopt_tuned_config``),
+``--tp N`` (transformer only: composed dp x tp MeshPlan arm -- rows
+carry ``tp``/``mesh``/per-axis collective bytes and the PERF.md
+90-115k tok/s/chip anchor; ``docs/mesh_parallelism.md``),
+``--donate`` (resnet50 only: donation + remat headline arm -- how
+real training runs; PERF.md knob #6).
 """
 
 import json
@@ -464,7 +469,8 @@ def _policy_row(pol, default_compute='bfloat16'):
 
 
 def _classifier_setup(model, insize, batch, seed=0, comm=None,
-                      n_classes=1000, policy=None):
+                      n_classes=1000, policy=None, donate=False,
+                      remat=False):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -492,7 +498,8 @@ def _classifier_setup(model, insize, batch, seed=0, comm=None,
     clf = StatefulClassifier(model)
     upd = training.StandardUpdater(
         iter([]), optimizer, clf.loss, params, comm,
-        model_state=model_state, donate=False, policy=policy)
+        model_state=model_state, donate=donate, policy=policy,
+        remat=remat)
     arrays = upd.shard_batch([(x[i], y[i]) for i in range(batch)])
     return upd, arrays
 
@@ -527,6 +534,49 @@ def _scan_maker(upd, arrays):
     return make
 
 
+def _donating_scan_maker(upd, arrays):
+    """Scan maker with REAL training donation (PERF.md knob #6): the
+    carried params/state/opt buffers are donated at the OUTER jit
+    boundary so XLA reuses them across the scanned steps instead of
+    holding the replay copies the default ``donate=False``
+    measurement keeps.  Donation consumes the inputs, so each timed
+    call re-places fresh copies from host snapshots -- a per-call
+    FIXED cost that the marginal-slope fit absorbs into the
+    ``overhead_ms`` intercept, never into the per-step estimate."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    step = upd._build_step(donate=False)  # donate at the outer jit
+    has_state = upd._has_state
+    rng0 = upd._rng
+    live = (upd.params, upd.model_state, upd.opt_state)
+    shardings = jax.tree_util.tree_map(lambda a: a.sharding, live)
+    host = jax.device_get(live)
+
+    def make(k):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+        def run(p, ms, os_):
+            def body(carry, i):
+                p, ms, os_ = carry
+                r = (jax.random.fold_in(rng0, i) if has_state
+                     else rng0)
+                p, ms, os_, metrics = step(p, ms, os_, r, *arrays)
+                return (p, ms, os_), metrics['loss']
+
+            _, losses = lax.scan(body, (p, ms, os_), jnp.arange(k))
+            return losses
+
+        def call():
+            return run(*jax.device_put(host, shardings))
+
+        return call
+
+    return make
+
+
 # (model-class name, fwd GFLOPs/image at 224px, per-device batch on
 # TPU / on CPU): the three BASELINE conv workloads share one builder
 _CONV_MODELS = {
@@ -537,7 +587,8 @@ _CONV_MODELS = {
 
 
 def _build_conv(name, quick, on_cpu, per_dev_override=None,
-                s2d=False, policy=None, fused_norm=False):
+                s2d=False, policy=None, fused_norm=False,
+                donate=False):
     import jax
 
     import chainermn_tpu.models as zoo
@@ -557,7 +608,12 @@ def _build_conv(name, quick, on_cpu, per_dev_override=None,
         num_classes=1000, fused_norm=fused_norm,
         **({'stem': 'space_to_depth'} if s2d else {}))
     pol = _resolve_policy(policy)
-    upd, arrays = _classifier_setup(model, insize, batch, policy=pol)
+    # --donate: measure the headline the way real training runs --
+    # buffers donated into the step and the backward rematerializing
+    # the forward (PERF.md knob #6: the default donate=False replay
+    # scan understates training)
+    upd, arrays = _classifier_setup(model, insize, batch, policy=pol,
+                                    donate=donate, remat=donate)
     fwd = fwd_gf * 1e9 * (insize / 224.0) ** 2
     base = BASELINE_IMG_PER_SEC_PER_CHIP * (4.1 / fwd_gf) \
         * (224.0 / insize) ** 2
@@ -565,29 +621,34 @@ def _build_conv(name, quick, on_cpu, per_dev_override=None,
              'flops-normalized to insize' if name == 'resnet50' else
              'resnet50 baseline scaled by analytic flops ratio '
              '4.1/%s (same hardware-time budget per image)' % fwd_gf)
-    return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
+    maker = (_donating_scan_maker if donate else _scan_maker)
+    return dict(make=maker(upd, arrays), upd=upd, arrays=arrays,
                 items=batch, insize=insize,
                 analytic_flops=3.0 * fwd * batch, baseline=base,
-                policy=_policy_row(pol),
+                policy=_policy_row(pol), donate=donate, remat=donate,
                 baseline_derivation=deriv)
 
 
-def _updater_setup(loss, params, examples, policy=None):
+def _updater_setup(loss, params, examples, policy=None, comm=None,
+                   param_specs=None):
     """Shared LM/MLP bench plumbing: communicator + multi-node adam +
     StandardUpdater (donate=False so scans can replay from the same
     buffers) + sharded batch -- ONE place for the updater-construction
-    contract the three non-conv builders share."""
+    contract the three non-conv builders share.  ``comm``/
+    ``param_specs`` override for the composed-mesh tp arm (a MeshPlan
+    communicator + per-leaf shardings)."""
     import optax
 
     import chainermn_tpu
     from chainermn_tpu import training
 
-    comm = chainermn_tpu.create_communicator('xla')
+    if comm is None:
+        comm = chainermn_tpu.create_communicator('xla')
     optimizer = chainermn_tpu.create_multi_node_optimizer(
         optax.adam(1e-3), comm)
     upd = training.StandardUpdater(
         iter([]), optimizer, loss, params, comm, has_aux=True,
-        donate=False, policy=policy)
+        donate=False, policy=policy, param_specs=param_specs)
     return upd, upd.shard_batch(examples)
 
 
@@ -634,7 +695,7 @@ def build_seq2seq(quick, on_cpu, per_dev_override=None, policy=None):
 
 
 def build_transformer(quick, on_cpu, per_dev_override=None,
-                      policy=None):
+                      policy=None, tp=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -649,20 +710,39 @@ def build_transformer(quick, on_cpu, per_dev_override=None,
             512, 8, 6, 1024, 32000, 8
     per_dev = per_dev_override or per_dev
     batch = per_dev * jax.device_count()
+    plan = comm = specs = None
+    tp_kw = {}
+    if tp:
+        # composed dp x tp mesh (docs/mesh_parallelism.md): heads and
+        # MLP columns/rows split on the `model` axis, batch shards on
+        # `data` only -- each data replica spans `tp` chips
+        from chainermn_tpu.parallel.meshplan import MeshPlan
+        plan = MeshPlan.create(tp=tp)
+        comm = plan.communicator()
+        tp_kw = {'tp_axis': plan.model_axis}
     model = TransformerLM(vocab_size=vocab, d_model=d_model,
                           n_heads=n_heads, n_layers=n_layers,
-                          d_ff=4 * d_model, max_len=seq)
+                          d_ff=4 * d_model, max_len=seq, **tp_kw)
     rng = np.random.RandomState(0)
     toks = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
     tgts = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
-    params = init_on_host(
-        model.init, jax.random.PRNGKey(0),
-        jnp.zeros((1, seq), jnp.int32))['params']
+    if tp:
+        from chainermn_tpu.models import tp_oracle, tp_param_specs
+        # the tp model's parameter tree IS the oracle's: init the
+        # unsharded twin, shard by specs (the updater places them)
+        params = init_on_host(
+            tp_oracle(model).init, jax.random.PRNGKey(0),
+            jnp.zeros((1, seq), jnp.int32))['params']
+        specs = tp_param_specs(params, plan.model_axis)
+    else:
+        params = init_on_host(
+            model.init, jax.random.PRNGKey(0),
+            jnp.zeros((1, seq), jnp.int32))['params']
     loss = lm_loss(lambda p, t: model.apply({'params': p}, t))
     pol = _resolve_policy(policy)
     upd, arrays = _updater_setup(
         loss, params, [(toks[i], tgts[i]) for i in range(batch)],
-        policy=pol)
+        policy=pol, comm=comm, param_specs=specs)
     tokens = batch * seq
     # per token fwd: 12 d^2 per layer (qkvo + 2-layer 4d MLP) +
     # 4*seq*d attention matmuls per layer (causal halves it) + lm head
@@ -673,13 +753,29 @@ def build_transformer(quick, on_cpu, per_dev_override=None,
     flops = 3.0 * per_tok_fwd * tokens
     base = BASELINE_IMG_PER_SEC_PER_CHIP * 4.1e9 * 3.0 / (
         flops / tokens)
-    return dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
-                items=tokens, analytic_flops=flops, baseline=base,
-                policy=_policy_row(pol),
-                baseline_derivation='resnet50 baseline converted to '
-                'tokens/sec via analytic flops per item',
-                check_fn=lambda: _transformer_numerics_check(
-                    model, params, toks, tgts))
+    out = dict(make=_scan_maker(upd, arrays), upd=upd, arrays=arrays,
+               items=tokens, analytic_flops=flops, baseline=base,
+               policy=_policy_row(pol),
+               baseline_derivation='resnet50 baseline converted to '
+               'tokens/sec via analytic flops per item',
+               # PERF.md transformer roofline anchor: ~290k tok/s/chip
+               # perfect-MXU for the d512/L6/seq1024/V32k config on
+               # v5e, 30-40% MFU => 90-115k -- attached to every
+               # transformer row so the banked artifact carries its
+               # own bar (the CPU/plumbing configs differ from the
+               # anchor config; anchor_config_match says so)
+               anchor_tok_s_per_chip=[90000.0, 115000.0],
+               anchor_source='PERF.md: d512/L6/seq1024/V32k @ '
+               '30-40%% MFU of 197 TF/s',
+               anchor_config_match=bool(
+                   not on_cpu and per_dev_override is None))
+    if not tp:
+        out['check_fn'] = lambda: _transformer_numerics_check(
+            model, params, toks, tgts)
+    if tp:
+        out['tp'] = int(plan.model_size)
+        out['mesh'] = plan.describe()
+    return out
 
 
 def _transformer_numerics_check(model, params, toks, tgts):
@@ -866,14 +962,16 @@ def measure(argv):
     s2d = parse_s2d(argv, model_name)
     policy_name = parse_policy(argv, model_name)
     fused_norm = parse_fused_norm(argv, model_name)
-    _log('building %s%s%s%s%s' % (model_name,
-                                  ' (per-device batch %d)' % per_dev
-                                  if per_dev else '',
-                                  ' (s2d stem)' if s2d else '',
-                                  ' (policy %s)' % policy_name
-                                  if policy_name else '',
-                                  ' (fused norm)' if fused_norm
-                                  else ''))
+    tp = parse_tp(argv, model_name)
+    donate = parse_donate(argv, model_name)
+    _log('building %s%s%s%s%s%s%s' % (
+        model_name,
+        ' (per-device batch %d)' % per_dev if per_dev else '',
+        ' (s2d stem)' if s2d else '',
+        ' (policy %s)' % policy_name if policy_name else '',
+        ' (fused norm)' if fused_norm else '',
+        ' (tp %d)' % tp if tp else '',
+        ' (donate+remat)' if donate else ''))
     extra_kw = {}
     if s2d:
         extra_kw['s2d'] = True
@@ -881,6 +979,10 @@ def measure(argv):
         extra_kw['policy'] = policy_name
     if fused_norm:
         extra_kw['fused_norm'] = True
+    if tp:
+        extra_kw['tp'] = tp
+    if donate:
+        extra_kw['donate'] = True
     cfg = BUILDERS[model_name](quick, on_cpu, per_dev, **extra_kw)
     make = cfg['make']
 
@@ -944,6 +1046,38 @@ def measure(argv):
     )
     if 'insize' in cfg:
         result['insize'] = cfg['insize']
+    if 'donate' in cfg:
+        # donation + remat arm: how real training runs; the default
+        # rows replay with donate=False (PERF.md knob #6)
+        result['donate'] = bool(cfg['donate'])
+        result['remat'] = bool(cfg['remat'])
+    if model_name == 'transformer':
+        # tokens/s/chip vs the PERF.md roofline anchor, on every
+        # transformer row (the tp arm's acceptance bar)
+        result['anchor_tok_s_per_chip'] = cfg['anchor_tok_s_per_chip']
+        result['anchor_source'] = cfg['anchor_source']
+        result['anchor_config_match'] = cfg['anchor_config_match']
+        lo, hi = cfg['anchor_tok_s_per_chip']
+        result['pct_of_anchor_mid'] = round(
+            100.0 * per_chip / ((lo + hi) / 2.0), 1)
+    if cfg.get('tp'):
+        result['tp'] = cfg['tp']
+        result['mesh'] = cfg['mesh']
+        try:
+            # per-axis collective bytes of the traced per-device step
+            # (dp vs tp wire traffic, jaxpr-level -- no capture
+            # needed); see analysis/memtraffic.py
+            import jax as _jax
+            from chainermn_tpu.analysis.memtraffic import (
+                collective_bytes_by_axis)
+            fn, args = cfg['upd'].traceable_step(cfg['arrays'])
+            by_axis = collective_bytes_by_axis(
+                _jax.make_jaxpr(fn)(*args))
+            result['collective_bytes_per_axis_mb'] = {
+                k: round(v / 1e6, 3) for k, v in sorted(
+                    by_axis.items())}
+        except Exception as e:
+            result['collective_bytes_per_axis_error'] = repr(e)[:300]
     # flash-attention block overrides (ci/run_fa_tuned.sh adoption
     # path): the row must record the kernel config it measured
     if os.environ.get('CHAINERMN_TPU_FA_BLOCK_Q'):
@@ -1175,6 +1309,48 @@ def parse_fused_norm(argv, model):
     return True
 
 
+def parse_tp(argv, model):
+    """``--tp N`` (transformer only): composed dp x tp MeshPlan arm
+    -- attention heads / MLP columns+rows split over the ``model``
+    mesh axis (docs/mesh_parallelism.md).  Validated in the PARENT
+    before the backend probe, like the other flags."""
+    if '--tp' not in argv:
+        return None
+    if model != 'transformer':
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+                  error='bad_flag',
+                  detail='--tp (tensor-parallel MeshPlan arm) '
+                  'applies to --model transformer only'), rc=1)
+    i = argv.index('--tp')
+    raw = argv[i + 1] if i + 1 < len(argv) else None
+    try:
+        val = int(raw)
+        if val <= 0:
+            raise ValueError
+    except (TypeError, ValueError):
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+                  error='bad_tp',
+                  detail='--tp needs a positive integer, got %r'
+                  % (raw,)), rc=1)
+    return val
+
+
+def parse_donate(argv, model):
+    """``--donate`` (resnet50 only): the donation+remat headline arm
+    -- buffers donated into the step and the backward rematerializing
+    the forward, i.e. how real training runs (PERF.md knob #6: the
+    default replay scan measures with donate=False and understates
+    it)."""
+    if '--donate' not in argv:
+        return False
+    if model != 'resnet50':
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+                  error='bad_flag',
+                  detail='--donate (donation + remat headline arm) '
+                  'applies to --model resnet50 only'), rc=1)
+    return True
+
+
 def _last_json_row(path):
     """Parse the last non-blank line of a bench artifact as JSON (the
     one-JSON-line-last contract every ``bench_*.out`` follows; the
@@ -1280,6 +1456,14 @@ def _quickness_matches(a, b):
     return a is None or b is None or a == b
 
 
+def _round_tag_of(source):
+    """The round tag (window ordinal) a bench artifact name carries
+    (``bench_resnet50_b64_r5.out`` -> ``r5``); None when the name
+    follows no round convention."""
+    m = re.search(r'_(r[a-zA-Z0-9]+)\.out$', str(source or ''))
+    return m.group(1) if m else None
+
+
 def _pick_tuned(rows, fallback_incumbent=None):
     """Adoption decision over bench JSON rows (rich form).
 
@@ -1334,6 +1518,15 @@ def _pick_tuned(rows, fallback_incumbent=None):
                            'against' % (quickness or 'unknown'))
         return out
     inc_value, inc_row = max(matching, key=lambda iv: iv[0])
+    # window/device identity (ADVICE r5 adoption-fairness residual):
+    # the round tag is the chip-window ordinal and device_kind the
+    # hardware identity -- a winner crowned across two windows (or
+    # two chip generations) is visible in the provenance instead of
+    # silently passing as a same-conditions comparison
+    w_tag = _round_tag_of(row.get('_source'))
+    i_tag = _round_tag_of(inc_row.get('_source'))
+    w_kind = row.get('device_kind')
+    i_kind = inc_row.get('device_kind')
     out.update(
         incumbent_source=inc_row.get('_source', '(unknown artifact)'),
         incumbent_value=inc_value,
@@ -1341,8 +1534,15 @@ def _pick_tuned(rows, fallback_incumbent=None):
         winner_quick=quickness,
         winner_scan_lengths=row.get('scan_lengths'),
         incumbent_scan_lengths=inc_row.get('scan_lengths'),
-        winner_device_kind=row.get('device_kind'),
-        incumbent_device_kind=inc_row.get('device_kind'),
+        winner_device_kind=w_kind,
+        incumbent_device_kind=i_kind,
+        winner_round_tag=w_tag,
+        incumbent_round_tag=i_tag,
+        cross_window=bool(
+            (w_tag is not None and i_tag is not None
+             and w_tag != i_tag)
+            or (w_kind is not None and i_kind is not None
+                and w_kind != i_kind)),
     )
     if value <= inc_value:
         return out  # default config still wins
@@ -1569,6 +1769,8 @@ def main():
     parse_s2d(argv, model)
     parse_policy(argv, model)
     parse_fused_norm(argv, model)
+    parse_tp(argv, model)
+    parse_donate(argv, model)
     if '--child' in argv:
         measure([a for a in argv if a != '--child'])
         return

@@ -324,8 +324,37 @@ def device_utilization(paths):
         return {}
 
 
-def analyze_trace(trace_dir):
-    """One report object for one trace dir (or an explanatory stub)."""
+def overlap_by_axis_from_telemetry(outdir):
+    """Per-mesh-axis overlap split from a telemetry session dir
+    (``events-rank*.jsonl``).  Device xplane profiles carry no mesh
+    axis names -- the HLO op name of a lowered all-reduce says
+    nothing about WHICH named axis it spans -- so the dp-vs-tp split
+    of the overlap column comes from the axis-tagged telemetry spans
+    (:func:`chainermn_tpu.telemetry.report.overlap_stats`), captured
+    alongside the profile (``CHAINERMN_TPU_TELEMETRY=<dir>``)."""
+    from chainermn_tpu.telemetry import report as treport
+
+    _metas, spans, _events, _bad = treport.load_rank_logs(outdir)
+    st = treport.overlap_stats(spans)
+    return {
+        key: {
+            'spans': agg['spans'],
+            'total_collective_ms': round(
+                agg['total_collective_s'] * 1e3, 3),
+            'exposed_collective_ms': round(
+                agg['exposed_collective_s'] * 1e3, 3),
+            'overlap_fraction': agg['overlap_fraction'],
+        }
+        for key, agg in (st.get('per_axis') or {}).items()}
+
+
+def analyze_trace(trace_dir, telemetry_dir=None):
+    """One report object for one trace dir (or an explanatory stub).
+
+    ``telemetry_dir`` (or a ``telemetry/`` subdir of the trace dir
+    holding ``events-rank*.jsonl``) adds the per-axis dp-vs-tp split
+    to the overlap object -- see
+    :func:`overlap_by_axis_from_telemetry`."""
     paths = sorted(glob.glob(
         os.path.join(trace_dir, '**', '*.xplane.pb'), recursive=True))
     out = {'trace_dir': os.path.relpath(trace_dir, HERE)}
@@ -377,6 +406,17 @@ def analyze_trace(trace_dir):
                           'exposed_collective_ms': None,
                           'overlap_fraction': None,
                           'error': repr(e)}
+    # dp-vs-tp axis split of the overlap column, from the axis-tagged
+    # telemetry capture when one rode along (never fabricated from
+    # the axis-blind device profile)
+    tdir = telemetry_dir or os.path.join(trace_dir, 'telemetry')
+    if glob.glob(os.path.join(tdir, 'events-rank*.jsonl')):
+        try:
+            out['overlap']['by_axis'] = \
+                overlap_by_axis_from_telemetry(tdir)
+            out['overlap']['by_axis_source'] = tdir
+        except Exception as e:
+            out['overlap']['by_axis_error'] = repr(e)
     util = device_utilization(paths)
     if util:
         out['device_utilization'] = util
@@ -420,6 +460,14 @@ def render(report):
         lines.append('  overlap: no collective spans in trace%s'
                      % (' (%s)' % ov['error'] if ov.get('error')
                         else ''))
+    for key, agg in sorted((ov.get('by_axis') or {}).items()):
+        frac = agg.get('overlap_fraction')
+        lines.append(
+            '    axis %-12s %4d spans  %8.3f ms collective  '
+            '%8.3f ms exposed  overlap %s'
+            % (key, agg['spans'], agg['total_collective_ms'],
+               agg['exposed_collective_ms'],
+               '-' if frac is None else '%.3f' % frac))
     for key, val in (report.get('device_utilization') or {}).items():
         lines.append('  %s: %s' % (key, val))
     for name, b in report['buckets'].items():
@@ -451,6 +499,11 @@ def render(report):
 
 
 def main(argv):
+    telemetry_dir = None
+    if '--telemetry' in argv:
+        i = argv.index('--telemetry')
+        telemetry_dir = argv[i + 1] if i + 1 < len(argv) else None
+        argv = argv[:i] + argv[i + 2:]
     dirs = [a for a in argv if not a.startswith('--')]
     if '--latest' in argv or not dirs:
         dirs = dirs or latest_trace_dirs()
@@ -483,7 +536,8 @@ def main(argv):
         print('wrote stub %s' % os.path.relpath(out_path,
                                                 os.getcwd()))
         return 0
-    reports = [analyze_trace(d) for d in dirs]
+    reports = [analyze_trace(d, telemetry_dir=telemetry_dir)
+               for d in dirs]
     with open(out_path, 'w') as f:
         for rep in reports:
             f.write(json.dumps(rep) + '\n')

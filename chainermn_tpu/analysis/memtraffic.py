@@ -59,6 +59,30 @@ def _aval_bytes(aval):
         return 0
 
 
+def collective_bytes_by_axis(jaxpr):
+    """Bytes each mesh axis's collectives move in one traced step,
+    keyed by the axis tuple (``'data'``, ``'model'``,
+    ``'data,model'`` for multi-axis reduces): per collective equation
+    the widest operand's bytes, summed per axis key.  Jaxpr-level and
+    per-device (the traced program IS the per-device program), so a
+    dp x tp bench row can report where its wire bytes go
+    (``bench.py --tp``) without a device capture."""
+    from chainermn_tpu.analysis import walker
+
+    out = {}
+    for eqn, _path in walker.iter_eqns(jaxpr):
+        if eqn.primitive.name not in walker.COLLECTIVE_PRIMS:
+            continue
+        axes = walker.eqn_axes(eqn)
+        if not axes:
+            continue
+        nbytes = max((_aval_bytes(v.aval) for v in eqn.invars
+                      if hasattr(v, 'aval')), default=0)
+        key = ','.join(axes)
+        out[key] = out.get(key, 0) + nbytes
+    return out
+
+
 def _in_kernel_layer(eqn, path):
     """Equations from the hand-scheduled kernel layer are exempt from
     the materialization audit: by source file (the kernel's reference

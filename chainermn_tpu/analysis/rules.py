@@ -44,13 +44,18 @@ class RuleContext:
         collective helper (a strategy's bare ``allreduce_grad``) has
         nothing to overlap with BY CONSTRUCTION and would always
         read as serialized.
+      plan_axes: the composed-mesh axes the target DECLARES its
+        computation spans (a :class:`chainermn_tpu.parallel.MeshPlan`
+        target declares ``('data', 'model')``); enables the SL010
+        multi-axis family.  None (single-axis targets) disables it.
       trace_error: exception raised while tracing, if any.
     """
 
     def __init__(self, target_name, jaxpr=None, mesh_axes=None,
                  reduction_axes=None, signatures=None,
                  trace_error=None, declared_dtypes=None,
-                 compute_dtype=None, overlap_check=False):
+                 compute_dtype=None, overlap_check=False,
+                 plan_axes=None):
         self.target_name = target_name
         self.jaxpr = jaxpr
         self.mesh_axes = dict(mesh_axes or {})
@@ -58,6 +63,8 @@ class RuleContext:
         self.declared_dtypes = declared_dtypes
         self.compute_dtype = compute_dtype
         self.overlap_check = overlap_check
+        self.plan_axes = (tuple(plan_axes) if plan_axes is not None
+                          else None)
         self.signatures = signatures
         self.trace_error = trace_error
 
@@ -203,7 +210,14 @@ def rule_reduction_dtype(ctx):
     if ctx.jaxpr is None:
         return out
     allowed = set()
-    for d in (ctx.declared_dtypes or ()):
+    # the declared COMPUTE dtype is allowed too: a bf16-native model
+    # whose forward psums activations in bf16 (the tp transformer's
+    # embedding reduction) is the declared design, not an accidental
+    # gradient narrowing
+    declared = tuple(ctx.declared_dtypes or ())
+    if ctx.compute_dtype is not None:
+        declared += (ctx.compute_dtype,)
+    for d in declared:
         try:
             allowed.add(np.dtype(d).name)
         except TypeError:
@@ -518,6 +532,159 @@ def rule_collective_overlap(ctx):
     return out
 
 
+# ---------------------------------------------------------------------
+# SL010 family: multi-axis (composed-mesh) rules.  Scoped to targets
+# that DECLARE a MeshPlan topology (ctx.plan_axes, e.g.
+# ('data', 'model')): the single-axis strategy sweep keeps SL001's
+# contract; these rules audit what only exists once axes COMPOSE.
+
+# SL010: plan-axis discipline.  (a) every collective must act over
+# declared plan axes only -- a collective over a mesh axis outside
+# the plan means some subsystem still thinks it owns the whole mesh
+# (the exact bug class composing dp x tp creates: a classic
+# full-mesh allreduce_grad would average tensor-parallel SHARDS
+# across the model axis); (b) every declared axis of size > 1 must be
+# touched by at least one collective -- devices hold shards along a
+# dead axis but never combine along it, so the axis only divides the
+# batch/weights without buying parallel work.
+def rule_plan_axis_coverage(ctx):
+    out = []
+    if ctx.jaxpr is None or ctx.plan_axes is None:
+        return out
+    declared = set(ctx.plan_axes)
+    seen = set()
+    for eqn, _path in walker.iter_eqns(ctx.jaxpr):
+        if eqn.primitive.name not in walker.COLLECTIVE_PRIMS:
+            continue
+        axes = [a for a in walker.eqn_axes(eqn)
+                if a in ctx.mesh_axes]
+        seen.update(axes)
+        stray = [a for a in axes if a not in declared]
+        if stray:
+            out.append(ctx.finding(
+                'SL010', SEV_ERROR,
+                '%s over axis %s outside the declared plan axes %s: '
+                'a collective crossing an undeclared axis combines '
+                'values the plan lays out as distinct shards'
+                % (eqn.primitive.name, sorted(stray),
+                   sorted(declared)), eqn))
+    for ax in sorted(declared):
+        if ctx.mesh_axes.get(ax, 1) > 1 and ax not in seen:
+            out.append(ctx.finding(
+                'SL010', SEV_ERROR,
+                'declared plan axis %r (size %d) is never touched by '
+                'any collective: the axis shards data/weights but no '
+                'computation ever combines along it (dead axis -- '
+                'drop it from the plan or wire its collectives)'
+                % (ax, ctx.mesh_axes[ax])))
+    return out
+
+
+# SL011: cross-axis redundant collective chain.  SL003 flags
+# re-reducing over an OVERLAPPING axis; in a composed mesh the new
+# waste shape is a reduce over one axis feeding DIRECTLY into a
+# reduce over a DISJOINT axis with no compute between: a single
+# reduction over the union moves the same bytes in one collective
+# (XLA lowers a multi-axis psum as one all-reduce over the product
+# group) instead of two serialized launches.  Scoped to plan targets:
+# the hierarchical/two_dimensional strategies STAGE their reductions
+# across axes on purpose (reduce-scatter within, allreduce across)
+# and declare no plan.
+def rule_cross_axis_chain(ctx):
+    out = []
+    if ctx.jaxpr is None or ctx.plan_axes is None:
+        return out
+    reduce_set = set(walker.REDUCE_PRIMS) - {
+        'reduce_scatter', 'psum_scatter'}
+    for jx, _path in walker.iter_jaxprs(ctx.jaxpr):
+        producers = walker.producer_map(jx)
+        for eqn in jx.eqns:
+            if eqn.primitive.name not in reduce_set:
+                continue
+            axes = set(walker.eqn_axes(eqn))
+            if not axes:
+                continue
+            for invar in eqn.invars:
+                prev = producers.get(invar)
+                if prev is None or prev.primitive.name \
+                        not in reduce_set:
+                    continue
+                paxes = set(walker.eqn_axes(prev))
+                if not paxes or axes & paxes:
+                    continue  # overlap is SL003's finding
+                out.append(ctx.finding(
+                    'SL011', SEV_WARNING,
+                    '%s over %s directly consumes %s over %s: '
+                    'consecutive reductions over disjoint plan axes '
+                    'serialize two collective launches where one '
+                    '%s over %s moves the same bytes once'
+                    % (eqn.primitive.name, sorted(axes),
+                       prev.primitive.name, sorted(paxes),
+                       eqn.primitive.name,
+                       sorted(axes | paxes)), eqn))
+    return out
+
+
+# SL012: tp-aware donation.  SL005 pairs donated inputs with output
+# slots by shape/dtype -- which is blind to SHARDING: under a
+# composed plan a donated model-sharded parameter whose matching
+# output leaves the shard_map with a DIFFERENT spec (gathered to
+# replicated, or resharded to another axis) cannot alias -- XLA must
+# materialize the resharded output next to the donated buffer and
+# the donation frees nothing.  The shard_map equation carries the
+# in/out axis mappings (``in_names``/``out_names``), so the mismatch
+# is statically visible.
+def rule_tp_donation(ctx):
+    out = []
+    if ctx.jaxpr is None or ctx.plan_axes is None:
+        return out
+    for eqn, _path in walker.iter_eqns(ctx.jaxpr):
+        if eqn.primitive.name != 'pjit':
+            continue
+        donated = eqn.params.get('donated_invars')
+        if not donated or not any(donated):
+            continue
+        sub = walker.raw_jaxpr(eqn.params['jaxpr'])
+        donated_vars = {id(var): i
+                        for i, (var, don) in enumerate(
+                            zip(sub.invars, donated)) if don}
+        for inner, _p in walker.iter_eqns(sub):
+            if inner.primitive.name != 'shard_map':
+                continue
+            in_names = inner.params.get('in_names')
+            out_names = inner.params.get('out_names')
+            if in_names is None or out_names is None:
+                continue  # primitive layout changed; stay silent
+            out_sig = []
+            for var, names in zip(inner.outvars, out_names):
+                aval = getattr(var, 'aval', None)
+                if aval is not None:
+                    out_sig.append((tuple(aval.shape),
+                                    str(aval.dtype), dict(names)))
+            for pos, (var, names) in enumerate(
+                    zip(inner.invars, in_names)):
+                arg_i = donated_vars.get(id(var))
+                if arg_i is None or not dict(names):
+                    continue  # not donated, or replicated anyway
+                aval = var.aval
+                sig = (tuple(aval.shape), str(aval.dtype))
+                matches = [o for o in out_sig if o[:2] == sig]
+                if not matches:
+                    continue  # SL005's finding, not ours
+                if not any(o[2] == dict(names) for o in matches):
+                    out.append(ctx.finding(
+                        'SL012', SEV_WARNING,
+                        'donated argument %d (%s%s, sharded %r into '
+                        'the shard_map) matches outputs only under a '
+                        'different sharding (%s): the resharded '
+                        'output cannot alias the donated shard and '
+                        'the donation frees nothing'
+                        % (arg_i, aval.dtype, list(aval.shape),
+                           dict(names),
+                           [o[2] for o in matches]), inner))
+    return out
+
+
 #: rule id -> (callable, one-line description)
 RULES = {
     'SL001': (rule_axis_topology,
@@ -546,6 +713,18 @@ RULES = {
               'gradient-sized reduce collectives are schedulable '
               'before their last consumer (independent work exists '
               'to overlap them with; step targets only)'),
+    'SL010': (rule_plan_axis_coverage,
+              'composed-mesh targets: collectives act over declared '
+              'plan axes only, and every declared axis of size > 1 '
+              'is combined by at least one collective'),
+    'SL011': (rule_cross_axis_chain,
+              'no reduce-feeding-reduce chains over disjoint plan '
+              'axes (one multi-axis collective moves the same bytes '
+              'once)'),
+    'SL012': (rule_tp_donation,
+              'donated plan-sharded buffers alias an output of the '
+              'SAME sharding (a gathered/resharded output cannot '
+              'alias and wastes the donation)'),
 }
 
 

@@ -34,6 +34,7 @@ def lint_target(target, only=None):
         declared_dtypes=getattr(target, 'declared_dtypes', None),
         compute_dtype=getattr(target, 'compute_dtype', None),
         overlap_check=getattr(target, 'overlap_check', False),
+        plan_axes=getattr(target, 'plan_axes', None),
         signatures=signatures, trace_error=err)
     findings = rules_mod.run_rules(ctx, only=only)
     # a trace failure no rule claimed (SL001 claims unbound-axis

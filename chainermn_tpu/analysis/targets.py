@@ -45,7 +45,8 @@ class LintTarget:
 
     def __init__(self, name, fn, args, mesh_axes, reduction_axes=None,
                  make_args=None, declared_dtypes=None,
-                 compute_dtype=None, items=None, overlap_check=False):
+                 compute_dtype=None, items=None, overlap_check=False,
+                 plan_axes=None):
         self.name = name
         self.fn = fn
         self.args = tuple(args)
@@ -56,6 +57,8 @@ class LintTarget:
         self.compute_dtype = compute_dtype
         self.items = items
         self.overlap_check = overlap_check
+        self.plan_axes = (tuple(plan_axes) if plan_axes is not None
+                          else None)
         self.make_args = make_args
 
     def __repr__(self):
@@ -141,13 +144,14 @@ def _data_comm():
 
 
 def _updater_target(name, updater, batch, mesh_axes,
-                    compute_dtype=None, items=None):
+                    compute_dtype=None, items=None, plan_axes=None):
     fn, args = updater.traceable_step(batch, iteration=1)
     declared = getattr(updater, 'declared_reduce_dtypes',
                        lambda: None)()
     return LintTarget(
         name, fn, args, mesh_axes, declared_dtypes=declared,
         compute_dtype=compute_dtype, items=items, overlap_check=True,
+        plan_axes=plan_axes,
         make_args=lambda it: updater.traceable_step(
             batch, iteration=it)[1])
 
@@ -343,11 +347,54 @@ def resnet50_step_target(comm=None, insize=32, batch=8, policy=None,
                            compute_dtype='bfloat16', items=batch)
 
 
+def transformer_tp_step_target(policy=None, tp=2):
+    """The composed dp x tp train step (``docs/mesh_parallelism.md``):
+    a tensor-parallel ``TransformerLM(tp_axis='model')`` on a
+    :class:`chainermn_tpu.parallel.MeshPlan` CPU sub-mesh, threaded
+    through ``StandardUpdater(param_specs=...)`` with the plan
+    communicator (gradient reduction over ``data`` only).  Declares
+    ``plan_axes=('data', 'model')``, so the SL010 multi-axis family
+    audits it -- the clean reference state ``ci/run_staticcheck.sh``
+    pins in both precisions."""
+    import optax
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import (TransformerLM, lm_loss,
+                                      tp_oracle, tp_param_specs)
+    from chainermn_tpu.parallel.meshplan import MeshPlan
+
+    plan = MeshPlan.create(tp=tp)
+    comm = plan.communicator(
+        reduce_dtype=policy.reduce_dtype if policy is not None
+        else None)
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, d_ff=64, max_len=64,
+                          tp_axis=plan.model_axis)
+    params = tp_oracle(model).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))['params']
+    specs = tp_param_specs(params, plan.model_axis)
+    loss = lm_loss(lambda p, t: model.apply({'params': p}, t))
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    updater = training.StandardUpdater(
+        iter([]), optimizer, loss, params, comm, has_aux=True,
+        policy=policy, param_specs=specs)
+    n_tok = 2 * plan.data_size
+    batch = (jnp.zeros((n_tok, 16), jnp.int32),
+             jnp.zeros((n_tok, 16), jnp.int32))
+    return _updater_target('step:transformer_tp', updater, batch,
+                           dict(plan.mesh.shape),
+                           compute_dtype='bfloat16',
+                           items=n_tok * 16,
+                           plan_axes=tuple(plan.mesh.axis_names))
+
+
 def step_targets(include_resnet50=True, policy=None):
     out = [mlp_step_target(policy=policy), zero_core_target(),
            zero_step_target(policy=policy),
            bucketed_overlap_step_target(policy=policy),
-           pipeline_step_target(policy=policy)]
+           pipeline_step_target(policy=policy),
+           transformer_tp_step_target(policy=policy)]
     if include_resnet50:
         # unfused (flax-oracle) AND fused train steps: the SL008 /
         # memtraffic A/B pair ci/run_staticcheck.sh sweeps in both

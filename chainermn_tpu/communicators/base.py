@@ -132,6 +132,15 @@ class CommunicatorBase:
     #: (``dummy``) override it.
     reduction_axes = AXES
 
+    #: Axes the data-parallel contract spans: batch sharding
+    #: (:meth:`shard_batch`), ZeRO-1 partitioning and
+    #: :meth:`axis_rank`.  The classic strategies span the whole
+    #: mesh; a composed plan
+    #: (:class:`chainermn_tpu.parallel.MeshPlanCommunicator`)
+    #: narrows this to its ``data`` axes so tensor-parallel shards
+    #: are never partitioned or reduced across the ``model`` axis.
+    data_axes = AXES
+
     def __init__(self, mesh=None, mesh_shape=None, devices=None,
                  reduce_dtype=None):
         """``reduce_dtype`` (e.g. ``'bfloat16'``): run every
@@ -239,10 +248,13 @@ class CommunicatorBase:
             # trace-time collective-issue mark (fires once per
             # compilation, not per step): correlates WHICH strategy
             # issued a gradient reduction into the program with the
-            # step spans around its executions
+            # step spans around its executions.  `axes` names the
+            # mesh axes the reduction spans, so the report can split
+            # dp vs tp collective time
             _telemetry.event(
                 '%s:allreduce_grad' % type(self).__name__,
                 kind='collective_trace',
+                axes=list(self.reduction_axes),
                 leaves=len(jax.tree_util.tree_leaves(grads)))
         rd = self.reduce_dtype
         if rd is None:
@@ -285,12 +297,13 @@ class CommunicatorBase:
         """
         if not _is_tracing(params):
             with _telemetry.span('broadcast_data', kind='collective',
-                                 strategy=type(self).__name__):
+                                 strategy=type(self).__name__,
+                                 axes=list(AXES)):
                 return self.replicate(params)
         if _telemetry._active is not None:
             _telemetry.event(
                 '%s:broadcast_data' % type(self).__name__,
-                kind='collective_trace')
+                kind='collective_trace', axes=list(AXES))
         me = self.axis_rank()
 
         def bcast(x):
@@ -427,7 +440,8 @@ class CommunicatorBase:
         """
         if jax.process_count() == 1:
             return
-        with _telemetry.span('barrier', kind='collective', tag=tag):
+        with _telemetry.span('barrier', kind='collective', tag=tag,
+                             axes=list(self.mesh.axis_names)):
             return self._barrier_impl(timeout, tag)
 
     def _barrier_impl(self, timeout, tag):
@@ -496,7 +510,7 @@ class CommunicatorBase:
             self.barrier(timeout=timeout, tag='allreduce_obj')
         from jax.experimental import multihost_utils
         with _telemetry.span('allreduce_obj', kind='collective',
-                             op=op):
+                             op=op, axes=list(self.mesh.axis_names)):
             vals = multihost_utils.process_allgather(value)
 
         def red(stack):

@@ -141,3 +141,21 @@ def balanced_2d(n):
     while n % intra:
         intra -= 1
     return (n // intra, intra)
+
+
+def divisor_leq(n, k):
+    """The largest divisor of ``n`` that is ``<= k`` (>= 1).
+
+    The graceful-degradation rule shared by
+    :class:`chainermn_tpu.parallel.MeshPlan`: a requested axis width
+    that does not divide the device count clamps DOWN to one that
+    does, so a plan written for a pod still builds on a laptop --
+    ``divisor_leq(1, k) == 1`` (the (1, 1) mesh), ``divisor_leq(n, n)
+    == n`` (the (1, n) mesh), ``divisor_leq(7, 2) == 1`` (prime
+    counts degrade to pure data parallelism)."""
+    if n < 1:
+        raise ValueError('need at least one device, got %d' % n)
+    k = max(1, min(int(k), n))
+    while n % k:
+        k -= 1
+    return k

@@ -22,7 +22,7 @@ from chainermn_tpu.models.resnet50 import (  # noqa
 from chainermn_tpu.models.seq2seq import Seq2seq, seq2seq_loss  # noqa
 from chainermn_tpu.models.transformer import (  # noqa
     TransformerLM, TransformerBlock, lm_loss, lm_loss_sum,
-    pipeline_parts)
+    pipeline_parts, tp_oracle, tp_param_specs)
 
 
 def get_arch(name, **kwargs):

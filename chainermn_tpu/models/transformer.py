@@ -12,13 +12,39 @@ All matmuls are bfloat16-by-default (MXU-native); accumulation and
 softmax bookkeeping stay float32.
 """
 
-from typing import Any, Optional
+from typing import Any, Optional, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
 from jax import lax
 
 from chainermn_tpu import ops
+
+
+class _TpDense(nn.Module):
+    """Explicit-shape kernel/bias holder for the tensor-parallel path.
+
+    The tp-local parameter TREE must mirror the unsharded oracle's
+    module names (``block_0/qkv/kernel`` ...) so that the GLOBAL
+    arrays -- local shapes times the ``model`` axis, reassembled by
+    ``shard_map`` out_specs / :func:`tp_param_specs` -- are exactly
+    the oracle's parameter tree: init the oracle once, place with the
+    tp shardings, and the two models share ONE checkpoint format.
+    ``nn.Dense``/``nn.DenseGeneral`` cannot declare the local shapes
+    (they re-derive the kernel shape from the input and reject the
+    shard), hence this holder."""
+
+    kernel_shape: Tuple[int, ...]
+    bias_shape: Optional[Tuple[int, ...]] = None
+
+    @nn.compact
+    def __call__(self):
+        k = self.param('kernel', nn.initializers.lecun_normal(),
+                       self.kernel_shape)
+        b = (self.param('bias', nn.initializers.zeros,
+                        self.bias_shape)
+             if self.bias_shape is not None else None)
+        return k, b
 
 
 class TransformerBlock(nn.Module):
@@ -29,9 +55,72 @@ class TransformerBlock(nn.Module):
     sequence_axis: Optional[str] = None
     dropout: float = 0.0
     sp_scheme: str = 'ring'  # 'ring' | 'ulysses' (see parallel.sequence)
+    tp_axis: Optional[str] = None  # Megatron tensor parallelism
+
+    def _tp_call(self, x):
+        """Megatron-sharded block body: heads and MLP columns split
+        over ``tp_axis``, one psum per half-block (attention, MLP)
+        via the row-parallel exits.  Entries/exits use the
+        ``tp_copy``/``tp_reduce`` conjugate pair so gradients taken
+        INSIDE ``shard_map`` (the updaters' mode, check_vma=False)
+        match the unsharded oracle -- see parallel/tensor.py."""
+        from chainermn_tpu.parallel import tensor
+
+        tp = lax.axis_size(self.tp_axis)
+        if self.n_heads % tp or self.d_ff % tp:
+            raise ValueError(
+                'tp_axis=%r of size %d must divide n_heads=%d and '
+                'd_ff=%d' % (self.tp_axis, tp, self.n_heads,
+                             self.d_ff))
+        d_head = self.d_model // self.n_heads
+        heads_l = self.n_heads // tp
+        d_ff_l = self.d_ff // tp
+
+        ln1_g = self.param('ln1_scale', nn.initializers.ones,
+                           (self.d_model,))
+        ln1_b = self.param('ln1_bias', nn.initializers.zeros,
+                           (self.d_model,))
+        h = ops.layer_norm(x, ln1_g, ln1_b).astype(self.dtype)
+        h = tensor.tp_copy(h, self.tp_axis)
+        wqkv, bqkv = _TpDense((self.d_model, 3, heads_l, d_head),
+                              (3, heads_l, d_head), name='qkv')()
+        attn = tensor.qkv_attention(
+            h, wqkv.astype(self.dtype), causal=True,
+            bqkv=bqkv.astype(self.dtype))
+        wo, bo = _TpDense((heads_l * d_head, self.d_model),
+                          (self.d_model,), name='proj')()
+        x = x + tensor.row_parallel_dense(
+            attn, wo.astype(self.dtype), self.tp_axis,
+            bo.astype(self.dtype), grad_conjugate=True)
+
+        ln2_g = self.param('ln2_scale', nn.initializers.ones,
+                           (self.d_model,))
+        ln2_b = self.param('ln2_bias', nn.initializers.zeros,
+                           (self.d_model,))
+        h = ops.layer_norm(x, ln2_g, ln2_b).astype(self.dtype)
+        h = tensor.tp_copy(h, self.tp_axis)
+        w_in, b_in = _TpDense((self.d_model, d_ff_l), (d_ff_l,),
+                              name='ff_in')()
+        g = nn.gelu(tensor.column_parallel_dense(
+            h, w_in.astype(self.dtype), b_in.astype(self.dtype)))
+        w_out, b_out = _TpDense((d_ff_l, self.d_model),
+                                (self.d_model,), name='ff_out')()
+        return x + tensor.row_parallel_dense(
+            g, w_out.astype(self.dtype), self.tp_axis,
+            b_out.astype(self.dtype), grad_conjugate=True)
 
     @nn.compact
     def __call__(self, x, train=False):
+        if self.tp_axis is not None:
+            if self.sequence_axis is not None:
+                raise ValueError('tp_axis and sequence_axis cannot '
+                                 'both be set on one block')
+            if train and self.dropout > 0:
+                raise ValueError('tp_axis blocks run without dropout '
+                                 '(per-rank rng divergence would '
+                                 'silently break the head groups); '
+                                 'build with dropout=0.0')
+            return self._tp_call(x)
         d_head = self.d_model // self.n_heads
         ln1_g = self.param('ln1_scale', nn.initializers.ones,
                            (self.d_model,))
@@ -73,10 +162,36 @@ class TransformerBlock(nn.Module):
         return x + h
 
 
+class _TpEmbed(nn.Module):
+    """Vocab-row-sharded embedding table holder (tp-local shape,
+    oracle tree name ``embed/embedding``)."""
+
+    shape: Tuple[int, ...]
+
+    @nn.compact
+    def __call__(self):
+        return self.param('embedding', nn.initializers.normal(0.02),
+                          self.shape)
+
+
 class TransformerLM(nn.Module):
     """Causal LM.  With ``sequence_axis`` set, call inside
     ``shard_map`` with the token dim sharded over that axis; position
-    embeddings are offset by the local shard's global start."""
+    embeddings are offset by the local shard's global start.
+
+    With ``tp_axis`` set (mutually exclusive with ``sequence_axis``),
+    call inside ``shard_map`` over a mesh binding that axis (the
+    :class:`chainermn_tpu.parallel.MeshPlan` ``model`` axis):
+    attention heads and MLP columns/rows split Megatron-style on the
+    axis with one psum per half-block, the embedding table is
+    vocab-row-sharded (masked local lookup + psum) and the vocab
+    projection is row-parallel over ``d_model``.  The parameter tree
+    is EXACTLY the unsharded oracle's -- init the ``tp_axis=None``
+    twin and place its params with :func:`tp_param_specs`; activations
+    stay replicated over the axis, so the batch shards on ``data``
+    only.  Numerically pinned against the oracle in
+    ``tests/test_transformer.py`` / ``tests/test_meshplan.py``.
+    """
 
     vocab_size: int = 32000
     d_model: int = 512
@@ -88,13 +203,65 @@ class TransformerLM(nn.Module):
     sequence_axis: Optional[str] = None
     dropout: float = 0.0
     sp_scheme: str = 'ring'  # 'ring' | 'ulysses' (see parallel.sequence)
+    tp_axis: Optional[str] = None  # Megatron tensor parallelism
+
+    def _tp_embed(self, tokens):
+        """Vocab-row-sharded lookup: each rank owns rows
+        ``[r*V/tp, (r+1)*V/tp)``; off-shard tokens contribute zeros
+        and ONE psum (``tp_reduce`` -- identity backward, so the local
+        table rows receive exactly their own scatter-add gradients)
+        completes the lookup."""
+        from chainermn_tpu.parallel import tensor
+
+        tp = lax.axis_size(self.tp_axis)
+        if self.vocab_size % tp or self.d_model % tp:
+            raise ValueError(
+                'tp_axis=%r of size %d must divide vocab_size=%d and '
+                'd_model=%d' % (self.tp_axis, tp, self.vocab_size,
+                                self.d_model))
+        v_local = self.vocab_size // tp
+        emb = _TpEmbed((v_local, self.d_model), name='embed')()
+        local = tokens - lax.axis_index(self.tp_axis) * v_local
+        in_shard = (local >= 0) & (local < v_local)
+        rows = jnp.take(emb, jnp.clip(local, 0, v_local - 1), axis=0)
+        x = jnp.where(in_shard[..., None], rows,
+                      jnp.zeros((), rows.dtype)).astype(self.dtype)
+        # exact in any dtype: per token exactly one rank is nonzero
+        return tensor.tp_reduce(x, self.tp_axis)
+
+    def _tp_head(self, x):
+        """Row-parallel vocab projection: ``d_model`` sliced per rank,
+        f32 contraction completed by one psum, bias added once after
+        (same arithmetic as the oracle's f32 ``lm_head`` Dense up to
+        the split-contraction summation order)."""
+        from chainermn_tpu.parallel import tensor
+
+        tp = lax.axis_size(self.tp_axis)
+        d_local = self.d_model // tp
+        kernel, bias = _TpDense((d_local, self.vocab_size),
+                                (self.vocab_size,), name='lm_head')()
+        xh = tensor.tp_copy(x.astype(self.dtype), self.tp_axis)
+        x_local = lax.dynamic_slice_in_dim(
+            xh, lax.axis_index(self.tp_axis) * d_local, d_local,
+            axis=-1)
+        return tensor.row_parallel_dense(
+            x_local.astype(jnp.float32), kernel.astype(jnp.float32),
+            self.tp_axis, bias, grad_conjugate=True)
 
     @nn.compact
     def __call__(self, tokens, train=False):
         """tokens (B, T_local) int32 -> logits (B, T_local, V) f32."""
+        tp_mode = self.tp_axis is not None
+        if tp_mode and self.sequence_axis is not None:
+            raise ValueError('tp_axis and sequence_axis cannot both '
+                             'be set (compose tp with data/pipeline '
+                             'axes via MeshPlan instead)')
         b, t = tokens.shape
-        x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype,
-                     name='embed')(tokens)
+        if tp_mode:
+            x = self._tp_embed(tokens)
+        else:
+            x = nn.Embed(self.vocab_size, self.d_model,
+                         dtype=self.dtype, name='embed')(tokens)
         pos0 = 0
         if self.sequence_axis is not None:
             pos0 = lax.axis_index(self.sequence_axis) * t
@@ -107,15 +274,56 @@ class TransformerLM(nn.Module):
             x = TransformerBlock(
                 self.d_model, self.n_heads, self.d_ff, self.dtype,
                 self.sequence_axis, self.dropout, self.sp_scheme,
+                tp_axis=self.tp_axis,
                 name=f'block_{i}')(x, train=train)
         gf = self.param('lnf_scale', nn.initializers.ones,
                         (self.d_model,))
         bf = self.param('lnf_bias', nn.initializers.zeros,
                         (self.d_model,))
-        x = ops.layer_norm(x, gf, bf).astype(self.dtype)
+        x = ops.layer_norm(x, gf, bf)
+        if tp_mode:
+            return self._tp_head(x)
         logits = nn.Dense(self.vocab_size, dtype=jnp.float32,
-                          name='lm_head')(x)
+                          name='lm_head')(x.astype(self.dtype))
         return logits
+
+
+def tp_oracle(model):
+    """The unsharded twin of a ``tp_axis`` model: same config, same
+    parameter tree (init THIS one to get params for either)."""
+    return model.clone(tp_axis=None, name=None)
+
+
+def tp_param_specs(params, axis='model'):
+    """``PartitionSpec`` tree for a ``TransformerLM(tp_axis=axis)``
+    parameter tree (which IS the unsharded oracle's tree): attention
+    heads and MLP columns/rows on ``axis``, embedding rows on the
+    vocab dim, ``lm_head`` rows on ``d_model``, everything else
+    (layer norms, positional table, post-reduction biases)
+    replicated.  Feed to
+    :meth:`chainermn_tpu.parallel.MeshPlan.param_shardings` or a
+    ``StandardUpdater(param_specs=...)``."""
+    from jax.sharding import PartitionSpec as P
+
+    def one(path, leaf):
+        names = {str(getattr(k, 'key', k)) for k in path}
+        nd = getattr(leaf, 'ndim', 0)
+        if 'embedding' in names:
+            return P(axis, None)
+        if 'qkv' in names:
+            return (P(None, None, axis, None) if nd == 4
+                    else P(None, axis, None))
+        if 'ff_in' in names:
+            return P(None, axis) if nd == 2 else P(axis)
+        if 'ff_out' in names or 'proj' in names \
+                or 'lm_head' in names:
+            # row-parallel kernels; their biases ride post-psum,
+            # replicated
+            return P(axis, None) if nd == 2 else P()
+        return P()
+
+    import jax
+    return jax.tree_util.tree_map_with_path(one, params)
 
 
 def pipeline_parts(model, params, n_stages, pad_id=-1):
@@ -142,6 +350,12 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
     if model.sequence_axis is not None:
         raise ValueError('pipeline_parts shards the batch dimension; '
                          'build the model with sequence_axis=None')
+    if model.tp_axis is not None:
+        raise ValueError('pipeline_parts expects the unsharded block '
+                         'body; build the model with tp_axis=None '
+                         '(tensor parallelism composes with the '
+                         'pipeline via MeshPlan, not through the '
+                         'stacked stage tree)')
     if model.dropout:
         raise ValueError('pipeline_parts runs the blocks without '
                          'dropout rngs; build the model with '

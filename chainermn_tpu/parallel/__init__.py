@@ -28,9 +28,11 @@ pattern.  Purely local losses (data parallelism) are unaffected.
 """
 
 from chainermn_tpu.parallel.pipeline import Pipeline  # noqa
+from chainermn_tpu.parallel.meshplan import (  # noqa
+    MeshPlan, MeshPlanCommunicator, broadcast_specs_to_state)
 from chainermn_tpu.parallel.tensor import (  # noqa
     column_parallel_dense, row_parallel_dense, tp_attention,
-    tp_mlp, tp_transformer_block)
+    tp_copy, tp_mlp, tp_reduce, tp_transformer_block)
 from chainermn_tpu.parallel.sequence import (  # noqa
     mapped_global_loss, ring_attention, ulysses_attention)
 from chainermn_tpu.parallel.moe import (  # noqa

@@ -1,0 +1,324 @@
+"""MeshPlan: composed named-axis device meshes (data x model).
+
+Every parallelism axis in the repo used to run alone -- the 9
+data-parallel strategies span the whole ``(inter, intra)`` mesh, ZeRO
+partitions over it, the pipeline owns its own ``(data, stage)`` mesh.
+``MeshPlan`` is the composition layer: ONE mesh with named roles --
+``data`` (batch sharding + gradient reduction + ZeRO partitioning) and
+``model`` (Megatron tensor parallelism: attention heads / MLP columns
+and rows, :mod:`chainermn_tpu.parallel.tensor`) -- built from the same
+TPU/CPU topology discovery as the communicators
+(:mod:`chainermn_tpu.communicators.mesh_utility`), handing out
+``NamedSharding``/``PartitionSpec`` trees for params, optimizer state
+and batches (the SNIPPETS [2] named-2-D-mesh pattern, GSPMD-style: the
+specs declare placement, the compiler inserts the collectives the
+specs imply).
+
+Degradation is graceful and SHAPE-ONLY (the SNIPPETS [2] contract):
+both axes always exist with stable names; on small device counts the
+requested tp clamps to the largest divisor of the device count, so
+1 device -> ``(1, 1)``, tp >= n -> ``(1, n)``, tp = 1 -> ``(n, 1)`` --
+a ``psum`` over a size-1 axis is the identity and the same program
+runs unchanged.
+
+A pipeline axis is a planned extension, not wired yet: the
+:class:`~chainermn_tpu.training.PipelineUpdater` owns its own
+``(data, stage)`` mesh today, and ``MeshPlan.create`` reserves the
+``pp=`` slot so the 3-D composition lands without an API break.
+
+Threading: ``plan.communicator()`` returns a
+:class:`MeshPlanCommunicator` -- the updater-facing adapter whose
+gradient reduction, batch sharding and ZeRO partitioning span the
+``data`` axes ONLY (tensor-parallel leaves are sharded, not
+replicated, over ``model``; reducing them across it would be wrong) --
+and ``StandardUpdater(param_specs=...)`` takes the per-leaf spec tree
+(e.g. :func:`chainermn_tpu.models.tp_param_specs`) through placement,
+the mesh-aware jitted step (donation and policy casts intact) and the
+shard_map in/out specs.  See ``docs/mesh_parallelism.md``.
+"""
+
+import numpy as np
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from chainermn_tpu import telemetry as _telemetry
+from chainermn_tpu.communicators import mesh_utility
+from chainermn_tpu.communicators.base import CommunicatorBase
+
+#: canonical plan axis names (the SNIPPETS [2] ("batch", "model")
+#: pattern under the repo's own vocabulary)
+AXIS_DATA = 'data'
+AXIS_MODEL = 'model'
+PLAN_AXES = (AXIS_DATA, AXIS_MODEL)
+
+
+class MeshPlan:
+    """A named-axis mesh plus the spec handout for training on it.
+
+    Attributes:
+      mesh: the 2-D ``jax.sharding.Mesh`` (axes ``(data, model)``).
+      data_axes: axes batch sharding / gradient reduction / ZeRO span.
+      model_axis: the tensor-parallel axis name.
+      requested_tp: the tp the caller asked for (the effective tp is
+        ``model_size``; they differ only under graceful degradation).
+    """
+
+    def __init__(self, mesh, data_axes=(AXIS_DATA,),
+                 model_axis=AXIS_MODEL, requested_tp=None):
+        self.mesh = mesh
+        self.data_axes = tuple(data_axes)
+        self.model_axis = model_axis
+        self.requested_tp = requested_tp
+        for ax in self.data_axes + (self.model_axis,):
+            if ax not in mesh.shape:
+                raise ValueError('mesh %r does not bind plan axis %r'
+                                 % (dict(mesh.shape), ax))
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def create(cls, tp=1, devices=None, axis_names=PLAN_AXES, pp=None):
+        """Compose a ``(data, model)`` plan over the global devices.
+
+        ``tp`` is the requested model-axis width; it degrades to the
+        largest divisor of the device count
+        (:func:`mesh_utility.divisor_leq`), never errors on a small
+        host.  Devices are ordered by the same slice-aware sort as
+        the communicators (``mesh_utility.sorted_devices``), and the
+        model axis is the MINOR (fastest-varying) one so tensor
+        parallelism lands on the tightest ICI neighbors.
+
+        ``pp`` reserves the pipeline-axis slot for the 3-D extension;
+        any value other than ``None``/``1`` raises for now.
+        """
+        if pp not in (None, 1):
+            raise NotImplementedError(
+                'the pipeline axis is a reserved extension slot '
+                '(PipelineUpdater owns its own (data, stage) mesh '
+                'today); pass pp=None')
+        if tp < 1:
+            raise ValueError('tp must be >= 1, got %d' % tp)
+        devices = mesh_utility.sorted_devices(devices)
+        n = len(devices)
+        eff = mesh_utility.divisor_leq(n, tp)
+        arr = np.asarray(  # noqa: shardlint - eager driver-level
+            devices, dtype=object).reshape(n // eff, eff)
+        data_name, model_name = axis_names
+        return cls(Mesh(arr, (data_name, model_name)),
+                   data_axes=(data_name,), model_axis=model_name,
+                   requested_tp=tp)
+
+    # -- topology ------------------------------------------------------
+    @property
+    def size(self):
+        return self.mesh.size
+
+    @property
+    def data_size(self):
+        out = 1
+        for ax in self.data_axes:
+            out *= self.mesh.shape[ax]
+        return out
+
+    @property
+    def model_size(self):
+        return self.mesh.shape[self.model_axis]
+
+    @property
+    def axis_names(self):
+        return tuple(self.mesh.axis_names)
+
+    def describe(self):
+        """Provenance dict for bench rows / checkpoint manifests."""
+        return {'axes': {k: int(v) for k, v in self.mesh.shape.items()},
+                'data_axes': list(self.data_axes),
+                'model_axis': self.model_axis,
+                'requested_tp': self.requested_tp,
+                'effective_tp': int(self.model_size)}
+
+    # -- spec handout --------------------------------------------------
+    def batch_spec(self, axis=0):
+        """Batch spec: the leading (or ``axis``-th) dim sharded over
+        the DATA axes only -- every model rank of a data replica sees
+        the same per-replica batch."""
+        return P(*([None] * axis + [self.data_axes]))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    def sharding(self, spec):
+        return NamedSharding(self.mesh, spec)
+
+    def batch_sharding(self, axis=0):
+        return self.sharding(self.batch_spec(axis))
+
+    def param_shardings(self, specs):
+        """``NamedSharding`` tree from a ``PartitionSpec`` tree (e.g.
+        :func:`chainermn_tpu.models.tp_param_specs`)."""
+        return jax.tree_util.tree_map(self.sharding, specs)
+
+    def state_specs(self, param_specs, params, state):
+        """Broadcast a param spec tree through an optax state.
+
+        Optimizer states embed param-STRUCTURED subtrees (adam's
+        mu/nu); every subtree whose structure matches ``params`` gets
+        ``param_specs`` verbatim, every other leaf (step counters,
+        loss-scale scalars) is replicated.  This is how the
+        tensor-parallel sharding of a weight follows its optimizer
+        moments without per-optimizer plumbing."""
+        return broadcast_specs_to_state(param_specs, params, state)
+
+    def local_shape(self, shape, spec):
+        """The per-device shape of a global ``shape`` under ``spec``
+        on this mesh (sharded dims divided by their axis sizes)."""
+        shape = list(shape)
+        for i, axes in enumerate(tuple(spec) + (None,) * (
+                len(shape) - len(tuple(spec)))):
+            if axes is None:
+                continue
+            axes = (axes,) if isinstance(axes, str) else tuple(axes)
+            for ax in axes:
+                k = self.mesh.shape[ax]
+                if shape[i] % k:
+                    raise ValueError(
+                        'dim %d of shape %r does not divide over axis '
+                        '%r (size %d)' % (i, tuple(shape), ax, k))
+                shape[i] //= k
+        return tuple(shape)
+
+    # -- updater threading ---------------------------------------------
+    def communicator(self, reduce_dtype=None):
+        """The updater-facing communicator for this plan (gradient
+        reduction / ZeRO over the data axes only)."""
+        return MeshPlanCommunicator(self, reduce_dtype=reduce_dtype)
+
+
+def broadcast_specs_to_state(param_specs, params, state):
+    """See :meth:`MeshPlan.state_specs` (module-level so the updater
+    can call it without holding a plan)."""
+    pstruct = jax.tree_util.tree_structure(params)
+
+    def matches(node):
+        try:
+            return jax.tree_util.tree_structure(node) == pstruct
+        except Exception:
+            return False
+
+    def one(node):
+        if matches(node):
+            return param_specs
+        return jax.tree_util.tree_map(lambda _: P(), node)
+
+    return jax.tree_util.tree_map(one, state, is_leaf=matches)
+
+
+class MeshPlanCommunicator(CommunicatorBase):
+    """Communicator adapter over a :class:`MeshPlan`.
+
+    The classic strategies span the whole ``(inter, intra)`` mesh;
+    this one scopes the DATA-parallel contract to the plan's ``data``
+    axes -- :meth:`allreduce_grad` pmeans over ``data`` only (a
+    tensor-parallel leaf is SHARDED over ``model``: its per-shard
+    gradients are already exact and must not be combined across the
+    axis), :meth:`shard_batch`/:meth:`batch_spec` shard the batch over
+    ``data`` only (model ranks of one replica see the same batch), the
+    in-trace :meth:`broadcast_data` syncs replicas along ``data``
+    while leaving model shards alone, and :attr:`size`/
+    :meth:`axis_rank` count DATA replicas -- which is what the
+    updater's batch-divisibility check and ZeRO-1 partitioning
+    consume ("partition along data only").  Metric/statistic
+    :meth:`allreduce` still spans the full mesh (post-psum losses are
+    replicated over ``model``, so the full-mesh mean equals the data
+    mean).  Eager helpers (``replicate``, object p2p, barriers)
+    inherit unchanged.
+    """
+
+    def __init__(self, plan, reduce_dtype=None):
+        self.plan = plan
+        super().__init__(mesh=plan.mesh, reduce_dtype=reduce_dtype)
+        # introspection hooks (shardlint SL001/SL010, updater ZeRO)
+        self.reduction_axes = plan.data_axes
+        self.data_axes = plan.data_axes
+
+    # -- topology ------------------------------------------------------
+    @property
+    def size(self):
+        """Number of DATA replicas (batch divisor, ZeRO partition
+        count) -- NOT the device count; that is ``mesh.size``."""
+        return self.plan.data_size
+
+    @property
+    def inter_size(self):
+        return self.plan.data_size
+
+    @property
+    def intra_size(self):
+        return self.plan.model_size
+
+    def axis_rank(self):
+        """This device's DATA-replica index (valid in-trace)."""
+        rank = 0
+        for ax in self.plan.data_axes:
+            rank = rank * self.mesh.shape[ax] + lax.axis_index(ax)
+        return rank
+
+    def model_rank(self):
+        return lax.axis_index(self.plan.model_axis)
+
+    # -- collectives ---------------------------------------------------
+    def _allreduce_impl(self, grads):
+        axes = self.plan.data_axes
+        return jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, axes), grads)
+
+    def allreduce(self, x, op='mean'):
+        axes = tuple(self.mesh.axis_names)
+        red = {'mean': lambda v: lax.pmean(v, axes),
+               'sum': lambda v: lax.psum(v, axes),
+               'max': lambda v: lax.pmax(v, axes),
+               'min': lambda v: lax.pmin(v, axes)}[op]
+        return jax.tree_util.tree_map(red, x)
+
+    def broadcast_data(self, params, root=0):
+        """Every DATA replica receives replica ``root``'s values;
+        model shards stay untouched (a full-mesh broadcast would
+        overwrite one model rank's shard with another's).  In-trace
+        only: eager placement of a plan-sharded tree goes through
+        ``plan.param_shardings`` + ``device_put`` instead."""
+        from chainermn_tpu.communicators.base import _is_tracing
+        import jax.numpy as jnp
+
+        if not _is_tracing(params):
+            raise NotImplementedError(
+                'eager broadcast_data is undefined for a plan-sharded '
+                'tree; place it with '
+                'plan.param_shardings(specs) / multihost_device_put')
+        if _telemetry._active is not None:
+            _telemetry.event(
+                '%s:broadcast_data' % type(self).__name__,
+                kind='collective_trace',
+                axes=list(self.plan.data_axes))
+        me = self.axis_rank()
+
+        def bcast(x):
+            sel = jnp.where(me == root, x, jnp.zeros_like(x))
+            return lax.psum(sel, self.plan.data_axes).astype(x.dtype)
+
+        return jax.tree_util.tree_map(bcast, params)
+
+    # -- driver-level helpers ------------------------------------------
+    def shard_batch(self, tree, axis=0):
+        from chainermn_tpu.training.placement import multihost_device_put
+        sharding = NamedSharding(self.mesh, self.batch_spec(axis))
+        with _telemetry.span('shard_batch', kind='h2d',
+                             axes=list(self.plan.data_axes)):
+            return multihost_device_put(tree, sharding)
+
+    def batch_spec(self, axis=0):
+        return self.plan.batch_spec(axis)
+
+    def __repr__(self):
+        return 'MeshPlanCommunicator(%s)' % (
+            ', '.join('%s=%d' % (k, v)
+                      for k, v in self.mesh.shape.items()))

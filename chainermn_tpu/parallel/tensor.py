@@ -10,9 +10,84 @@ reference parity requirement but the natural extension of its sharded
 design.)
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+
+# ---------------------------------------------------------------------
+# Megatron conjugate pair (Shoeybi et al.'s f/g operators).
+#
+# The updaters differentiate INSIDE shard_map with check_vma=False,
+# where jax transposes ``psum`` to ``psum``: a cotangent that is
+# already replicated over the model axis gets multiplied by the axis
+# size at every reduction it crosses (measured, not theoretical --
+# the naive block's grads come out exactly tp x too large).  The
+# correct transposes for the "loss replicated over the model axis"
+# convention are the conjugates below: the region EXIT reduces
+# forward and passes cotangents through untouched (every rank already
+# holds the full replicated cotangent), and the region ENTRY is the
+# identity forward but psums cotangents backward (each rank's
+# backward contributes only its own weight shard's term of dL/dx).
+# Differentiating OUTSIDE shard_map hits the same custom rules, so
+# both supported autodiff placements agree.
+
+def _tp_mark(name, axis):
+    """Trace-time collective-issue mark (fires per compilation): the
+    model-axis twin of the strategies' allreduce_grad mark, so the
+    telemetry report can split dp vs tp collective issues."""
+    from chainermn_tpu import telemetry as _telemetry
+    if _telemetry._active is not None:
+        _telemetry.event(name, kind='collective_trace',
+                         axes=[axis] if isinstance(axis, str)
+                         else list(axis))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_reduce(x, axis):
+    """Megatron ``g``: exit a tensor-parallel region.  Forward is
+    ``psum`` over ``axis`` (completes the sharded contraction);
+    backward is the identity -- the downstream cotangent is already
+    replicated over ``axis``, and a psum transpose would scale it by
+    the axis size."""
+    _tp_mark('tensor:tp_reduce', axis)
+    return lax.psum(x, axis)
+
+
+def _tp_reduce_fwd(x, axis):
+    _tp_mark('tensor:tp_reduce', axis)
+    return lax.psum(x, axis), None
+
+
+def _tp_reduce_bwd(axis, _res, ct):
+    return (ct,)
+
+
+tp_reduce.defvjp(_tp_reduce_fwd, _tp_reduce_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def tp_copy(x, axis):
+    """Megatron ``f``: enter a tensor-parallel region with a
+    replicated activation.  Forward is the identity; backward psums
+    the cotangents over ``axis`` -- each rank's backward computes only
+    its own weight shard's contribution to dL/dx, and the residual
+    stream (and every parameter upstream, layer norms included) needs
+    their sum."""
+    return x
+
+
+def _tp_copy_fwd(x, axis):
+    return x, None
+
+
+def _tp_copy_bwd(axis, _res, ct):
+    return (lax.psum(ct, axis),)
+
+
+tp_copy.defvjp(_tp_copy_fwd, _tp_copy_bwd)
 
 
 def column_parallel_dense(x, w, b=None):
@@ -24,12 +99,20 @@ def column_parallel_dense(x, w, b=None):
     return y
 
 
-def row_parallel_dense(x_local, w, axis, b=None):
+def row_parallel_dense(x_local, w, axis, b=None,
+                       grad_conjugate=False):
     """``y = psum_axis(x_local @ w_local)`` -- w sharded on rows (input
     dim), input arrives feature-sharded from a column-parallel layer;
-    the psum completes the logical matmul."""
+    the psum completes the logical matmul.
+
+    ``grad_conjugate=True`` exits through :func:`tp_reduce` (identity
+    backward) instead of a raw ``psum`` -- REQUIRED when the caller
+    differentiates this block inside ``shard_map`` with
+    ``check_vma=False`` (the updaters' mode), where the raw psum's
+    transpose scales cotangents by the axis size.  Pair it with
+    :func:`tp_copy` at the region entry."""
     y = jnp.einsum('...d,df->...f', x_local, w)
-    y = lax.psum(y, axis)
+    y = tp_reduce(y, axis) if grad_conjugate else lax.psum(y, axis)
     if b is not None:
         y = y + b  # bias applied once, after the reduction
     return y
@@ -45,14 +128,17 @@ def tp_mlp(x, w_in, b_in, w_out, b_out, axis, activation=jnp.tanh):
     return row_parallel_dense(h, w_out, axis, b_out)
 
 
-def qkv_attention(x, wqkv, causal=False, attn_fn=None):
+def qkv_attention(x, wqkv, causal=False, attn_fn=None, bqkv=None):
     """Shared attention core: fused QKV projection
-    (``wqkv``: (d_model, 3, heads, d_head)) -> attention -> heads
-    re-flattened, ``(B, T, heads * d_head)``.  Used with the full
-    head set by ``moe.moe_transformer_block`` (replicated weights)
-    and with the LOCAL head group by :func:`tp_attention`
-    (head-sharded weights)."""
+    (``wqkv``: (d_model, 3, heads, d_head), optional ``bqkv``:
+    (3, heads, d_head)) -> attention -> heads re-flattened,
+    ``(B, T, heads * d_head)``.  Used with the full head set by
+    ``moe.moe_transformer_block`` (replicated weights) and with the
+    LOCAL head group by :func:`tp_attention` and the tp transformer
+    (head-sharded weights and bias)."""
     qkv = jnp.einsum('btd,dchf->btchf', x, wqkv)  # c=3
+    if bqkv is not None:
+        qkv = qkv + bqkv  # sharded with the heads, added pre-psum
     if attn_fn is None:
         from chainermn_tpu import ops
         attn_fn = ops.flash_attention

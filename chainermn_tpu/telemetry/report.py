@@ -87,22 +87,52 @@ def overlap_from_intervals(collective, compute):
     }
 
 
+def span_axes_key(span):
+    """The mesh-axis tag of a collective span (``'data'``, ``'model'``,
+    ``'inter,intra'`` ...), from the ``axes`` attribute the
+    communicator layer records; ``'untagged'`` for spans that predate
+    the tagging."""
+    axes = span.get('axes')
+    if isinstance(axes, (list, tuple)) and axes:
+        return ','.join(str(a) for a in axes)
+    return 'untagged'
+
+
 def overlap_stats(spans):
     """Overlap statistics over merged telemetry spans, exposure
     judged per rank (a collective is hidden only by compute running
-    on the SAME rank)."""
+    on the SAME rank).  ``per_axis`` splits the same accounting by
+    the collective spans' mesh-axis tag, so a composed dp x tp run
+    shows WHICH axis's communication is exposed (the data-parallel
+    gradient reduction vs the tensor-parallel block psums)."""
     ranks = sorted({s.get('rank', 0) for s in spans})
     total = exposed = 0.0
+    per_axis = {}
     for rank in ranks:
-        coll = [(s['t0'], s['t1']) for s in spans
-                if s.get('rank', 0) == rank
-                and s.get('kind') in COLLECTIVE_KINDS]
         comp = [(s['t0'], s['t1']) for s in spans
                 if s.get('rank', 0) == rank
                 and s.get('kind') in COMPUTE_KINDS]
-        st = overlap_from_intervals(coll, comp)
+        merged = merge_intervals(comp)
+        coll_spans = [s for s in spans
+                      if s.get('rank', 0) == rank
+                      and s.get('kind') in COLLECTIVE_KINDS]
+        st = overlap_from_intervals(
+            [(s['t0'], s['t1']) for s in coll_spans], comp)
         total += st['total_collective_s']
         exposed += st['exposed_collective_s']
+        for s in coll_spans:
+            key = span_axes_key(s)
+            agg = per_axis.setdefault(
+                key, {'total_collective_s': 0.0,
+                      'exposed_collective_s': 0.0, 'spans': 0})
+            agg['spans'] += 1
+            agg['total_collective_s'] += max(s['t1'] - s['t0'], 0.0)
+            agg['exposed_collective_s'] += exposed_time(
+                (s['t0'], s['t1']), merged)
+    for agg in per_axis.values():
+        t, e = agg['total_collective_s'], agg['exposed_collective_s']
+        agg['overlap_fraction'] = (
+            None if t <= 0.0 else max(0.0, min(1.0, 1.0 - e / t)))
     return {
         'total_collective_s': total,
         'exposed_collective_s': exposed,
@@ -110,6 +140,7 @@ def overlap_stats(spans):
         'overlap_fraction': (None if total <= 0.0
                              else max(0.0, min(1.0,
                                                1.0 - exposed / total))),
+        'per_axis': per_axis,
     }
 
 
@@ -300,6 +331,15 @@ def render_text(report, max_steps=24):
             % (ov['overlap_fraction'], ov['total_collective_s'] * 1e3,
                ov['exposed_collective_s'] * 1e3,
                ov['hidden_collective_s'] * 1e3))
+        for key, agg in sorted((ov.get('per_axis') or {}).items()):
+            frac = agg.get('overlap_fraction')
+            lines.append(
+                '  axis %-12s %4d spans  %10.3f ms total  '
+                '%10.3f ms exposed  overlap %s'
+                % (key, agg['spans'],
+                   agg['total_collective_s'] * 1e3,
+                   agg['exposed_collective_s'] * 1e3,
+                   '-' if frac is None else '%.3f' % frac))
     if report['chaos_events']:
         lines.append('chaos events in timeline: %d (%s)'
                      % (len(report['chaos_events']),

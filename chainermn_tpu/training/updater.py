@@ -38,7 +38,7 @@ class StandardUpdater:
                  has_aux=False, donate=True, model_state=None, rng=None,
                  zero=False, accum_steps=1, zero_check=True,
                  zero_reduce_dtype=None, device_prefetch=0,
-                 policy=None):
+                 policy=None, param_specs=None, remat=False):
         """``model_state``: optional non-trainable collections (e.g.
         BatchNorm running stats).  When given, ``loss_fn`` must have
         the extended signature
@@ -111,6 +111,29 @@ class StandardUpdater:
         pmin, so no device can diverge), and adjusts the scale --
         metrics then carry ``loss_scale`` and ``grads_finite``.
         See ``docs/mixed_precision.md``.
+
+        ``param_specs`` (a ``PartitionSpec`` pytree over ``params``,
+        e.g. :func:`chainermn_tpu.models.tp_param_specs`): per-leaf
+        parameter sharding for composed-mesh training
+        (``docs/mesh_parallelism.md``) -- pair with a
+        :class:`chainermn_tpu.parallel.MeshPlan` communicator
+        (``plan.communicator()``).  Params and optimizer state are
+        PLACED with the specs (optimizer moments inherit their
+        weight's spec via structure matching), the jitted step maps
+        them with the same in/out specs (donation aliases shard to
+        shard, policy casts run on the local shards), gradient
+        reduction and the batch shard span the communicator's
+        ``data_axes`` only, and the loss runs inside ``shard_map``
+        with the plan's axes bound -- a ``tp_axis`` model's
+        collectives just work.  ``zero=True`` composes with
+        REPLICATED specs (the partitioning then spans the data axes
+        only); ZeRO of a model-SHARDED leaf is not implemented.
+
+        ``remat=True`` wraps the differentiated loss in
+        ``jax.checkpoint``: the backward recomputes the forward
+        instead of holding its activations -- the PERF.md knob #6
+        memory lever, paired with ``donate=True`` by
+        ``bench.py --donate``.
         """
         _telemetry.maybe_enable_from_env()
         self.iterator = iterator
@@ -151,16 +174,43 @@ class StandardUpdater:
                 comm.reduce_dtype = policy.reduce_dtype
         from chainermn_tpu.training.placement import owned_device_put
 
+        # data-parallel axes: the whole mesh for classic strategies,
+        # the plan's `data` axes for a MeshPlan communicator -- batch
+        # sharding, gradient reduction and ZeRO partitioning all key
+        # off this (docs/mesh_parallelism.md)
+        from chainermn_tpu.communicators.mesh_utility import AXES
+        self._data_axes = tuple(getattr(comm, 'data_axes', AXES))
+        self._param_specs = param_specs
+        self._remat = bool(remat)
+        sharded_params = param_specs is not None and any(
+            tuple(s) for s in jax.tree_util.tree_leaves(
+                param_specs,
+                is_leaf=lambda x: isinstance(x, P)))
+
         # replicate + donation-aliasing guard in one placement: copies
         # exactly the would-alias leaves (see placement.py)
         _repl = NamedSharding(comm.mesh, P())
-        self.params = owned_device_put(params, _repl, donate)
+        if param_specs is None:
+            param_shardings = _repl
+        else:
+            param_shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(comm.mesh, spec),
+                param_specs)
+        self.params = owned_device_put(params, param_shardings, donate)
         self.model_state = (owned_device_put(model_state, _repl, donate)
                             if self._has_state else None)
         if zero:
             from chainermn_tpu.multi_node_optimizer import (
                 MultiNodeOptimizerState)
             from chainermn_tpu.parallel import zero as zero_mod
+            if sharded_params:
+                raise NotImplementedError(
+                    'zero=True with model-sharded param_specs is not '
+                    'implemented: the ZeRO stacked-state layout has '
+                    'no host-level representation for leaves that '
+                    'also vary over the model axis.  Under a '
+                    'MeshPlan, ZeRO partitions along the data axes '
+                    'of a REPLICATED parameter tree only.')
             local_state = optimizer.init(
                 zero_mod.shard_templates(params, comm.size))
             if isinstance(local_state, MultiNodeOptimizerState):
@@ -169,8 +219,8 @@ class StandardUpdater:
                     'multi-node wrapper (broadcast-first is built in)')
             if zero_check:
                 zero_mod.check_elementwise(optimizer)
-            from chainermn_tpu.communicators.mesh_utility import AXES
-            self._zero_specs = zero_mod.state_specs(local_state, AXES)
+            self._zero_specs = zero_mod.state_specs(local_state,
+                                                    self._data_axes)
             stacked = zero_mod.expand_state(local_state, comm.size)
             shardings = jax.tree_util.tree_map(
                 lambda spec: NamedSharding(comm.mesh, spec),
@@ -181,9 +231,22 @@ class StandardUpdater:
             self.opt_state = owned_device_put(stacked, shardings,
                                               donate, protect=params)
         else:
-            self.opt_state = owned_device_put(optimizer.init(params),
-                                              _repl, donate,
-                                              protect=params)
+            opt_state = optimizer.init(params)
+            if param_specs is None:
+                self._opt_specs = P()
+                opt_shardings = _repl
+            else:
+                # optimizer moments inherit their weight's spec
+                # (structure matching; see meshplan.state_specs)
+                from chainermn_tpu.parallel.meshplan import (
+                    broadcast_specs_to_state)
+                self._opt_specs = broadcast_specs_to_state(
+                    param_specs, params, opt_state)
+                opt_shardings = jax.tree_util.tree_map(
+                    lambda spec: NamedSharding(comm.mesh, spec),
+                    self._opt_specs)
+            self.opt_state = owned_device_put(opt_state, opt_shardings,
+                                              donate, protect=params)
         self.iteration = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.scale_state = (comm.replicate(self._loss_scale.init())
@@ -203,18 +266,18 @@ class StandardUpdater:
         has_aux = self._has_aux
 
         from chainermn_tpu import precision as precision_mod
-        from chainermn_tpu.communicators.mesh_utility import AXES
         has_state = self._has_state
         is_zero = self._zero
         policy = self._policy
         loss_scale = self._loss_scale
+        remat = self._remat
         reduce_dtype = self._zero_reduce_dtype
         if policy is not None and policy.reduce_dtype is not None:
             # the policy subsumes zero_reduce_dtype (enforced in
             # __init__); the non-zero path narrows inside the
             # communicator's allreduce_grad instead
             reduce_dtype = policy.reduce_dtype
-        axes = AXES
+        axes = self._data_axes
 
         accum = self._accum_steps
 
@@ -237,6 +300,10 @@ class StandardUpdater:
                     sloss = (loss * scale.astype(loss.dtype)
                              if scale is not None else loss)
                     return sloss, (dict(metrics, loss=loss), new_state)
+                if remat:
+                    # backward recomputes the forward instead of
+                    # holding its activations (PERF.md knob #6)
+                    wrapped = jax.checkpoint(wrapped)
                 (_, (metrics, new_state)), grads = jax.value_and_grad(
                     wrapped, has_aux=True)(params)
                 if policy is not None:
@@ -257,6 +324,8 @@ class StandardUpdater:
                     sloss = (loss * scale.astype(loss.dtype)
                              if scale is not None else loss)
                     return sloss, dict(metrics, loss=loss)
+                if remat:
+                    wrapped = jax.checkpoint(wrapped)
                 (_, metrics), grads = jax.value_and_grad(
                     wrapped, has_aux=True)(params)
                 new_state = model_state
@@ -444,11 +513,15 @@ class StandardUpdater:
                 return step_core(params, model_state, opt_state, rng,
                                  None, *batch)
 
-        opt_specs = self._zero_specs if is_zero else P()
-        lead_specs = ((P(), P(), opt_specs, P())
+        opt_specs = self._zero_specs if is_zero else self._opt_specs
+        # per-leaf param specs under a MeshPlan (P() replicated
+        # otherwise); in == out so donated shards alias shard to shard
+        pspecs = (self._param_specs if self._param_specs is not None
+                  else P())
+        lead_specs = ((pspecs, P(), opt_specs, P())
                       + ((P(),) if scaled else ())
                       + ((P(),) if is_zero else ()))
-        out_specs = ((P(), P(), opt_specs)
+        out_specs = ((pspecs, P(), opt_specs)
                      + ((P(),) if scaled else ()) + (P(),))
         n_lead = len(lead_specs)
 

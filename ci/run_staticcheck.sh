@@ -70,6 +70,27 @@ print('SL009 OK: bucketed_overlap clean, fused mlp flagged (%d '
 " "$1"
 }
 
+# SL010-family gate (docs/mesh_parallelism.md): the composed dp x tp
+# transformer_tp step must be IN the sweep and lint clean under the
+# multi-axis rules (SL010 plan-axis discipline, SL011 cross-axis
+# chains, SL012 tp-aware donation) -- the known-bad shapes are pinned
+# by fixtures in tests/test_analysis.py; this check pins the clean
+# state in BOTH precision sweeps.
+check_sl010() {
+  python -c "
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert 'step:transformer_tp' in report['targets'], report['targets']
+tp = [f for f in report['findings']
+      if f['target'] == 'step:transformer_tp'
+      and f['rule'] in ('SL010', 'SL011', 'SL012')]
+assert not tp, (
+    'transformer_tp must lint clean under the SL010 family: %r' % tp)
+print('SL010 OK: transformer_tp swept and clean under the '
+      'multi-axis rules')
+" "$1"
+}
+
 out_f32=$(mktemp)
 out_bf16=$(mktemp)
 trap 'rm -f "$out_f32" "$out_bf16"' EXIT
@@ -77,6 +98,8 @@ trap 'rm -f "$out_f32" "$out_bf16"' EXIT
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json | tee "$out_f32"
 check_memtraffic "$out_f32"
 check_sl009 "$out_f32"
+check_sl010 "$out_f32"
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16 | tee "$out_bf16"
 check_memtraffic "$out_bf16"
 check_sl009 "$out_bf16"
+check_sl010 "$out_bf16"

@@ -224,6 +224,11 @@ run bench_resnet50_bf16 $QT python bench.py --quick --policy bf16
 # carry fused_norm/hbm_bytes_per_image/pct_of_hbm_peak, so the A/B
 # against bench_resnet50_bf16 is self-describing in the artifacts
 run bench_resnet50_fused $QT python bench.py --quick --policy bf16 --fused-norm
+# donation + remat headline arm (PERF.md knob #6): the default rows
+# replay with donate=False, which understates real training -- this
+# row measures with buffers donated into the step and the backward
+# rematerializing the forward (rows carry donate/remat)
+run bench_resnet50_donate $QT python bench.py --quick --donate
 
 # end-of-sweep headline rerun: a PLAIN bench.py invocation adopts the
 # sweep winner just banked above (bench.py:adopt_tuned_config), so the
@@ -259,15 +264,24 @@ else
   run_with pred_best_row bench_resnet50_best 3900 python bench.py
 fi
 
+# composed dp x tp transformer (docs/mesh_parallelism.md), queued
+# right after the resnet sweep: rows carry tokens/s/chip, analytic
+# MFU vs the PERF.md 90-115k tok/s/chip anchor, and per-axis
+# collective bytes (data vs model wire traffic)
+run bench_transformer_tp $QT python bench.py --model transformer --quick --tp 2
+
 # --- tier 4: the remaining BASELINE workloads ------------------------
-# moderate compiles first; the two tunnel-killers LAST, with a
-# smaller-batch vgg16 attempt (smaller program) before the standard
-# one so SOME vgg16 datum banks even if the full config kills the
-# compile service again (per_device_batch_override is recorded in
-# the row, so the config is honest)
+# seq2seq FIRST: it is the variable-shape allreduce configuration
+# (VERDICT #4) -- the datum no other workload stands in for -- and
+# must not starve behind the transformer pair when a window closes
+# mid-tier.  Then the two tunnel-killers LAST, with a smaller-batch
+# vgg16 attempt (smaller program) before the standard one so SOME
+# vgg16 datum banks even if the full config kills the compile
+# service again (per_device_batch_override is recorded in the row,
+# so the config is honest)
+run bench_seq2seq $QT python bench.py --model seq2seq --quick
 run bench_transformer $QT python bench.py --model transformer --quick
 run bench_transformer_check $QT python bench.py --model transformer --quick --check
-run bench_seq2seq $QT python bench.py --model seq2seq --quick
 
 # flash-attention kernel vs XLA attention + block-size sweep
 run_with pred_wrote flash_attn 3000 \

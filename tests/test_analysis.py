@@ -372,7 +372,7 @@ def test_resnet50_fused_step_lints_fully_clean():
 def test_rule_catalogue_is_complete():
     assert sorted(rules_mod.RULES) == [
         'SL001', 'SL002', 'SL003', 'SL004', 'SL005', 'SL006', 'SL007',
-        'SL008', 'SL009']
+        'SL008', 'SL009', 'SL010', 'SL011', 'SL012']
 
 
 def test_report_json_roundtrip():
@@ -613,3 +613,161 @@ def test_memtraffic_mlp_step_in_report_json():
     assert row['bytes_per_item'] > 0
     # and the human rendering mentions it
     assert 'memtraffic step:mlp_example' in report.render_text()
+
+
+# --------------------------------------------------- SL010 family
+# Multi-axis (MeshPlan) rules: each fixture seeds one composed-mesh
+# violation on a plan-declaring target; the clean state is the real
+# step:transformer_tp target (swept below and by run_staticcheck.sh).
+
+def _plan_mesh(shape=(4, 2), names=('data', 'model')):
+    import numpy as np
+    from jax.sharding import Mesh
+    n = 1
+    for s in shape:
+        n *= s
+    devs = np.array(jax.devices()[:n]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def _plan_target(fn, args, mesh, plan_axes=('data', 'model'),
+                 in_specs=None, out_specs=None, donate=False,
+                 **kw):
+    from jax.sharding import PartitionSpec as P
+    mapped = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=in_specs if in_specs is not None else P(),
+        out_specs=out_specs if out_specs is not None else P(),
+        check_vma=False)
+    jitted = (jax.jit(mapped, donate_argnums=0) if donate
+              else jax.jit(mapped))
+    return analysis.lint_target(targets_mod.LintTarget(
+        'fixture', jitted, args, dict(mesh.shape),
+        plan_axes=plan_axes, **kw))
+
+
+def test_sl010_undeclared_axis_collective_fires():
+    # 3-axis mesh, 2-axis plan: a psum over the off-plan 'extra'
+    # axis traces fine (the mesh binds it) but leaks outside the
+    # declared topology
+    mesh = _plan_mesh((2, 2, 2), ('data', 'model', 'extra'))
+
+    def bad(x):
+        return (lax.psum(x, 'extra')
+                + lax.psum(x, 'data') + lax.psum(x, 'model'))
+
+    fs = _plan_target(bad, (jnp.zeros((4,)),), mesh)
+    sl10 = [f for f in fs if f.rule_id == 'SL010']
+    assert sl10 and any('outside the declared plan' in f.message
+                        for f in sl10), fs
+
+
+def test_sl010_dead_axis_fires():
+    # the plan declares (data, model) but the step only ever reduces
+    # over data: the size-2 model axis shards weights without any
+    # combining collective
+    mesh = _plan_mesh()
+    fs = _plan_target(lambda x: lax.psum(x, 'data'),
+                      (jnp.zeros((4,)),), mesh)
+    sl10 = [f for f in fs if f.rule_id == 'SL010']
+    assert sl10 and any('never touched' in f.message for f in sl10), fs
+
+
+def test_sl010_good_covered_plan_is_silent():
+    mesh = _plan_mesh()
+
+    def good(x):
+        return lax.psum(lax.pmean(x * 2.0, 'model') * x, 'data')
+
+    fs = _plan_target(good, (jnp.zeros((4,)),), mesh)
+    assert not [f for f in fs if f.rule_id == 'SL010'], fs
+
+
+def test_sl011_cross_axis_chain_fires():
+    # psum over model feeding DIRECTLY into psum over data: one
+    # psum(('data','model')) would move the same bytes once
+    mesh = _plan_mesh()
+
+    def bad(x):
+        return lax.psum(lax.psum(x, 'model'), 'data')
+
+    fs = _plan_target(bad, (jnp.zeros((4,)),), mesh)
+    assert [f for f in fs if f.rule_id == 'SL011'], fs
+    # and SL003 does NOT claim it (disjoint axes are this rule's)
+    assert not [f for f in fs if f.rule_id == 'SL003'], fs
+
+
+def test_sl011_good_fused_multi_axis_reduce_is_silent():
+    mesh = _plan_mesh()
+    fs = _plan_target(lambda x: lax.psum(x, ('data', 'model')),
+                      (jnp.zeros((4,)),), mesh)
+    assert not [f for f in fs if f.rule_id == 'SL011'], fs
+
+
+def test_sl011_compute_between_reduces_is_silent():
+    mesh = _plan_mesh()
+
+    def ok(x):
+        return lax.psum(jnp.tanh(lax.psum(x, 'model')), 'data')
+
+    fs = _plan_target(ok, (jnp.zeros((4,)),), mesh)
+    assert not [f for f in fs if f.rule_id == 'SL011'], fs
+
+
+def test_sl012_resharded_donation_fires():
+    # donated model-sharded input; the only shape-matched output is
+    # the GATHERED (replicated) tree -- XLA cannot alias across the
+    # reshard, so the donation frees nothing.  data axis size 1 so
+    # SL010's dead-axis check stays out of frame.
+    from jax.sharding import PartitionSpec as P
+    mesh = _plan_mesh((1, 2))
+
+    def bad(x):
+        return lax.all_gather(x, 'model', tiled=True) * 1.0
+
+    fs = _plan_target(bad, (jnp.zeros((8,), jnp.float32),), mesh,
+                      in_specs=P('model'), out_specs=P(),
+                      donate=True)
+    assert [f for f in fs if f.rule_id == 'SL012'], fs
+
+
+def test_sl012_same_sharding_donation_is_silent():
+    from jax.sharding import PartitionSpec as P
+    mesh = _plan_mesh((1, 2))
+
+    def good(x):
+        # output keeps the input's sharding (aliasable); the scalar
+        # psum covers the model axis for SL010
+        return x * 2.0, lax.psum(x.sum(), 'model')
+
+    fs = _plan_target(good, (jnp.zeros((8,), jnp.float32),), mesh,
+                      in_specs=P('model'),
+                      out_specs=(P('model'), P()), donate=True)
+    assert not [f for f in fs if f.rule_id == 'SL012'], fs
+
+
+def test_sl010_family_silent_without_plan_axes():
+    # the hierarchical-style staged reduction is DELIBERATE on
+    # single-axis strategies: without a declared plan the family
+    # stays out of the way
+    mesh = _plan_mesh()
+
+    def staged(x):
+        return lax.psum(lax.psum(x, 'model'), 'data')
+
+    fs = _plan_target(staged, (jnp.zeros((4,)),), mesh,
+                      plan_axes=None)
+    assert not [f for f in fs
+                if f.rule_id in ('SL010', 'SL011', 'SL012')], fs
+
+
+def test_transformer_tp_target_lints_clean_both_precisions():
+    # the real composed dp x tp step is the SL010-family clean state
+    # (and SL001..SL009 clean too) in BOTH precision sweeps
+    from chainermn_tpu.precision import Policy
+
+    for policy in (None, Policy.bf16()):
+        target = targets_mod.transformer_tp_step_target(policy=policy)
+        assert target.plan_axes == ('data', 'model')
+        fs = analysis.lint_target(target)
+        assert fs == [], (policy, fs)

@@ -811,3 +811,77 @@ def test_trace_report_no_dirs_writes_explanatory_stub(tmp_path,
     assert len(rows) == 1
     assert rows[0]['error'] == 'no trace dirs found'
     assert 'superseded' in rows[0]['detail']
+
+
+def test_donating_scan_maker_replays_from_fresh_buffers():
+    # bench --donate measures with buffers donated at the outer jit
+    # boundary; donation consumes them, so every timed call must
+    # re-place fresh copies and reproduce the SAME loss trajectory
+    # (a second call reading donated garbage would diverge or crash)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import bench
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    comm = chainermn_tpu.create_communicator('xla')
+    model = MLP(n_units=8, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))['params']
+    loss = classifier_loss(lambda p, x: model.apply({'params': p}, x))
+    upd = training.StandardUpdater(
+        iter([]), chainermn_tpu.create_multi_node_optimizer(
+            optax.adam(1e-3), comm),
+        loss, params, comm, has_aux=True, donate=True, remat=True)
+    rng = np.random.RandomState(0)
+    batch = [(rng.rand(784).astype(np.float32), np.int32(i % 10))
+             for i in range(8)]
+    arrays = upd.shard_batch(batch)
+    make = bench._donating_scan_maker(upd, arrays)
+    call = make(3)
+    first = np.asarray(call())
+    second = np.asarray(call())
+    assert first.shape == (3,)
+    np.testing.assert_allclose(first, second, rtol=1e-6)
+    assert np.all(np.isfinite(first))
+
+
+def test_pick_tuned_records_window_and_device_identity():
+    # ISSUE 7 satellite (ADVICE r5 residual): a winner crowned across
+    # two chip windows (round tags) or two device kinds must say so
+    # in the comparison provenance
+    from bench import _pick_tuned
+
+    same = [
+        _rs_row(2588.0, _source='bench_resnet50_r5.out',
+                device_kind='TPU v5 lite'),
+        _rs_row(4100.0, override=128,
+                _source='bench_resnet50_b128_r5.out',
+                device_kind='TPU v5 lite'),
+    ]
+    d = _pick_tuned(same)
+    assert d['winner_round_tag'] == 'r5'
+    assert d['incumbent_round_tag'] == 'r5'
+    assert d['cross_window'] is False
+
+    cross = [
+        _rs_row(2588.0, _source='bench_resnet50_r4.out',
+                device_kind='TPU v5 lite'),
+        _rs_row(4100.0, override=128,
+                _source='bench_resnet50_b128_r6.out',
+                device_kind='TPU v6 lite'),
+    ]
+    d = _pick_tuned(cross)
+    assert (d['winner_round_tag'], d['incumbent_round_tag']) == \
+        ('r6', 'r4')
+    assert d['cross_window'] is True
+
+    # rows without artifact names (direct API use) stay well-defined
+    bare = [_rs_row(2588.0), _rs_row(4100.0, override=128)]
+    d = _pick_tuned(bare)
+    assert d['winner_round_tag'] is None
+    assert d['cross_window'] is False

@@ -487,3 +487,27 @@ def test_disabled_overhead_under_2pct_on_mlp_step():
         'on %.3f ms): the disabled-by-default path is bounded by '
         'this and must stay unmeasurable'
         % (overhead * 100, min(t_off) * 1e3, min(t_on) * 1e3))
+
+
+def test_overlap_stats_splits_per_axis():
+    # ISSUE 7 satellite: collective spans carry the mesh axis name,
+    # so the overlap column splits dp vs tp communication.  One
+    # 'data' span fully hidden behind compute, one 'model' span fully
+    # exposed; the aggregate blends them, the per-axis split does not.
+    from chainermn_tpu.telemetry.report import overlap_stats
+
+    spans = [
+        {'kind': 'compute', 't0': 0.0, 't1': 1.0, 'rank': 0},
+        {'kind': 'collective', 't0': 0.2, 't1': 0.4, 'rank': 0,
+         'axes': ['data']},
+        {'kind': 'collective', 't0': 2.0, 't1': 2.4, 'rank': 0,
+         'axes': ['model']},
+        {'kind': 'collective', 't0': 3.0, 't1': 3.1, 'rank': 0},
+    ]
+    st = overlap_stats(spans)
+    per = st['per_axis']
+    assert per['data']['overlap_fraction'] == 1.0
+    assert per['model']['overlap_fraction'] == 0.0
+    assert abs(per['model']['exposed_collective_s'] - 0.4) < 1e-9
+    assert 'untagged' in per  # pre-tagging spans stay visible
+    assert 0.0 < st['overlap_fraction'] < 1.0

@@ -111,3 +111,55 @@ def test_build_mesh_uses_slice_topology():
     devs = mesh_utility.sorted_devices(jax.devices())
     mesh = mesh_utility.build_mesh(devs)
     assert mesh.devices.size == len(devs)
+
+
+# ------------------------------------------------------------------
+# Degenerate shapes (ISSUE 7 satellite): MeshPlan leans on
+# mesh_utility's factorization helpers, so the SNIPPETS [2]
+# graceful-degradation contract is pinned HERE, at the topology
+# layer: non-factorable counts collapse sanely, one device always
+# builds, and axis NAMES never change with the shape.
+
+def test_balanced_2d_non_factorable_counts():
+    assert mesh_utility.balanced_2d(7) == (7, 1)   # prime
+    assert mesh_utility.balanced_2d(1) == (1, 1)
+    assert mesh_utility.balanced_2d(6) == (3, 2)
+    assert mesh_utility.balanced_2d(8) == (4, 2)
+
+
+def test_divisor_leq_degenerate():
+    assert mesh_utility.divisor_leq(1, 1) == 1
+    assert mesh_utility.divisor_leq(1, 8) == 1
+    assert mesh_utility.divisor_leq(7, 7) == 7
+    assert mesh_utility.divisor_leq(7, 6) == 1
+    assert mesh_utility.divisor_leq(12, 5) == 4
+
+
+def test_single_device_builds_1x1_mesh_with_stable_axis_names():
+    devs = [FakeDev(id=0, process_index=0)]
+    assert mesh_utility.detect_topology(devs) == (1, 1)
+    mesh = mesh_utility.build_mesh(devs)
+    assert dict(mesh.shape) == {'inter': 1, 'intra': 1}
+    assert mesh.axis_names == mesh_utility.AXES
+
+
+def test_axis_names_stable_across_shapes():
+    # (1, n), (n, 1) and square meshes all bind the SAME axis names:
+    # programs written against ('inter', 'intra') run unchanged on
+    # every degradation (the same contract MeshPlan keeps for
+    # ('data', 'model'))
+    for shape in ((1, 8), (8, 1), (2, 4)):
+        devs = make_devices(shape[0], 1, shape[1], with_slice=True)
+        mesh = mesh_utility.build_mesh(devs, mesh_shape=shape)
+        assert mesh.axis_names == mesh_utility.AXES
+        assert dict(mesh.shape) == {'inter': shape[0],
+                                    'intra': shape[1]}
+
+
+def test_meshplan_axis_names_stable_across_degradations():
+    from chainermn_tpu.parallel.meshplan import MeshPlan
+    import jax
+    for tp in (1, 2, jax.device_count(), jax.device_count() * 2):
+        plan = MeshPlan.create(tp=tp)
+        assert plan.axis_names == ('data', 'model')
+        assert plan.size == jax.device_count()
