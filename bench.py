@@ -919,6 +919,30 @@ def phase_stats(cfg, quick, trace_steps=3):
         out['phase_stats_error'] = 'overlap capture: %r' % e
     finally:
         shutil.rmtree(td, ignore_errors=True)
+    # cross-rank diagnosis fields (ISSUE 8): a short telemetry-
+    # recorded window through the doctor's skew engine.  Honest
+    # Nones on a single-controller bench -- collective pairing needs
+    # spans from >= 2 ranks (a multi-process capture run through
+    # `telemetry doctor` fills them for real); the fields exist on
+    # every row so outage-window and multihost rows stay comparable.
+    try:
+        from chainermn_tpu import telemetry
+        from chainermn_tpu.telemetry import diagnosis
+        was_active = telemetry.active()
+        rec = was_active or telemetry.enable()  # in-memory recorder
+        n0 = len(rec.events)
+        for _ in range(2):
+            metrics = upd.update_core(arrays)
+        jax.block_until_ready(metrics)
+        spans = [dict(e, rank=e.get('rank', 0))
+                 for e in rec.events[n0:] if e.get('type') == 'span']
+        if was_active is None:
+            telemetry.disable()
+        out.update(diagnosis.skew_summary(spans))
+    except Exception as e:
+        out.setdefault('collective_skew_p99_ms', None)
+        out.setdefault('straggler_rank', None)
+        out.setdefault('phase_stats_error', 'skew capture: %r' % e)
     return out
 
 

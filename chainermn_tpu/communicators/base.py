@@ -298,7 +298,9 @@ class CommunicatorBase:
         if not _is_tracing(params):
             with _telemetry.span('broadcast_data', kind='collective',
                                  strategy=type(self).__name__,
-                                 axes=list(AXES)):
+                                 axes=list(AXES),
+                                 seq=self._next_eager_seq(
+                                     'broadcast_data')):
                 return self.replicate(params)
         if _telemetry._active is not None:
             _telemetry.event(
@@ -363,6 +365,21 @@ class CommunicatorBase:
     def batch_spec(self, axis=0):
         return P(*([None] * axis + [AXES]))
 
+    def _next_eager_seq(self, name, tag=None):
+        """Per-(name, tag) occurrence counter stamped as the ``seq``
+        attribute on eager collective spans.  Eager collectives are
+        bulk-synchronous in program order, so every participating
+        process counts the same rendezvous identically -- which is
+        what lets ``telemetry.diagnosis`` pair the spans ACROSS ranks
+        by (name, tag, seq) and attribute arrival skew.  One dict
+        get/set per eager rendezvous -- noise next to the
+        cross-process wait it annotates."""
+        seqs = self.__dict__.setdefault('_eager_coll_seq', {})
+        key = (name, tag)
+        n = seqs.get(key, 0)
+        seqs[key] = n + 1
+        return n
+
     # -- peer liveness (heartbeat-backed dead-peer detection) ----------
     def enable_peer_liveness(self, directory, interval=1.0,
                              stall_timeout=5.0):
@@ -391,6 +408,15 @@ class CommunicatorBase:
         self._liveness = {'dir': directory, 'timeout': stall_timeout,
                           'enabled_at': _time.monotonic()}
         self._heartbeat = hb
+        # hand the liveness dir off to the telemetry session: the
+        # post-mortem doctor pairs this capture's flight records with
+        # these heartbeat files to name the dead/stalled peer
+        rec = _telemetry.active()
+        if rec is not None:
+            rec.liveness_dir = _os.path.abspath(directory)
+            _telemetry.event('liveness_enabled', kind='liveness',
+                             dir=rec.liveness_dir, interval=interval,
+                             stall_timeout=stall_timeout)
         return hb
 
     def peer_state(self, process_index):
@@ -440,15 +466,16 @@ class CommunicatorBase:
         """
         if jax.process_count() == 1:
             return
-        with _telemetry.span('barrier', kind='collective', tag=tag,
-                             axes=list(self.mesh.axis_names)):
-            return self._barrier_impl(timeout, tag)
-
-    def _barrier_impl(self, timeout, tag):
-        from chainermn_tpu.utils import chaos, failure
-        client = self._kv_client()
         epochs = self.__dict__.setdefault('_barrier_epochs', {})
         n = epochs[tag] = epochs.get(tag, 0) + 1
+        with _telemetry.span('barrier', kind='collective', tag=tag,
+                             seq=n,
+                             axes=list(self.mesh.axis_names)):
+            return self._barrier_impl(timeout, tag, n)
+
+    def _barrier_impl(self, timeout, tag, n):
+        from chainermn_tpu.utils import chaos, failure
+        client = self._kv_client()
         bid = 'chainermn_tpu/barrier/%s/%s/%d' % (
             self._p2p_channel(), tag, n)
         deadline = failure.Deadline(timeout)
@@ -510,7 +537,9 @@ class CommunicatorBase:
             self.barrier(timeout=timeout, tag='allreduce_obj')
         from jax.experimental import multihost_utils
         with _telemetry.span('allreduce_obj', kind='collective',
-                             op=op, axes=list(self.mesh.axis_names)):
+                             op=op, axes=list(self.mesh.axis_names),
+                             seq=self._next_eager_seq(
+                                 'allreduce_obj')):
             vals = multihost_utils.process_allgather(value)
 
         def red(stack):

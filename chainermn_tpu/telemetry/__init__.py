@@ -15,7 +15,12 @@ logs, an aggregated ``metrics.json``, and a Prometheus text exporter;
 ``python -m chainermn_tpu.telemetry report`` merges per-rank logs
 into a step timeline and computes the **overlap fraction** (collective
 time hidden behind compute vs exposed) -- the dynamic twin of the
-static shardlint rule SL009.  See ``docs/observability.md``.
+static shardlint rule SL009 -- and ``... telemetry doctor`` runs the
+cross-rank diagnosis (:mod:`chainermn_tpu.telemetry.diagnosis`):
+collective skew attribution, straggler naming with the lagging
+phase, and the crash post-mortem from the crash-safe flight recorder
+(:func:`dump_flight` / ``flight-rank*.json``) merged with
+peer-liveness heartbeats.  See ``docs/observability.md``.
 
 Activation (exactly the chaos discipline -- zero cost when off)::
 
@@ -40,8 +45,8 @@ function call and return a preallocated no-op context.
 import os
 
 from chainermn_tpu.telemetry.recorder import (  # noqa: F401
-    Counter, Gauge, Histogram, NULL_SPAN, Recorder, Registry,
-    snapshot_to_prometheus)
+    Counter, FLIGHT_RING, Gauge, Histogram, NULL_SPAN, Recorder,
+    Registry, escape_help, escape_label_value, snapshot_to_prometheus)
 
 ENV_VAR = 'CHAINERMN_TPU_TELEMETRY'
 ENV_SYNC = 'CHAINERMN_TPU_TELEMETRY_SYNC'
@@ -133,3 +138,14 @@ def registry():
 def flush(outdir=None):
     rec = _active
     return rec.flush(outdir) if rec is not None else None
+
+
+def dump_flight(reason, **attrs):
+    """Write the crash-safe flight record (last-N-records ring, open
+    spans, last completed collective) for this rank -- see
+    :meth:`Recorder.dump_flight`.  No-op (None) when telemetry is
+    disabled or the session is in-memory; never raises."""
+    rec = _active
+    if rec is None:
+        return None
+    return rec.dump_flight(reason, **attrs)

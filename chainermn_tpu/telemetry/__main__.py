@@ -1,27 +1,38 @@
-"""``python -m chainermn_tpu.telemetry``: merge and report a
-telemetry capture.
+"""``python -m chainermn_tpu.telemetry``: merge, report and diagnose
+a telemetry capture.
 
 ``report DIR`` merges every rank's ``events-rank*.jsonl`` +
 ``metrics-rank*.json`` under ``DIR`` into one step timeline, prints
 it with the overlap fraction, and writes the merged artifacts
 (``merged_report.json``, aggregated ``metrics.json``,
-``metrics.prom``) back into ``DIR``.  Exit codes: 0 on a non-empty
-timeline, 2 when the directory holds no telemetry events (so CI
-smoke legs fail loudly on an accidentally-disabled capture), 1 on a
+``metrics.prom``) back into ``DIR``.
+
+``doctor DIR`` runs the cross-rank diagnosis
+(:mod:`chainermn_tpu.telemetry.diagnosis`): collective skew
+attribution + chronic-lateness scores, MAD-based straggler/anomaly
+flags, and the flight-record + heartbeat crash post-mortem (dead
+rank, its last completed collective seq, where survivors were
+blocked).  Writes ``doctor_report.json`` into ``DIR``.
+
+Exit codes (both subcommands): 0 on a non-empty capture, 2 when the
+directory holds no telemetry at all (CI smoke legs fail loudly on an
+accidentally-disabled capture); ``report`` additionally exits 1 on a
 malformed Prometheus export (never expected; guards the exporter).
+A missing or unknown subcommand prints usage and exits 2 -- CI
+misuse must never look like success.
 """
 
 import argparse
 import sys
 
 
-def main(argv=None):
+def _build_parser():
     parser = argparse.ArgumentParser(
         prog='python -m chainermn_tpu.telemetry',
         description='merge per-rank telemetry logs into a step '
                     'timeline with overlap fraction and metrics '
-                    'exports')
-    sub = parser.add_subparsers(dest='cmd', required=True)
+                    'exports, or diagnose a multi-rank capture')
+    sub = parser.add_subparsers(dest='cmd')
     rep = sub.add_parser('report', help='merge + report one session '
                                         'directory')
     rep.add_argument('outdir', help='telemetry session directory '
@@ -30,14 +41,33 @@ def main(argv=None):
     rep.add_argument('--json', action='store_true',
                      help='print the merged report as JSON instead '
                           'of text')
-    rep.add_argument('--steps', type=int, default=24,
-                     help='max step-timeline rows to print')
+    rep.add_argument('--max-steps', '--steps', type=int, default=24,
+                     dest='max_steps', metavar='N',
+                     help='max step-timeline rows to print '
+                          '(default: %(default)s)')
     rep.add_argument('--no-export', action='store_true',
                      help='print only; do not write merged_report/'
                           'metrics.json/metrics.prom into the '
                           'session dir')
-    args = parser.parse_args(argv)
+    doc = sub.add_parser('doctor', help='cross-rank diagnosis: '
+                                        'collective skew, stragglers, '
+                                        'crash post-mortem')
+    doc.add_argument('outdir', help='telemetry session directory')
+    doc.add_argument('--json', action='store_true',
+                     help='print the diagnosis as JSON instead of '
+                          'text')
+    doc.add_argument('--liveness', action='append', default=[],
+                     metavar='DIR',
+                     help='extra heartbeat directory to consult '
+                          '(repeatable; liveness dirs recorded in '
+                          'the capture are found automatically)')
+    doc.add_argument('--no-export', action='store_true',
+                     help='print only; do not write '
+                          'doctor_report.json into the session dir')
+    return parser
 
+
+def _cmd_report(args):
     from chainermn_tpu.telemetry import report as report_mod
     from chainermn_tpu.telemetry.recorder import snapshot_to_prometheus
 
@@ -48,7 +78,8 @@ def main(argv=None):
         import json
         print(json.dumps(report, indent=1))
     else:
-        print(report_mod.render_text(report, max_steps=args.steps))
+        print(report_mod.render_text(report,
+                                     max_steps=args.max_steps))
     if report['n_spans'] + report['n_events'] == 0:
         print('telemetry: EMPTY capture under %s (was '
               'CHAINERMN_TPU_TELEMETRY set, and did the run flush?)'
@@ -61,6 +92,46 @@ def main(argv=None):
               file=sys.stderr)
         return 1
     return 0
+
+
+def _cmd_doctor(args):
+    from chainermn_tpu.telemetry import diagnosis
+
+    diag = diagnosis.diagnose(args.outdir,
+                              liveness_dirs=args.liveness)
+    if not args.no_export:
+        diagnosis.export(args.outdir, diag)
+    if args.json:
+        import json
+        print(json.dumps(diag, indent=1, default=repr))
+    else:
+        print(diagnosis.render_doctor_text(diag))
+    if (diag['n_spans'] + diag['n_events']
+            + diag['n_flight_records'] == 0):
+        print('telemetry doctor: EMPTY capture under %s (was '
+              'CHAINERMN_TPU_TELEMETRY set, and did the run flush?)'
+              % args.outdir, file=sys.stderr)
+        return 2
+    return 0
+
+
+def main(argv=None):
+    parser = _build_parser()
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as e:
+        # argparse already printed usage + error; normalize the exit
+        # status to a nonzero return so programmatic callers (and CI
+        # pipelines capturing $?) see failure, never a traceback
+        return e.code if e.code else 0
+    if args.cmd is None:
+        parser.print_usage(sys.stderr)
+        print('%s: error: a subcommand is required (report | doctor)'
+              % parser.prog, file=sys.stderr)
+        return 2
+    if args.cmd == 'report':
+        return _cmd_report(args)
+    return _cmd_doctor(args)
 
 
 if __name__ == '__main__':

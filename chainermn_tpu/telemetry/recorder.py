@@ -37,6 +37,7 @@ collation), ``h2d`` (host-to-device placement), ``checkpoint``,
 marks -- they fire once per compilation, not per step).
 """
 
+import collections
 import contextlib
 import json
 import os
@@ -51,6 +52,10 @@ MAX_SAMPLES = 65536
 #: event-log retention cap per rank (a week-long run with telemetry
 #: left on must not OOM the host; the newest window wins)
 MAX_EVENTS = 1 << 20
+#: flight-recorder ring size -- the last N records a crash dump
+#: preserves (`Recorder.dump_flight`); small on purpose: the flight
+#: record is the black box read AFTER a death, not the full log
+FLIGHT_RING = 256
 
 
 def _percentile(sorted_vals, q):
@@ -76,7 +81,10 @@ class Counter:
         self.value += n
 
     def snapshot(self):
-        return {'type': 'counter', 'value': self.value}
+        snap = {'type': 'counter', 'value': self.value}
+        if self.help:
+            snap['help'] = self.help
+        return snap
 
 
 class Gauge:
@@ -93,7 +101,10 @@ class Gauge:
         self.value = float(v)
 
     def snapshot(self):
-        return {'type': 'gauge', 'value': self.value}
+        snap = {'type': 'gauge', 'value': self.value}
+        if self.help:
+            snap['help'] = self.help
+        return snap
 
 
 class Histogram:
@@ -138,9 +149,12 @@ class Histogram:
         }
 
     def snapshot(self):
-        return {'type': 'histogram', 'count': self.count,
+        snap = {'type': 'histogram', 'count': self.count,
                 'sum': self.total, 'samples': list(self.samples),
                 'summary': self.summary()}
+        if self.help:
+            snap['help'] = self.help
+        return snap
 
 
 class Registry:
@@ -197,22 +211,58 @@ def _prom_name(prefix, name):
     return ''.join(out)
 
 
+def escape_label_value(value):
+    """Prometheus label-value escaping (text exposition 0.0.4):
+    backslash, double-quote and newline must be escaped or the scrape
+    silently truncates/mangles the sample."""
+    return (str(value).replace('\\', r'\\').replace('"', r'\"')
+            .replace('\n', r'\n'))
+
+
+def escape_help(text):
+    """``# HELP`` line escaping: backslash and newline only (quotes
+    are legal in help text)."""
+    return str(text).replace('\\', r'\\').replace('\n', r'\n')
+
+
+def _labels_text(labels):
+    if not labels:
+        return ''
+    return '{%s}' % ','.join(
+        '%s="%s"' % (k, escape_label_value(v))
+        for k, v in sorted(labels.items()))
+
+
 def snapshot_to_prometheus(snapshot, prefix='chainermn_tpu_'):
     """Render a (possibly merged) registry snapshot as Prometheus
     text.  Shared by the live registry and the offline aggregator in
-    :mod:`chainermn_tpu.telemetry.report`."""
+    :mod:`chainermn_tpu.telemetry.report`.
+
+    Emits ``# HELP`` (escaped) alongside ``# TYPE`` when the metric
+    carries help text, and escapes every label value (``\\``, ``"``,
+    newline) -- a snapshot's optional ``labels`` dict is rendered on
+    counter/gauge sample lines."""
     lines = []
     for name, snap in sorted(snapshot.items()):
         pname = _prom_name(prefix, name)
         kind = snap.get('type')
+        help_text = snap.get('help')
         if kind in ('counter', 'gauge'):
             v = snap.get('value')
             if v is None:
                 continue
+            if help_text:
+                lines.append('# HELP %s %s'
+                             % (pname, escape_help(help_text)))
             lines.append('# TYPE %s %s' % (pname, kind))
-            lines.append('%s %s' % (pname, repr(float(v))))
+            lines.append('%s%s %s' % (pname,
+                                      _labels_text(snap.get('labels')),
+                                      repr(float(v))))
         elif kind == 'histogram':
             summ = snap.get('summary') or {}
+            if help_text:
+                lines.append('# HELP %s %s'
+                             % (pname, escape_help(help_text)))
             lines.append('# TYPE %s summary' % pname)
             for q in ('p50', 'p90', 'p99'):
                 if summ.get(q) is not None:
@@ -278,7 +328,8 @@ class Recorder:
     """One process's telemetry session: spans, events, metrics, and
     the per-rank JSONL/JSON flush."""
 
-    def __init__(self, outdir=None, sync_fences=False):
+    def __init__(self, outdir=None, sync_fences=False,
+                 flight_ring=FLIGHT_RING):
         self.outdir = outdir
         self.sync_fences = bool(sync_fences)
         self.registry = Registry()
@@ -290,6 +341,22 @@ class Recorder:
         self._wall0 = time.time()
         self._flushed_upto = 0
         self._meta_written = False
+        # flight recorder: the last N records, cheap to maintain and
+        # small enough to dump atomically from a dying process
+        self._flight = collections.deque(maxlen=flight_ring)
+        # spans currently OPEN (entered, not yet exited) -- the dump
+        # includes them so "where was this rank blocked" is answerable
+        # even though unclosed spans never reach the event log
+        self._open_spans = {}
+        # newest closed collective span (and p2p separately) -- the
+        # "last completed collective seq" a post-mortem names
+        self._last_collective = None
+        self._last_p2p = None
+        #: liveness directory handed off by
+        #: ``CommunicatorBase.enable_peer_liveness`` so the doctor can
+        #: find the heartbeat files that pair with this capture
+        self.liveness_dir = None
+        self.flight_dumps = 0
 
     # -- clock ---------------------------------------------------------
     def now(self):
@@ -299,6 +366,12 @@ class Recorder:
     def _append(self, rec):
         with self._lock:
             self.events.append(rec)
+            self._flight.append(rec)
+            kind = rec.get('kind')
+            if kind == 'collective':
+                self._last_collective = rec
+            elif kind == 'p2p':
+                self._last_p2p = rec
             if len(self.events) > MAX_EVENTS:
                 # drop the oldest UNFLUSHED window is wrong -- flushed
                 # records are already on disk, so trim from the front
@@ -311,9 +384,18 @@ class Recorder:
     def span(self, name, kind='generic', **attrs):
         handle = _SpanHandle(self, attrs)
         t0 = self.now()
+        # `attrs` is the handle's LIVE dict: attributes set mid-span
+        # (sp.set(...)) are visible in a flight dump of the open span.
+        # Lock-free on purpose (id-keyed dict set/del are GIL-atomic):
+        # this sits on the enabled hot path the <2% overhead pin
+        # bounds; dump_flight tolerates a transiently-inconsistent
+        # view
+        self._open_spans[id(handle)] = {'name': name, 'kind': kind,
+                                        't0': t0, 'attrs': attrs}
         try:
             yield handle
         finally:
+            self._open_spans.pop(id(handle), None)
             rec = {'type': 'span', 'name': name, 'kind': kind,
                    't0': t0, 't1': self.now()}
             if handle.synced:
@@ -368,3 +450,68 @@ class Recorder:
                        'metrics': self.registry.snapshot()}, f)
         os.replace(tmp, mpath)
         return epath
+
+    def dump_flight(self, reason, outdir=None, **attrs):
+        """Crash-safe black-box dump: atomically (tmp + rename, with
+        the serializers' write-complete sentinel convention) write
+        ``flight-rank<N>.json`` holding the last :data:`FLIGHT_RING`
+        records, every OPEN span (where this rank is blocked right
+        now), the newest completed collective/p2p span, and the
+        caller's ``reason``/attrs.  The event log is flushed first so
+        the JSONL tail is as current as the flight record.
+
+        Called from the places a process dies or detects death: chaos
+        kill sites before ``os._exit``, the typed-failure
+        constructors (``ChannelTimeout`` / ``PeerDeadError`` /
+        ``CheckpointCorruptError``), and the preemption SIGTERM hook.
+        Latest dump wins (one file per rank); ``n_dumps`` counts how
+        many this process wrote.  Best-effort by contract: returns
+        the path or None, never raises."""
+        outdir = outdir or self.outdir
+        if outdir is None:
+            return None
+        try:
+            try:
+                self.flush(outdir)
+            except Exception:
+                pass  # the flight record must still be attempted
+            rank = self._rank()
+            with self._lock:
+                ring = list(self._flight)
+                last_coll = (dict(self._last_collective)
+                             if self._last_collective else None)
+                last_p2p = (dict(self._last_p2p)
+                            if self._last_p2p else None)
+            open_spans = [
+                dict({k: v for k, v in rec.items()
+                      if k != 'attrs'}, **(rec.get('attrs') or {}))
+                for rec in list(self._open_spans.values())]
+            self.flight_dumps += 1
+            record = {
+                'rank': rank,
+                'pid': os.getpid(),
+                'reason': reason,
+                't': self.now(),
+                'wall0': self._wall0,
+                'n_dumps': self.flight_dumps,
+                'liveness_dir': self.liveness_dir,
+                'last_collective': last_coll,
+                'last_p2p': last_p2p,
+                'open_spans': open_spans,
+                'ring': ring,
+            }
+            if attrs:
+                record['attrs'] = attrs
+            record['complete'] = True  # write-complete sentinel
+            path = os.path.join(outdir, 'flight-rank%d.json' % rank)
+            tmp = path + '.tmp.%d' % os.getpid()
+            with open(tmp, 'w') as f:
+                # default=repr: an exotic attr value must not void the
+                # whole black box
+                json.dump(record, f, default=repr)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None  # a failing dump must never mask the fault

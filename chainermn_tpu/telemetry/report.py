@@ -221,6 +221,8 @@ def aggregate_metrics(rank_metrics):
                 cur['count'] += snap.get('count') or 0
                 cur['sum'] += snap.get('sum') or 0.0
                 cur['samples'].extend(snap.get('samples') or [])
+            if name in merged and snap.get('help'):
+                merged[name].setdefault('help', snap['help'])
     for snap in merged.values():
         if snap.get('type') == 'histogram':
             s = sorted(snap['samples'])
@@ -358,18 +360,34 @@ def render_text(report, max_steps=24):
     return '\n'.join(lines)
 
 
+#: one label pair with the exposition-format escaping contract: label
+#: values may contain ONLY escaped backslash/quote/newline sequences
+#: (``\\``, ``\"``, ``\n``) -- a raw quote or backslash truncates or
+#: mangles the sample at scrape time
+_PROM_LABEL = r'[a-zA-Z_][a-zA-Z0-9_]*="(?:\\[\\"n]|[^"\\])*"'
 _PROM_LINE = re.compile(
-    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? '
-    r'[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[Nn]a[Nn]|[Ii]nf)$')
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+    r'(\{(?:%(l)s)(?:,(?:%(l)s))*,?\})? '
+    r'[-+]?([0-9]*\.?[0-9]+([eE][-+]?[0-9]+)?|[Nn]a[Nn]|[Ii]nf)$'
+    % {'l': _PROM_LABEL})
+_PROM_COMMENT = re.compile(
+    r'^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?$')
 
 
 def validate_prometheus(text):
     """Offending lines of a Prometheus text exposition (empty list =
     valid).  Deliberately strict: the CI smoke leg treats ANY
-    malformed sample line as a failure."""
+    malformed sample line as a failure -- including a label value
+    with an unescaped quote/backslash, which the old looser pattern
+    (any non-brace run) waved through."""
     bad = []
     for line in text.splitlines():
-        if not line.strip() or line.startswith('#'):
+        if not line.strip():
+            continue
+        if line.startswith('#'):
+            if (line.startswith(('# HELP', '# TYPE'))
+                    and not _PROM_COMMENT.match(line)):
+                bad.append(line)
             continue
         if not _PROM_LINE.match(line):
             bad.append(line)

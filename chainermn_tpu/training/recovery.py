@@ -102,10 +102,19 @@ class PreemptionHandler:
                     sig, self._on_signal)
 
     def _on_signal(self, signum, frame):
-        # async-signal-safe: set flags only; the checkpoint runs at
-        # the next step boundary where device state is consistent
+        # set the flags FIRST (the contract: the checkpoint runs at
+        # the next step boundary where device state is consistent),
+        # then drop the crash-safe flight record -- if the scheduler
+        # follows this SIGTERM with a SIGKILL before the step
+        # boundary, the black box is all that survives.  CPython
+        # handlers run between bytecodes (not true async-signal
+        # context), so the small atomic file write is safe; it
+        # touches no device state and never raises by contract.
         self.preempt_requested = True
         self.received_signal = signum
+        _telemetry.dump_flight('sigterm', signum=signum,
+                               iteration=getattr(self.updater,
+                                                 'iteration', None))
 
     def restore_signal_handlers(self):
         for sig, prev in self._prev_handlers.items():

@@ -204,12 +204,13 @@ class FaultInjector:
                 telemetry.event('chaos:' + site, kind='chaos',
                                 occurrence=idx, arg=rule.arg)
                 if site in ('kill_step', 'kill_recv', 'ckpt_kill'):
-                    # os._exit skips atexit: flush the timeline NOW
-                    # or the fatal injection is invisible in it
-                    try:
-                        telemetry.flush()
-                    except Exception:
-                        pass
+                    # os._exit skips atexit: flush the timeline AND
+                    # drop the crash-safe flight record NOW, or the
+                    # fatal injection is invisible post-mortem
+                    # (dump_flight flushes internally and never
+                    # raises)
+                    telemetry.dump_flight('chaos:' + site,
+                                          occurrence=idx)
         return rule if hit else None
 
     def counts(self):

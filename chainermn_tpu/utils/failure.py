@@ -42,6 +42,21 @@ import numpy as np
 # CMN_* status codes, ``csrc/chainermn_core.cpp`` / ``native/core.py``)
 # ----------------------------------------------------------------------
 
+def _flight_dump(reason, **attrs):
+    """Drop the telemetry flight record at a typed-failure raise
+    site.  The typed constructors call this so EVERY raise path --
+    present and future -- leaves the black box behind without each
+    call site remembering to; a no-op when telemetry is off or
+    in-memory, and never raises (a failing dump must not mask the
+    typed verdict)."""
+    try:
+        from chainermn_tpu import telemetry
+        if telemetry._active is not None:
+            telemetry.dump_flight(reason, **attrs)
+    except Exception:
+        pass
+
+
 class CommFailure(RuntimeError):
     """Base of the eager-channel failure taxonomy (Python twin of the
     native engine's :class:`~chainermn_tpu.native.core.CommError`)."""
@@ -57,6 +72,11 @@ class ChannelTimeout(CommFailure, TimeoutError):
 
     status_name = 'CMN_TIMEOUT'
 
+    def __init__(self, *args):
+        super().__init__(*args)
+        _flight_dump('ChannelTimeout',
+                     message=str(args[0]) if args else '')
+
 
 class PeerDeadError(CommFailure):
     """A peer process is POSITIVELY detected dead (its heartbeat file
@@ -71,6 +91,8 @@ class PeerDeadError(CommFailure):
     def __init__(self, message, process_index=None):
         super().__init__(message)
         self.process_index = process_index
+        _flight_dump('PeerDeadError', message=str(message),
+                     process_index=process_index)
 
 
 class CheckpointCorruptError(ValueError):
@@ -101,6 +123,8 @@ class CheckpointCorruptError(ValueError):
         self.path = path
         self.leaf = leaf
         self.kind = kind
+        _flight_dump('CheckpointCorruptError', message=str(message),
+                     path=path, leaf=leaf, corruption_kind=kind)
 
 
 class CheckpointSkippedWarning(UserWarning):

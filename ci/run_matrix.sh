@@ -47,7 +47,20 @@ python -m pytest tests/test_multiprocess.py -q --runslow \
 # uninterrupted loss trajectory.  See docs/fault_tolerance.md.
 echo "=== multi-controller chaos leg: real jax.distributed CPU processes ==="
 python -m pytest tests/test_multiprocess.py -q --runslow \
-  -k 'not elastic and not corrupt'
+  -k 'not elastic and not corrupt and not doctor'
+
+# TELEMETRY DOCTOR LEG (ISSUE 8 acceptance): the cross-rank
+# diagnosis proved end-to-end over real jax.distributed processes.
+# (1) chaos-delay variant: a rank-restricted fixed p2p delay
+# (rank=1;delay_send=*:0.05) -- `telemetry doctor` must name rank 1
+# as the chronic straggler with the lagging phase send_obj;
+# (2) chaos-kill post-mortem: rank 1 dies at a kill_recv site and
+# the doctor -- from the flight record flushed across os._exit, the
+# event-log tail and the heartbeat files, all written BEFORE the
+# death -- must report the dead rank, its last completed collective
+# seq, and the open recv_obj span the survivor was blocked in.
+echo "=== telemetry doctor leg: straggler attribution + crash post-mortem ==="
+python -m pytest tests/test_multiprocess.py -q --runslow -k 'doctor'
 
 # TELEMETRY SMOKE LEG (ISSUE 6): capture -> merge -> report on the
 # mnist example.  The env var is the ONLY switch (zero-cost-off
@@ -62,6 +75,9 @@ CHAINERMN_TPU_TELEMETRY="${TELEMETRY_DIR}" \
   python examples/mnist/train_mnist.py --quick --cpu -b 96 \
   --out "${TELEMETRY_DIR}/result"
 python -m chainermn_tpu.telemetry report "${TELEMETRY_DIR}"
+# the doctor must also accept the capture: exit 0 and a parseable
+# verdict JSON (single-controller, so skew fields are honest Nones)
+python -m chainermn_tpu.telemetry doctor "${TELEMETRY_DIR}"
 python - "${TELEMETRY_DIR}" <<'PY'
 import json, sys
 from chainermn_tpu.telemetry import report as trep
@@ -75,9 +91,13 @@ assert ov is None or 0.0 <= ov <= 1.0, rep['overlap']
 prom = open(d + '/metrics.prom').read()
 bad = trep.validate_prometheus(prom)
 assert not bad, 'malformed Prometheus lines: %r' % bad[:3]
+doc = json.load(open(d + '/doctor_report.json'))
+assert 'verdict' in doc and 'healthy' in doc['verdict'], doc.keys()
+assert doc['verdict']['dead_ranks'] == [], doc['verdict']
 print('telemetry smoke OK: %d spans, %d step rows, overlap=%r, '
-      '%d prom lines' % (rep['n_spans'], len(rep['steps']), ov,
-                         len(prom.splitlines())))
+      '%d prom lines, doctor verdict healthy=%r'
+      % (rep['n_spans'], len(rep['steps']), ov,
+         len(prom.splitlines()), doc['verdict']['healthy']))
 PY
 rm -rf "${TELEMETRY_DIR}"
 

@@ -42,6 +42,19 @@ Scenarios (``CMN_MP_SCENARIO``):
                     parent corrupts the newest between phases; the
                     resume phase must skip it with a typed warning
                     and continue from the previous valid one
+- ``tele_skew``     telemetry-captured lap loop (send -> bounded
+                    allreduce -> recv); with a rank-restricted
+                    ``delay_send`` fault one rank arrives late to
+                    every barrier -- the parent's ``telemetry
+                    doctor`` must name that rank as the chronic
+                    straggler with phase ``send_obj``
+- ``tele_dead``     telemetry + liveness laps, then rank 1 dies at a
+                    chaos ``kill_recv`` site (flight record flushed
+                    across ``os._exit``); rank 0 blocks in recv_obj
+                    until the typed ``PeerDeadError`` (its own flight
+                    record snapshots the open span) -- the doctor
+                    must name the dead rank, its last completed
+                    collective seq, and where rank 0 was blocked
 """
 
 import json
@@ -487,6 +500,75 @@ def scenario_nan_guard(rank, nprocs, outdir, res):
         and os.path.exists(guard.divergence_checkpoint))
 
 
+def scenario_tele_skew(rank, nprocs, outdir, res):
+    """Lap structure chosen so a p2p send delay does NOT couple the
+    ranks before the collective: send first (the injected
+    ``delay_send`` inflates only the sender's span), then the bounded
+    allreduce (the delayed rank arrives late to its barrier), then
+    the recv (whose message was published a lap-phase earlier, so it
+    is an instant pickup).  With ``rank=1;delay_send=*:ARG`` rank 1
+    is chronically late to every rendezvous and the grown span on
+    rank 1 is ``send_obj`` -- exactly what the doctor must say."""
+    from chainermn_tpu import telemetry
+    comm = _comm(nprocs)
+    res['telemetry_on'] = telemetry.enabled()
+    for lap in range(6):
+        comm.send_obj({'lap': lap}, (rank + 1) % nprocs, tag=7,
+                      timeout=60.0)
+        comm.allreduce_obj(float(lap), op='mean', timeout=60.0)
+        got = comm.recv_obj((rank - 1) % nprocs, tag=7, timeout=60.0)
+        assert got['lap'] == lap
+    res['laps'] = 6
+    telemetry.flush()
+
+
+TELE_DEAD_LAPS = 2
+
+
+def scenario_tele_dead(rank, nprocs, outdir, res):
+    """Clean laps establish per-stream collective seqs, then rank 1's
+    third ``recv_obj`` call trips the chaos ``kill_recv`` site
+    (``rank=1;kill_recv=@2``): flight record + event flush, then
+    ``os._exit(42)``.  Rank 0 blocks in a recv from the corpse until
+    peer liveness surfaces the typed ``PeerDeadError`` -- whose
+    constructor drops rank 0's own flight record with the open
+    ``recv_obj`` span inside."""
+    from chainermn_tpu import telemetry
+    from chainermn_tpu.utils import failure
+    comm = _comm(nprocs)
+    hb = comm.enable_peer_liveness(os.path.join(outdir, 'live'),
+                                   interval=0.2, stall_timeout=1.5)
+    res['telemetry_on'] = telemetry.enabled()
+    for lap in range(TELE_DEAD_LAPS):
+        comm.send_obj({'lap': lap}, (rank + 1) % nprocs, tag=7,
+                      timeout=60.0)
+        comm.allreduce_obj(float(lap), op='mean', timeout=60.0)
+        comm.recv_obj((rank - 1) % nprocs, tag=7, timeout=60.0)
+    if rank == 1:
+        # the 3rd recv_obj call: chaos kills this process before the
+        # wait even starts; nothing is ever published under tag 9
+        comm.recv_obj(0, tag=9, timeout=30.0)
+        res['unreachable'] = True  # kill_recv must have fired
+        return
+    time.sleep(0.3)
+    t0 = time.monotonic()
+    try:
+        comm.recv_obj(1, tag=9, timeout=30.0)
+        res['recv_error'] = None
+    except failure.PeerDeadError as e:
+        res['recv_error'] = 'PeerDeadError'
+        res['dead_process_index'] = e.process_index
+    except Exception as e:  # pragma: no cover - wrong type is a FAIL
+        res['recv_error'] = type(e).__name__
+    res['detect_seconds'] = time.monotonic() - t0
+    hb.stop()
+    telemetry.flush()
+    _write(outdir, rank, res)
+    # skip atexit (jax.distributed shutdown would wait on the corpse)
+    sys.stdout.flush()
+    os._exit(0)
+
+
 SCENARIOS = {
     'p2p_ring': scenario_p2p_ring,
     'scatter': scenario_scatter,
@@ -497,6 +579,8 @@ SCENARIOS = {
     'train_elastic': scenario_train_elastic,
     'train_fallback': scenario_train_fallback,
     'nan_guard': scenario_nan_guard,
+    'tele_skew': scenario_tele_skew,
+    'tele_dead': scenario_tele_dead,
 }
 
 

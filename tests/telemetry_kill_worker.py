@@ -1,0 +1,42 @@
+"""Single-process child for the flight-recorder kill-site tests.
+
+Enables telemetry from the environment (the parent sets
+``CHAINERMN_TPU_TELEMETRY``), records a couple of spans so the flight
+ring and last-collective slot have content, then arms ONE chaos kill
+site (argv[1]: ``kill_step`` / ``kill_recv`` / ``ckpt_kill``) and
+triggers its hook: the process hard-dies via ``os._exit`` (42, or 43
+for ``ckpt_kill``).  The parent (``tests/test_telemetry.py``) asserts
+the ``chaos:<site>`` event reached ``events-rank0.jsonl`` AND the
+crash-safe ``flight-rank0.json`` exists, is sentinel-complete, and
+names the site -- both written across the ``os._exit`` that skips
+every atexit handler.
+"""
+
+import os
+import sys
+
+
+def main():
+    site = sys.argv[1]
+    os.environ['JAX_PLATFORMS'] = 'cpu'  # see ckpt_kill_worker.py
+    from chainermn_tpu import telemetry
+    from chainermn_tpu.utils import chaos
+
+    telemetry.maybe_enable_from_env()
+    assert telemetry.enabled(), 'parent must set CHAINERMN_TPU_TELEMETRY'
+    with telemetry.span('allreduce_obj', kind='collective', seq=4):
+        pass
+    with telemetry.span('jitted_step', kind='compute', iteration=0):
+        pass
+    chaos.install(chaos.FaultInjector('%s=@0' % site))
+    if site == 'kill_step':
+        chaos.on_step(0)
+    elif site == 'kill_recv':
+        chaos.on_recv()
+    elif site == 'ckpt_kill':
+        chaos.on_checkpoint_write('unused.tmp')
+    os._exit(99)  # NOT reached when the fault fires
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,382 @@
+"""Cross-rank diagnosis (``chainermn_tpu/telemetry/diagnosis.py``):
+collective pairing + clock-offset estimation + arrival-skew
+attribution, MAD-based anomaly flags, straggler verdicts, the
+flight-record/heartbeat crash post-mortem, and the ``doctor`` CLI
+(ISSUE 8 tentpole)."""
+
+import json
+import os
+import time
+
+import pytest
+
+from chainermn_tpu import telemetry
+from chainermn_tpu.telemetry import diagnosis as dx
+from chainermn_tpu.telemetry import report as rep_mod
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    yield
+    telemetry.disable()
+
+
+# ---------------------------------------------------------------------
+# synthetic capture builders
+
+def _write_rank_log(tmp_path, rank, records):
+    path = tmp_path / ('events-rank%d.jsonl' % rank)
+    with open(str(path), 'w') as f:
+        f.write(json.dumps({'type': 'meta', 'rank': rank,
+                            'wall0': 0.0}) + '\n')
+        for r in records:
+            f.write(json.dumps(dict(r, rank=rank)) + '\n')
+
+
+def _train_capture(tmp_path, lates, n_steps=10, offsets=None,
+                   prep=0.005, compute=0.03):
+    """Per-rank step-phase + eager-allreduce logs: rank r's
+    host_batch_prep is inflated by ``lates[r]`` seconds, every rank's
+    timestamps shifted by ``offsets[r]`` (simulated clock drift).
+    Every allreduce exits at the common release time (the last
+    arrival), which is what a real rendezvous does."""
+    offsets = offsets or [0.0] * len(lates)
+    worst = max(lates)
+    for rank, late in enumerate(lates):
+        off = offsets[rank]
+        recs = []
+        t = 0.0
+        for it in range(n_steps):
+            p = prep + late
+            recs.append({'type': 'span', 'name': 'host_batch_prep',
+                         'kind': 'host', 't0': t + off,
+                         't1': t + p + off, 'iteration': it})
+            t += p
+            recs.append({'type': 'span', 'name': 'jitted_step',
+                         'kind': 'compute', 't0': t + off,
+                         't1': t + compute + off, 'iteration': it})
+            t += compute
+            release = (it + 1) * (prep + worst + compute + 0.004)
+            recs.append({'type': 'span', 'name': 'allreduce_obj',
+                         'kind': 'collective', 't0': t + off,
+                         't1': release + off, 'seq': it})
+            t = release
+        _write_rank_log(tmp_path, rank, recs)
+
+
+# ---------------------------------------------------------------------
+# pairing + offsets + skew
+
+def test_pair_collectives_by_name_tag_seq():
+    spans = [
+        {'kind': 'collective', 'name': 'barrier', 'tag': 'b', 'seq': 1,
+         't0': 0.0, 't1': 1.0, 'rank': 0},
+        {'kind': 'collective', 'name': 'barrier', 'tag': 'b', 'seq': 1,
+         't0': 0.5, 't1': 1.0, 'rank': 1},
+        {'kind': 'collective', 'name': 'barrier', 'tag': 'b', 'seq': 2,
+         't0': 2.0, 't1': 3.0, 'rank': 0},
+        # no seq: unpairable, skipped
+        {'kind': 'collective', 'name': 'allreduce_obj',
+         't0': 0.0, 't1': 1.0, 'rank': 0},
+    ]
+    groups = dx.pair_collectives(spans)
+    assert set(groups) == {('barrier', 'b', 1), ('barrier', 'b', 2)}
+    assert set(groups[('barrier', 'b', 1)]) == {0, 1}
+
+
+def test_clock_offsets_recovered_from_rendezvous_exits():
+    # rank 1's clock runs 0.25 s ahead: every paired exit shows it
+    groups = {}
+    for seq in range(5):
+        groups[('barrier', None, seq)] = {
+            0: {'t0': seq * 1.0, 't1': seq + 0.5},
+            1: {'t0': seq * 1.0 + 0.25, 't1': seq + 0.75},
+        }
+    offs = dx.estimate_clock_offsets(groups)
+    assert abs((offs[1] - offs[0]) - 0.25) < 1e-9
+
+
+def test_skew_none_without_pairs(tmp_path):
+    _write_rank_log(tmp_path, 0, [
+        {'type': 'span', 'name': 'jitted_step', 'kind': 'compute',
+         't0': 0.0, 't1': 1.0, 'iteration': 0}])
+    _, spans, _, _ = rep_mod.load_rank_logs(str(tmp_path))
+    assert dx.collective_skew(spans) is None
+    assert dx.skew_summary(spans) == {
+        'collective_skew_p99_ms': None, 'straggler_rank': None}
+
+
+def test_chronic_straggler_named_with_lagging_phase(tmp_path):
+    _train_capture(tmp_path, lates=[0.0, 0.02, 0.0])
+    diag = dx.diagnose(str(tmp_path))
+    v = diag['verdict']
+    assert v['straggler_rank'] == 1
+    assert v['straggler_phase'] == 'host_batch_prep'
+    # exactly one straggler: the VICTIMS' inflated collective waits
+    # must not read as additional stragglers
+    assert len(diag['stragglers']) == 1
+    st = diag['collective_skew']['per_rank'][1]
+    assert st['chronic'] and st['late_fraction'] > 0.9
+    assert abs(st['mean_late_ms'] - 20.0) < 2.0
+    assert any('rank 1 arrives' in s for s in v['summary'])
+
+
+def test_skew_attribution_survives_clock_drift(tmp_path):
+    # rank 2's wall clock is 0.5 s off; the true straggler is rank 1.
+    # Without offset correction every rank-2 arrival would look 500 ms
+    # late and swamp the 20 ms real signal.
+    _train_capture(tmp_path, lates=[0.0, 0.02, 0.0],
+                   offsets=[0.0, 0.0, 0.5])
+    diag = dx.diagnose(str(tmp_path))
+    offs = diag['collective_skew']['clock_offsets_ms']
+    assert abs((offs[2] - offs[0]) - 500.0) < 1.0
+    assert diag['verdict']['straggler_rank'] == 1
+    assert abs(diag['collective_skew']['skew_ms']['p99'] - 20.0) < 2.0
+
+
+def test_healthy_capture_has_clean_verdict(tmp_path):
+    _train_capture(tmp_path, lates=[0.0, 0.0])
+    diag = dx.diagnose(str(tmp_path))
+    assert diag['verdict']['healthy'] is True
+    assert diag['verdict']['straggler_rank'] is None
+    assert diag['verdict']['dead_ranks'] == []
+    assert diag['stragglers'] == []
+
+
+def test_skew_summary_bench_fields(tmp_path):
+    _train_capture(tmp_path, lates=[0.0, 0.02])
+    _, spans, _, _ = rep_mod.load_rank_logs(str(tmp_path))
+    out = dx.skew_summary(spans)
+    assert abs(out['collective_skew_p99_ms'] - 20.0) < 2.0
+    assert out['straggler_rank'] == 1
+
+
+# ---------------------------------------------------------------------
+# MAD outliers + step anomalies
+
+def test_mad_and_robust_outliers():
+    med, m = dx.mad([1.0, 2.0, 3.0, 4.0, 100.0])
+    assert med == 3.0 and m == 1.0
+    assert dx.robust_outliers([1.0, 2.0, 3.0, 4.0, 100.0]) == [4]
+    # fast outliers are not flagged (slow side only)
+    assert dx.robust_outliers([10.0, 10.0, 10.0, 10.0, 0.001]) == []
+    # degenerate: too few samples / zero MAD -> nothing fabricated
+    assert dx.robust_outliers([1.0, 100.0]) == []
+    assert dx.robust_outliers([5.0] * 10) == []
+
+
+def test_step_anomalies_attribute_grown_phase(tmp_path):
+    recs = []
+    for it in range(12):
+        dur = 0.030 if it != 7 else 0.300  # iteration 7 spikes 10x
+        recs.append({'type': 'span', 'name': 'jitted_step',
+                     'kind': 'compute', 't0': it * 1.0,
+                     't1': it * 1.0 + dur, 'iteration': it})
+        recs.append({'type': 'span', 'name': 'host_batch_prep',
+                     'kind': 'host', 't0': it * 1.0 - 0.005,
+                     't1': it * 1.0, 'iteration': it})
+    _write_rank_log(tmp_path, 0, recs)
+    _, spans, _, _ = rep_mod.load_rank_logs(str(tmp_path))
+    rows = dx.step_anomalies(spans)
+    assert rows and rows[0]['iteration'] == 7
+    assert rows[0]['phase'] == 'jitted_step'
+    assert rows[0]['value_ms'] == pytest.approx(300.0, abs=1.0)
+
+
+# ---------------------------------------------------------------------
+# flight records + heartbeats + crash verdicts
+
+def test_flight_dump_roundtrip_and_open_spans(tmp_path):
+    rec = telemetry.enable(outdir=str(tmp_path))
+    with rec.span('allreduce_obj', kind='collective', seq=6):
+        pass
+    try:
+        with rec.span('recv_obj', kind='p2p', source=1, seq=2):
+            rec.dump_flight('test_reason', detail='x')
+            raise RuntimeError('boom')
+    except RuntimeError:
+        pass
+    flights = dx.load_flight_records(str(tmp_path))
+    f = flights[0]
+    assert f['complete'] is True
+    assert f['reason'] == 'test_reason'
+    assert f['attrs']['detail'] == 'x'
+    assert f['last_collective']['name'] == 'allreduce_obj'
+    assert f['last_collective']['seq'] == 6
+    # the dump happened INSIDE the recv_obj span: it is open in the
+    # record, with its attributes flattened
+    (blocked,) = f['open_spans']
+    assert blocked['name'] == 'recv_obj'
+    assert blocked['source'] == 1 and blocked['seq'] == 2
+    # the dump also flushed the event log
+    assert os.path.exists(str(tmp_path / 'events-rank0.jsonl'))
+
+
+def test_flight_records_skip_torn_files(tmp_path):
+    with open(str(tmp_path / 'flight-rank0.json'), 'w') as f:
+        f.write('{"rank": 0, "reason": "torn')  # crashed mid-dump
+    with open(str(tmp_path / 'flight-rank1.json'), 'w') as f:
+        json.dump({'rank': 1, 'reason': 'x'}, f)  # no sentinel
+    with open(str(tmp_path / 'flight-rank2.json'), 'w') as f:
+        json.dump({'rank': 2, 'reason': 'ok', 'complete': True}, f)
+    flights = dx.load_flight_records(str(tmp_path))
+    assert list(flights) == [2]
+
+
+def test_typed_failure_constructors_drop_flight_records(tmp_path):
+    from chainermn_tpu.utils import failure
+    telemetry.enable(outdir=str(tmp_path))
+    failure.ChannelTimeout('nothing arrived')
+    f = dx.load_flight_records(str(tmp_path))[0]
+    assert f['reason'] == 'ChannelTimeout'
+    failure.PeerDeadError('peer 3 dead', process_index=3)
+    f = dx.load_flight_records(str(tmp_path))[0]
+    assert f['reason'] == 'PeerDeadError'
+    assert f['attrs']['process_index'] == 3
+    failure.CheckpointCorruptError('bad crc', path='snap.npz',
+                                   kind='crc')
+    f = dx.load_flight_records(str(tmp_path))[0]
+    assert f['reason'] == 'CheckpointCorruptError'
+    assert f['attrs']['corruption_kind'] == 'crc'
+    assert f['n_dumps'] == 3  # latest wins, count preserved
+
+
+def test_typed_failures_are_silent_without_telemetry(tmp_path):
+    from chainermn_tpu.utils import failure
+    assert not telemetry.enabled()
+    failure.ChannelTimeout('no recorder, no file, no crash')
+    assert dx.load_flight_records(str(tmp_path)) == {}
+
+
+def _fake_death(tmp_path, *, beats=True):
+    """Rank 1 killed by chaos at its recv site; rank 0 survived,
+    blocked in recv_obj, and raised the typed PeerDeadError."""
+    d = str(tmp_path)
+    rec = telemetry.enable(outdir=d)
+    rec.liveness_dir = d
+    with rec.span('allreduce_obj', kind='collective', seq=0):
+        pass
+    try:
+        with rec.span('recv_obj', kind='p2p', source=1, tag=5, seq=3):
+            from chainermn_tpu.utils import failure
+            raise failure.PeerDeadError('stalled', process_index=1)
+    except Exception:
+        pass
+    telemetry.flush()
+    telemetry.disable()
+    with open(os.path.join(d, 'flight-rank1.json'), 'w') as f:
+        json.dump({'rank': 1, 'pid': 9, 'reason': 'chaos:kill_recv',
+                   't': 5.0, 'wall0': 0.0, 'n_dumps': 1,
+                   'liveness_dir': d,
+                   'last_collective': {
+                       'type': 'span', 'name': 'allreduce_obj',
+                       'kind': 'collective', 'seq': 7,
+                       't0': 4.0, 't1': 4.1},
+                   'open_spans': [], 'ring': [],
+                   'complete': True}, f)
+    if beats:
+        now = time.time()
+        for pi, t, it in ((0, now, 9), (1, now - 60, 4)):
+            with open(os.path.join(d, 'heartbeat-%d.json' % pi),
+                      'w') as f:
+                json.dump({'pid': pi, 'process_index': pi,
+                           'time': t, 'iteration': it}, f)
+
+
+def test_doctor_names_dead_rank_seq_and_blocked_survivor(tmp_path):
+    _fake_death(tmp_path)
+    diag = dx.diagnose(str(tmp_path))
+    v = diag['verdict']
+    assert v['dead_ranks'] == [1]
+    assert v['healthy'] is False
+    dead = diag['crash']['per_rank'][1]
+    assert dead['state'] == 'dead'
+    # all three accusation channels converge
+    why = ' '.join(dead['why'])
+    assert 'chaos:kill_recv' in why
+    assert 'PeerDeadError' in why
+    assert 'heartbeat froze' in why
+    # last completed collective comes from the victim's OWN flight
+    # record, written before os._exit
+    assert dead['last_collective'] == {
+        'name': 'allreduce_obj', 'seq': 7, 'tag': None}
+    surv = diag['crash']['per_rank'][0]
+    (blocked,) = surv['blocked_in']
+    assert blocked['name'] == 'recv_obj' and blocked['source'] == 1
+    text = dx.render_doctor_text(diag)
+    assert 'rank 1' in text and 'seq 7' in text
+    assert 'blocked: rank 0 in recv_obj' in text
+
+
+def test_doctor_heartbeats_alone_name_stalled_rank(tmp_path):
+    # no flight records at all: relative heartbeat age still accuses
+    now = time.time()
+    for pi, t in ((0, now), (1, now - 120), (2, now - 1)):
+        with open(str(tmp_path / ('heartbeat-%d.json' % pi)),
+                  'w') as f:
+            json.dump({'process_index': pi, 'time': t,
+                       'iteration': 5}, f)
+    crash = dx.crash_analysis(str(tmp_path), [], [], [], {},
+                              liveness_dirs=[str(tmp_path)])
+    assert crash['dead_ranks'] == [1]
+
+
+def test_sigterm_with_checkpoint_is_preemption_not_death(tmp_path):
+    d = str(tmp_path)
+    rec = telemetry.enable(outdir=d)
+    rec.dump_flight('sigterm', signum=15)
+    with rec.span('checkpoint_write', kind='checkpoint'):
+        pass
+    telemetry.flush()
+    telemetry.disable()
+    diag = dx.diagnose(d)
+    assert diag['crash']['dead_ranks'] == []
+    assert diag['crash']['per_rank'][0]['state'] == 'preempted'
+    # the same flight WITHOUT the checkpoint span reads as a death
+    for name in os.listdir(d):
+        if name.startswith('events-'):
+            os.remove(os.path.join(d, name))
+    diag = dx.diagnose(d)
+    assert diag['crash']['dead_ranks'] == [0]
+
+
+# ---------------------------------------------------------------------
+# doctor CLI
+
+def test_cli_doctor_writes_report_and_exits_0(tmp_path, capsys):
+    from chainermn_tpu.telemetry.__main__ import main
+    _train_capture(tmp_path, lates=[0.0, 0.02])
+    assert main(['doctor', str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert 'CHRONIC' in out
+    assert 'verdict: UNHEALTHY' in out
+    with open(str(tmp_path / 'doctor_report.json')) as f:
+        saved = json.load(f)
+    assert saved['verdict']['straggler_rank'] == 1
+    assert saved['verdict']['straggler_phase'] == 'host_batch_prep'
+
+
+def test_cli_doctor_json_mode(tmp_path, capsys):
+    from chainermn_tpu.telemetry.__main__ import main
+    _train_capture(tmp_path, lates=[0.0, 0.0])
+    assert main(['doctor', str(tmp_path), '--json',
+                 '--no-export']) == 0
+    diag = json.loads(capsys.readouterr().out)
+    assert diag['verdict']['healthy'] is True
+    assert not os.path.exists(str(tmp_path / 'doctor_report.json'))
+
+
+def test_cli_doctor_empty_capture_exits_2(tmp_path, capsys):
+    from chainermn_tpu.telemetry.__main__ import main
+    assert main(['doctor', str(tmp_path)]) == 2
+
+
+def test_cli_missing_or_unknown_subcommand_is_nonzero(capsys):
+    from chainermn_tpu.telemetry.__main__ import main
+    assert main([]) == 2
+    err = capsys.readouterr().err
+    assert 'usage:' in err and 'subcommand is required' in err
+    assert main(['frobnicate']) == 2
+    err = capsys.readouterr().err
+    assert 'invalid choice' in err

@@ -49,7 +49,8 @@ def _spawn(nprocs, outdir, worker=WORKER, extra_env=None,
     port = _free_port()
     env_base = {k: v for k, v in os.environ.items()
                 if k not in ('XLA_FLAGS', 'JAX_PLATFORMS',
-                             'CHAINERMN_TPU_CHAOS')}
+                             'CHAINERMN_TPU_CHAOS',
+                             'CHAINERMN_TPU_TELEMETRY')}
     env_base['PYTHONPATH'] = (
         ROOT + os.pathsep + env_base.get('PYTHONPATH', ''))
     procs = []
@@ -101,12 +102,14 @@ def _launch(nprocs, outdir):
 
 
 def _chaos(nprocs, outdir, scenario, chaos_spec=None, phase=None,
-           **kw):
+           telemetry_dir=None, **kw):
     extra = {'CMN_MP_SCENARIO': scenario}
     if chaos_spec:
         extra['CHAINERMN_TPU_CHAOS'] = chaos_spec
     if phase:
         extra['CMN_MP_PHASE'] = phase
+    if telemetry_dir:
+        extra['CHAINERMN_TPU_TELEMETRY'] = telemetry_dir
     return _spawn(nprocs, outdir, worker=CHAOS_WORKER,
                   extra_env=extra, **kw)
 
@@ -420,6 +423,102 @@ def test_corrupt_newest_snapshot_falls_back_to_previous(tmp_path):
         # steps 2..5 continue the uninterrupted oracle exactly
         np.testing.assert_allclose(res['losses'], res['oracle'][2:],
                                    rtol=0, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_doctor_names_injected_p2p_straggler(tmp_path):
+    """ISSUE 8 acceptance (1): a rank-restricted fixed p2p delay
+    (``rank=1;delay_send=*:0.05``) makes rank 1 chronically late to
+    every bounded allreduce's barrier; ``telemetry doctor`` over the
+    2-process capture must name rank 1 as the straggler with the
+    lagging phase ``send_obj`` -- machine-produced, no log
+    eyeballing."""
+    from chainermn_tpu.telemetry import diagnosis
+
+    tdir = str(tmp_path / 'tele')
+    results = _chaos(2, tmp_path, 'tele_skew',
+                     chaos_spec='seed=3;rank=1;delay_send=*:0.05',
+                     telemetry_dir=tdir)
+    for r in (0, 1):
+        assert results[r]['telemetry_on'] is True
+        assert results[r]['laps'] == 6
+
+    diag = diagnosis.diagnose(tdir)
+    v = diag['verdict']
+    assert v['straggler_rank'] == 1, v
+    assert v['straggler_phase'] == 'send_obj', v
+    skew = diag['collective_skew']
+    assert skew['paired'] >= 6
+    st = skew['per_rank'][1]
+    assert st['chronic'] is True, st
+    assert st['late_fraction'] >= 0.8, st
+    assert st['mean_late_ms'] > 10.0, st
+    # rank 0 is NOT chronically late, and is not a second straggler
+    assert skew['per_rank'][0]['chronic'] is False
+    assert [s['rank'] for s in diag['stragglers']] == [1]
+
+    # the CLI agrees: exit 0 and a parseable verdict JSON on disk
+    proc = subprocess.run(
+        [sys.executable, '-m', 'chainermn_tpu.telemetry', 'doctor',
+         tdir], capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS='cpu'))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'CHRONIC' in proc.stdout
+    with open(os.path.join(tdir, 'doctor_report.json')) as f:
+        saved = json.load(f)
+    assert saved['verdict']['straggler_rank'] == 1
+    assert saved['verdict']['straggler_phase'] == 'send_obj'
+
+
+@pytest.mark.slow
+def test_doctor_post_mortem_from_flight_records(tmp_path):
+    """ISSUE 8 acceptance (2): after a chaos ``kill_recv`` kills rank
+    1 mid-conversation, the doctor -- reading ONLY artifacts written
+    before the death (the flight record flushed across ``os._exit``,
+    the event tail, the heartbeat files) -- reports the dead rank,
+    its last completed collective seq, and the open recv_obj span
+    rank 0 was blocked in when the typed PeerDeadError fired."""
+    from chainermn_tpu.telemetry import diagnosis
+
+    TELE_DEAD_LAPS = 2  # keep in sync with mp_chaos_worker.py
+    tdir = str(tmp_path / 'tele')
+    results = _chaos(2, tmp_path, 'tele_dead',
+                     chaos_spec='seed=4;rank=1;kill_recv=@%d'
+                     % TELE_DEAD_LAPS,
+                     telemetry_dir=tdir,
+                     ok_rcs={0: (0,), 1: (42,)}, require_json=[0])
+    res = results[0]
+    assert res['recv_error'] == 'PeerDeadError', res
+    assert res['dead_process_index'] == 1
+
+    # the victim's artifacts exist and were written pre-death
+    assert os.path.exists(os.path.join(tdir, 'flight-rank1.json'))
+    with open(os.path.join(tdir, 'events-rank1.jsonl')) as f:
+        names = [json.loads(ln).get('name') for ln in f if ln.strip()]
+    assert 'chaos:kill_recv' in names
+
+    diag = diagnosis.diagnose(tdir)
+    assert diag['verdict']['dead_ranks'] == [1], diag['verdict']
+    dead = diag['crash']['per_rank'][1]
+    assert dead['state'] == 'dead'
+    assert dead['flight_reason'] == 'chaos:kill_recv'
+    # last completed collective: the bounded allreduce of the final
+    # clean lap, with the cross-rank-agreed sequence number
+    assert dead['last_collective']['name'] == 'allreduce_obj'
+    assert dead['last_collective']['seq'] == TELE_DEAD_LAPS - 1
+    surv = diag['crash']['per_rank'][0]
+    assert any(b['name'] == 'recv_obj' and b.get('source') == 1
+               for b in surv.get('blocked_in', [])), surv
+    # heartbeats corroborate: rank 1's froze before rank 0's last
+    assert any('heartbeat' in w for w in dead['why']), dead['why']
+
+    proc = subprocess.run(
+        [sys.executable, '-m', 'chainermn_tpu.telemetry', 'doctor',
+         tdir], capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS='cpu'))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'dead: rank 1' in proc.stdout
+    assert 'blocked: rank 0 in recv_obj' in proc.stdout
 
 
 @pytest.mark.slow

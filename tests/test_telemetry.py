@@ -148,6 +148,59 @@ def test_validate_prometheus_catches_malformed():
     assert rep_mod.validate_prometheus('no_value\n')
 
 
+def test_prometheus_help_lines_emitted_and_escaped():
+    reg = telemetry.Registry()
+    reg.counter('retries_total',
+                help='publish retries\nsecond line \\ tail').inc(2)
+    h = reg.histogram('wait_seconds', help='bounded waits')
+    h.observe(0.5)
+    text = reg.to_prometheus()
+    assert rep_mod.validate_prometheus(text) == []
+    # newline and backslash escaped per the exposition format
+    assert ('# HELP chainermn_tpu_retries_total publish '
+            'retries\\nsecond line \\\\ tail') in text
+    assert '# HELP chainermn_tpu_wait_seconds bounded waits' in text
+    # HELP precedes TYPE for the same metric
+    lines = text.splitlines()
+    ih = lines.index('# HELP chainermn_tpu_wait_seconds bounded waits')
+    assert lines[ih + 1] == '# TYPE chainermn_tpu_wait_seconds summary'
+
+
+def test_prometheus_label_values_escaped():
+    from chainermn_tpu.telemetry.recorder import (
+        escape_label_value, snapshot_to_prometheus)
+    assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+    text = snapshot_to_prometheus({
+        'g': {'type': 'gauge', 'value': 1.0,
+              'labels': {'rank': 'a"b\\c\nd', 'host': 'n-1'}}})
+    assert rep_mod.validate_prometheus(text) == []
+    assert 'host="n-1",rank="a\\"b\\\\c\\nd"' in text
+
+
+def test_validate_prometheus_rejects_unescaped_labels():
+    # raw quote inside a value, raw backslash, bad escape sequence,
+    # malformed HELP target -- all must be flagged
+    assert rep_mod.validate_prometheus('m{k="a"b"} 1.0\n')
+    assert rep_mod.validate_prometheus('m{k="a\\qb"} 1.0\n')
+    assert rep_mod.validate_prometheus('# HELP 9bad text\n')
+    assert rep_mod.validate_prometheus(
+        'm{k="ok\\n",j="fi\\\\ne"} 2.0\n# HELP m fine\n') == []
+
+
+def test_help_survives_rank_merge(tmp_path):
+    for rank in (0, 1):
+        with open(str(tmp_path / ('metrics-rank%d.json' % rank)),
+                  'w') as f:
+            json.dump({'rank': rank, 'metrics': {
+                'steps_total': {'type': 'counter', 'value': 1.0,
+                                'help': 'steps taken'}}}, f)
+    merged = rep_mod.aggregate_metrics(
+        rep_mod.load_rank_metrics(str(tmp_path)))
+    assert merged['steps_total']['help'] == 'steps taken'
+    text = telemetry.snapshot_to_prometheus(merged)
+    assert '# HELP chainermn_tpu_steps_total steps taken' in text
+
+
 # ---------------------------------------------------------------------
 # interval arithmetic + overlap
 
@@ -487,6 +540,114 @@ def test_disabled_overhead_under_2pct_on_mlp_step():
         'on %.3f ms): the disabled-by-default path is bounded by '
         'this and must stay unmeasurable'
         % (overhead * 100, min(t_off) * 1e3, min(t_on) * 1e3))
+
+
+# ---------------------------------------------------------------------
+# degenerate captures: the shapes a killed or half-started rank
+# leaves behind (ISSUE 8 satellite)
+
+def test_rank_dir_with_metrics_but_no_events(tmp_path):
+    # a rank that died before its first event flush still leaves a
+    # metrics snapshot; the merge must produce a report, not raise
+    with open(str(tmp_path / 'metrics-rank0.json'), 'w') as f:
+        json.dump({'rank': 0, 'metrics': {
+            'steps_total': {'type': 'counter', 'value': 3.0}}}, f)
+    report = rep_mod.build_report(str(tmp_path))
+    assert report['n_spans'] == 0 and report['steps'] == []
+    assert report['metrics']['steps_total']['value'] == 3.0
+    assert report['overlap']['overlap_fraction'] is None
+
+
+def test_loader_skips_torn_tail_and_binary_garbage(tmp_path):
+    # the exact footprint of a killed rank: valid lines, then a line
+    # cut mid-JSON with no trailing newline -- plus a line of raw
+    # bytes from a torn buffered write.  Loader must keep every
+    # intact record and count (not raise on) the rest.
+    path = str(tmp_path / 'events-rank0.jsonl')
+    with open(path, 'w') as f:
+        f.write(json.dumps({'type': 'meta', 'rank': 0,
+                            'wall0': 0.0}) + '\n')
+        f.write(json.dumps({'type': 'span', 'name': 'jitted_step',
+                            'kind': 'compute', 't0': 0.0, 't1': 1.0,
+                            'iteration': 0, 'rank': 0}) + '\n')
+        f.write('\x00\x01\xff garbled {{{\n')
+        f.write('{"type": "span", "name": "allreduce_obj", "kin')
+    metas, spans, events, bad = rep_mod.load_rank_logs(str(tmp_path))
+    assert len(metas) == 1 and len(spans) == 1
+    assert bad == 2
+    report = rep_mod.build_report(str(tmp_path))
+    assert report['n_spans'] == 1
+    assert report['n_unparseable_lines'] == 2
+
+
+def test_truncated_metrics_snapshot_is_skipped(tmp_path):
+    with open(str(tmp_path / 'metrics-rank0.json'), 'w') as f:
+        f.write('{"rank": 0, "metrics": {"steps_tot')  # torn write
+    with open(str(tmp_path / 'metrics-rank1.json'), 'w') as f:
+        json.dump({'rank': 1, 'metrics': {
+            'steps_total': {'type': 'counter', 'value': 2.0}}}, f)
+    merged = rep_mod.aggregate_metrics(
+        rep_mod.load_rank_metrics(str(tmp_path)))
+    assert merged['steps_total']['value'] == 2.0
+
+
+def test_aggregate_metrics_empty_and_malformed_snapshots():
+    assert rep_mod.aggregate_metrics([]) == {}
+    # snapshots without 'metrics', or entries without 'type', are
+    # ignored rather than fatal
+    merged = rep_mod.aggregate_metrics([
+        {'rank': 0},
+        {'rank': 1, 'metrics': {'x': {'no_type': True}}},
+        {'rank': 2, 'metrics': {'ok': {'type': 'counter',
+                                       'value': 1.0}}},
+    ])
+    assert list(merged) == ['ok']
+
+
+# ---------------------------------------------------------------------
+# chaos kill sites flush the timeline AND the flight record across
+# os._exit (ISSUE 8 satellite; subprocess-based like ckpt_kill_worker)
+
+@pytest.mark.parametrize('site,rc', [('kill_step', 42),
+                                     ('kill_recv', 42),
+                                     ('ckpt_kill', 43)])
+def test_chaos_kill_site_flushes_telemetry_and_flight(tmp_path, site,
+                                                      rc):
+    import subprocess
+    import sys
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'telemetry_kill_worker.py')
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS',
+                        'CHAINERMN_TPU_CHAOS',
+                        'CHAINERMN_TPU_TELEMETRY')}
+    env['PYTHONPATH'] = root + os.pathsep + env.get('PYTHONPATH', '')
+    env['CHAINERMN_TPU_TELEMETRY'] = str(tmp_path)
+    proc = subprocess.run([sys.executable, worker, site], env=env,
+                          stdout=subprocess.PIPE,
+                          stderr=subprocess.STDOUT, text=True,
+                          timeout=240)
+    assert proc.returncode == rc, proc.stdout  # died AT the site
+    # the event log made it out before os._exit, chaos event included
+    lines = [json.loads(ln) for ln in
+             open(str(tmp_path / 'events-rank0.jsonl'))]
+    names = [ln.get('name') for ln in lines]
+    assert ('chaos:' + site) in names
+    assert 'jitted_step' in names
+    # ... and so did the crash-safe flight record
+    with open(str(tmp_path / 'flight-rank0.json')) as f:
+        flight = json.load(f)
+    assert flight['complete'] is True
+    assert flight['reason'] == 'chaos:' + site
+    assert flight['last_collective']['name'] == 'allreduce_obj'
+    assert flight['last_collective']['seq'] == 4
+    assert any(r.get('name') == 'chaos:' + site
+               for r in flight['ring'])
+    # the doctor reads the same artifacts and declares the death
+    from chainermn_tpu.telemetry import diagnosis
+    diag = diagnosis.diagnose(str(tmp_path))
+    assert diag['crash']['dead_ranks'] == [0]
 
 
 def test_overlap_stats_splits_per_axis():
