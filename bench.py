@@ -930,14 +930,19 @@ def phase_stats(cfg, quick, trace_steps=3):
         from chainermn_tpu.telemetry import diagnosis
         was_active = telemetry.active()
         rec = was_active or telemetry.enable()  # in-memory recorder
-        n0 = len(rec.events)
-        for _ in range(2):
-            metrics = upd.update_core(arrays)
-        jax.block_until_ready(metrics)
-        spans = [dict(e, rank=e.get('rank', 0))
-                 for e in rec.events[n0:] if e.get('type') == 'span']
-        if was_active is None:
-            telemetry.disable()
+        try:
+            n0 = len(rec.events)
+            for _ in range(2):
+                metrics = upd.update_core(arrays)
+            jax.block_until_ready(metrics)
+            spans = [dict(e, rank=e.get('rank', 0))
+                     for e in rec.events[n0:]
+                     if e.get('type') == 'span']
+        finally:
+            # a failing step must not leave the in-memory recorder
+            # installed for the rest of the bench process
+            if was_active is None:
+                telemetry.disable()
         out.update(diagnosis.skew_summary(spans))
     except Exception as e:
         out.setdefault('collective_skew_p99_ms', None)

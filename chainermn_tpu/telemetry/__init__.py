@@ -140,12 +140,15 @@ def flush(outdir=None):
     return rec.flush(outdir) if rec is not None else None
 
 
-def dump_flight(reason, **attrs):
+def dump_flight(reason, outdir=None, blocking=True, **attrs):
     """Write the crash-safe flight record (last-N-records ring, open
     spans, last completed collective) for this rank -- see
-    :meth:`Recorder.dump_flight`.  No-op (None) when telemetry is
-    disabled or the session is in-memory; never raises."""
+    :meth:`Recorder.dump_flight`.  Signal handlers MUST pass
+    ``blocking=False`` (non-reentrant recorder lock).  No-op (None)
+    when telemetry is disabled or the session is in-memory; never
+    raises."""
     rec = _active
     if rec is None:
         return None
-    return rec.dump_flight(reason, **attrs)
+    return rec.dump_flight(reason, outdir=outdir, blocking=blocking,
+                           **attrs)

@@ -97,6 +97,24 @@ def mad(samples):
     return med, _median([abs(v - med) for v in samples])
 
 
+def effective_mad(samples):
+    """``(median, effective deviation)``: the MAD, or the mean
+    absolute deviation when the MAD collapses (over half the samples
+    identical -- a lone spike in an otherwise flat series zeroes the
+    MAD but cannot zero the mean absolute deviation).  ``(median,
+    None)`` when no usable deviation exists (constant or empty data);
+    every z-score computed against :func:`robust_outliers`' flags
+    MUST use this deviation, not the raw MAD."""
+    med, m = mad(samples)
+    if med is None:
+        return None, None
+    if not m or m < 1e-9 * max(abs(med), 1.0):
+        m = sum(abs(v - med) for v in samples) / len(samples)
+        if not m or m < 1e-9 * max(abs(med), 1.0):
+            return med, None
+    return med, m
+
+
 def robust_outliers(samples, z=MAD_Z, min_dev=0.0):
     """Indices of MAD-based outliers (modified z-score > ``z``,
     slow side only -- a suspiciously FAST step is not a straggler
@@ -104,20 +122,15 @@ def robust_outliers(samples, z=MAD_Z, min_dev=0.0):
     not fabricated flags): < 4 samples, MAD 0 on constant data, or a
     MAD that is pure floating-point noise relative to the median (the
     classic near-constant-series pitfall where nanoscale jitter earns
-    astronomical z-scores).  ``min_dev`` additionally requires the
-    deviation itself to be material in the samples' own unit."""
+    astronomical z-scores); near-constant-with-a-spike series fall
+    back to the mean absolute deviation (:func:`effective_mad`).
+    ``min_dev`` additionally requires the deviation itself to be
+    material in the samples' own unit."""
     if len(samples) < 4:
         return []
-    med, m = mad(samples)
-    if med is None:
+    med, m = effective_mad(samples)
+    if med is None or m is None:
         return []
-    if not m or m < 1e-9 * max(abs(med), 1.0):
-        # MAD collapses when over half the samples are identical (a
-        # lone spike in an otherwise flat series); fall back to the
-        # mean absolute deviation, which the spike cannot zero out
-        m = sum(abs(v - med) for v in samples) / len(samples)
-        if not m or m < 1e-9 * max(abs(med), 1.0):
-            return []
     return [i for i, v in enumerate(samples)
             if 0.6745 * (v - med) / m > z and (v - med) > min_dev]
 
@@ -396,7 +409,11 @@ def step_anomalies(spans, z=MAD_Z, max_rows=16):
     rows = []
     for phase, vals in samples.items():
         series = [v[0] for v in vals]
-        med, m = mad(series)
+        # effective_mad, not mad: when the MAD collapses (flat series
+        # with a lone spike) robust_outliers flags against the mean-
+        # absolute-deviation fallback, and the z reported here must
+        # use that same deviation or divide by zero
+        med, m = effective_mad(series)
         # min_dev: an anomalous step must ALSO be materially slow
         # (>= MIN_LATE_MS) -- sub-millisecond jitter is scheduler
         # noise however many z-scores it spans

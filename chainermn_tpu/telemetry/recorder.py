@@ -419,20 +419,32 @@ class Recorder:
         except Exception:
             return 0
 
-    def flush(self, outdir=None):
+    def flush(self, outdir=None, blocking=True):
         """Append unwritten events to ``events-rank<N>.jsonl`` and
         rewrite ``metrics-rank<N>.json`` under the session directory.
         Idempotent and incremental; safe to call repeatedly (the
-        enable path registers it atexit)."""
+        enable path registers it atexit).
+
+        ``blocking=False`` is the signal-handler mode: CPython runs
+        handlers between bytecodes of the interrupted thread, so if
+        that thread holds ``_lock`` (it is taken on every span/event
+        close), a blocking acquire here would self-deadlock.  When the
+        lock is unavailable the flush is SKIPPED (returns None) rather
+        than risking a duplicate window; the next boundary flush picks
+        the pending events up."""
         outdir = outdir or self.outdir
         if outdir is None:
             return None
         os.makedirs(outdir, exist_ok=True)
         rank = self._rank()
         epath = os.path.join(outdir, 'events-rank%d.jsonl' % rank)
-        with self._lock:
+        if not self._lock.acquire(blocking=blocking):
+            return None
+        try:
             pending = self.events[self._flushed_upto:]
             self._flushed_upto = len(self.events)
+        finally:
+            self._lock.release()
         with open(epath, 'a') as f:
             if not self._meta_written:
                 f.write(json.dumps({
@@ -451,7 +463,7 @@ class Recorder:
         os.replace(tmp, mpath)
         return epath
 
-    def dump_flight(self, reason, outdir=None, **attrs):
+    def dump_flight(self, reason, outdir=None, blocking=True, **attrs):
         """Crash-safe black-box dump: atomically (tmp + rename, with
         the serializers' write-complete sentinel convention) write
         ``flight-rank<N>.json`` holding the last :data:`FLIGHT_RING`
@@ -466,22 +478,44 @@ class Recorder:
         ``CheckpointCorruptError``), and the preemption SIGTERM hook.
         Latest dump wins (one file per rank); ``n_dumps`` counts how
         many this process wrote.  Best-effort by contract: returns
-        the path or None, never raises."""
+        the path or None, never raises.
+
+        ``blocking=False`` is REQUIRED from signal handlers: the
+        recorder lock is non-reentrant and taken by the interrupted
+        thread on every span close, so blocking on it from a handler
+        self-deadlocks the process.  When the lock cannot be acquired
+        the dump degrades -- the incremental flush is skipped and the
+        ring is snapshotted lock-free (consistent when the holder is
+        the interrupted frame of this same thread; a cross-thread
+        mid-mutation copy is retried, then dropped) -- and the record
+        carries ``degraded: true``."""
         outdir = outdir or self.outdir
         if outdir is None:
             return None
         try:
             try:
-                self.flush(outdir)
+                self.flush(outdir, blocking=blocking)
             except Exception:
                 pass  # the flight record must still be attempted
             rank = self._rank()
-            with self._lock:
-                ring = list(self._flight)
+            locked = self._lock.acquire(blocking=blocking)
+            try:
+                ring = []
+                for _ in range(3):
+                    try:
+                        ring = list(self._flight)
+                        break
+                    except RuntimeError:
+                        # deque mutated mid-copy: only possible on the
+                        # lock-free path with a concurrent appender
+                        continue
                 last_coll = (dict(self._last_collective)
                              if self._last_collective else None)
                 last_p2p = (dict(self._last_p2p)
                             if self._last_p2p else None)
+            finally:
+                if locked:
+                    self._lock.release()
             open_spans = [
                 dict({k: v for k, v in rec.items()
                       if k != 'attrs'}, **(rec.get('attrs') or {}))
@@ -502,6 +536,8 @@ class Recorder:
             }
             if attrs:
                 record['attrs'] = attrs
+            if not locked:
+                record['degraded'] = True  # lock-free snapshot
             record['complete'] = True  # write-complete sentinel
             path = os.path.join(outdir, 'flight-rank%d.json' % rank)
             tmp = path + '.tmp.%d' % os.getpid()

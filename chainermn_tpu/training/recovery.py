@@ -107,12 +107,16 @@ class PreemptionHandler:
         # then drop the crash-safe flight record -- if the scheduler
         # follows this SIGTERM with a SIGKILL before the step
         # boundary, the black box is all that survives.  CPython
-        # handlers run between bytecodes (not true async-signal
-        # context), so the small atomic file write is safe; it
-        # touches no device state and never raises by contract.
+        # handlers run between bytecodes of the interrupted thread --
+        # the SAME thread that takes the recorder's non-reentrant
+        # lock on every span close -- so the dump must never block on
+        # that lock: ``blocking=False`` degrades to a lock-free ring
+        # snapshot instead of self-deadlocking when the signal lands
+        # inside _append/flush.  The write itself touches no device
+        # state and never raises by contract.
         self.preempt_requested = True
         self.received_signal = signum
-        _telemetry.dump_flight('sigterm', signum=signum,
+        _telemetry.dump_flight('sigterm', blocking=False, signum=signum,
                                iteration=getattr(self.updater,
                                                  'iteration', None))
 

@@ -166,6 +166,36 @@ def test_mad_and_robust_outliers():
     assert dx.robust_outliers([5.0] * 10) == []
 
 
+def test_effective_mad_fallback_on_collapsed_mad():
+    # flat series with a lone spike: MAD is 0 but the mean absolute
+    # deviation is not -- this is the deviation robust_outliers flags
+    # against, so z recomputations must use it too
+    series = [5.0] * 8 + [500.0]
+    med, raw_m = dx.mad(series)
+    assert med == 5.0 and raw_m == 0.0
+    med, m = dx.effective_mad(series)
+    assert med == 5.0 and m == pytest.approx(55.0)
+    # truly constant data: no usable deviation at all
+    assert dx.effective_mad([5.0] * 10) == (5.0, None)
+    assert dx.effective_mad([]) == (None, None)
+
+
+def test_step_anomalies_spike_on_flat_series():
+    # regression: the z recomputation used the raw (zero) MAD and
+    # raised ZeroDivisionError whenever robust_outliers flagged via
+    # its mean-absolute-deviation fallback
+    spans = []
+    for it in range(10):  # iteration 0 is warmup-excluded
+        dur = 0.005 if it != 7 else 0.500
+        spans.append({'type': 'span', 'name': 'jitted_step',
+                      'kind': 'compute', 'rank': 0, 't0': it * 1.0,
+                      't1': it * 1.0 + dur, 'iteration': it})
+    rows = dx.step_anomalies(spans)
+    assert rows and rows[0]['iteration'] == 7
+    assert rows[0]['value_ms'] == pytest.approx(500.0, abs=1.0)
+    assert rows[0]['z'] > dx.MAD_Z
+
+
 def test_step_anomalies_attribute_grown_phase(tmp_path):
     recs = []
     for it in range(12):
@@ -211,6 +241,37 @@ def test_flight_dump_roundtrip_and_open_spans(tmp_path):
     assert blocked['source'] == 1 and blocked['seq'] == 2
     # the dump also flushed the event log
     assert os.path.exists(str(tmp_path / 'events-rank0.jsonl'))
+
+
+def test_dump_flight_nonblocking_while_lock_held(tmp_path):
+    # the SIGTERM-handler contract: the recorder lock is taken on
+    # every span close in the interrupted thread, so a handler-time
+    # dump must not block on it.  Run the dump in a helper thread
+    # with a join timeout so a regression to a blocking acquire shows
+    # up as a failed assertion, not a hung test.
+    import threading
+    rec = telemetry.enable(outdir=str(tmp_path))
+    with rec.span('allreduce_obj', kind='collective', seq=4):
+        pass
+    result = {}
+    rec._lock.acquire()
+    try:
+        t = threading.Thread(target=lambda: result.update(
+            path=rec.dump_flight('sigterm', blocking=False, signum=15)))
+        t.start()
+        t.join(10.0)
+        assert not t.is_alive(), 'dump_flight blocked on the held lock'
+    finally:
+        rec._lock.release()
+    assert result['path']
+    f = dx.load_flight_records(str(tmp_path))[0]
+    assert f['reason'] == 'sigterm'
+    assert f['degraded'] is True
+    assert f['last_collective']['seq'] == 4
+    # with the lock free, a later blocking dump is not degraded
+    rec.dump_flight('sigterm', signum=15)
+    f = dx.load_flight_records(str(tmp_path))[0]
+    assert 'degraded' not in f
 
 
 def test_flight_records_skip_torn_files(tmp_path):
