@@ -1790,8 +1790,85 @@ def parse_model(argv):
     return model
 
 
+def measure_recovery(argv):
+    """``--recovery``: the self-healing recovery-time row (ISSUE 9).
+
+    Runs ONE supervised chaos scenario end-to-end on real CPU
+    ``jax.distributed`` worker subprocesses -- rank 1 hard-killed
+    mid-train, the supervisor classifies, elastically shrinks 2 -> 1
+    and resumes from the periodic checkpoint -- and reports the
+    ledger's own recovery accounting: MTTR (failure detection to
+    first post-resume progress) as the row value, with downtime,
+    cause, world sizes and resumed step as fields.  No accelerator
+    involved: this row prices the CONTROL loop, so it stays
+    measurable through TPU outage windows."""
+    import shutil
+    import tempfile
+
+    quick = '--quick' in argv
+    from chainermn_tpu.training.supervisor import (
+        Ledger, RestartPolicy, Supervisor)
+    from chainermn_tpu.utils import failure as _failure
+
+    out = tempfile.mkdtemp(prefix='bench_recovery.')
+    env = dict(os.environ)
+    env['CHAINERMN_TPU_CHAOS'] = 'rank=1;kill_step=@2'
+    steps = 3 if quick else 4
+    policy = RestartPolicy(
+        max_restarts=3, crash_threshold=3,
+        backoff=_failure.Backoff(initial=0.2, factor=2.0,
+                                 max_delay=2.0))
+    sup = Supervisor(
+        nprocs=2, out=out, steps=steps, ckpt_every=1, policy=policy,
+        stall_timeout=90.0, startup_grace=240.0, term_grace=6.0,
+        drain_grace=2.0, attempt_timeout=420.0, oracle=False,
+        env=env)
+    _log('recovery: supervising 2 procs, kill_step=@2 on rank 1, '
+         '%d steps' % steps)
+    t0 = time.monotonic()
+    try:
+        rc = sup.run()
+        wall = time.monotonic() - t0
+        ledger = Ledger.read(os.path.join(out, 'supervisor_ledger.jsonl'))
+        fails = [e for e in ledger if e['event'] == 'failure']
+        recs = [e for e in ledger if e['event'] == 'recovered']
+        comps = [e for e in ledger if e['event'] == 'complete']
+        mttr = comps[0].get('mttr_s') if comps else None
+        result = {
+            'metric': 'supervisor_recovery_mttr_seconds',
+            'unit': 'seconds',
+            'value': mttr,
+            'supervisor_rc': rc,
+            'wall_s': round(wall, 3),
+            'downtime_s': (recs[0]['downtime_s'] if recs else None),
+            'cause': (fails[0]['cause'] if fails else None),
+            'chaos_site': (fails[0].get('chaos_site')
+                           if fails else None),
+            'dead_rank': (fails[0].get('rank') if fails else None),
+            'world_before': 2,
+            'world_after': (comps[0]['world_size'] if comps
+                            else None),
+            'resumed_step': (comps[0].get('resumed_step') if comps
+                             else None),
+            'restarts': (comps[0]['restarts'] if comps else None),
+            'steps': steps,
+            'quick': quick,
+            'backend': 'cpu-subprocess',
+        }
+        if rc != 0 or mttr is None:
+            result['error'] = 'recovery_incomplete'
+        emit(result, rc=0 if rc == 0 and mttr is not None else 1)
+    finally:
+        shutil.rmtree(out, ignore_errors=True)
+
+
 def main():
     argv = [a for a in sys.argv[1:]]
+    if '--recovery' in argv:
+        # self-contained CPU-subprocess scenario: no backend probe,
+        # no watchdog child (the supervisor bounds its own attempts)
+        measure_recovery(argv)
+        return
     model = parse_model(argv)
     # fail fast on flag mistakes BEFORE the backend probe
     parse_batch(argv, model)

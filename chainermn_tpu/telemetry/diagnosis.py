@@ -560,15 +560,29 @@ def crash_analysis(outdir, metas, spans, events, flights,
                 accuse(rank, 'heartbeat froze %.1fs before the newest'
                        % (newest - b.get('time', 0)))
 
+    # fired chaos injections per rank, from the event timeline: the
+    # flight record keeps only the LAST dump's reason (a later typed
+    # or sigterm dump overwrites a chaos one -- e.g. hang_step then
+    # the escalation SIGTERM), so the injection history must come
+    # from the events, which are append-only
+    chaos_events = {}
+    for e in events:
+        name = str(e.get('name') or '')
+        if e.get('kind') == 'chaos' or name.startswith('chaos:'):
+            chaos_events.setdefault(
+                int(e.get('rank', 0)), []).append(name)
+
     # an accused rank may have left no meta/flight/beat of its own
     # (killed before its first flush); it still belongs in the verdict
-    ranks = sorted(set(ranks) | set(dead))
+    ranks = sorted(set(ranks) | set(dead) | set(chaos_events))
     per_rank = {}
     for rank in ranks:
         rec = flights.get(rank)
         state = ('dead' if rank in dead
                  else 'preempted' if rank in preempted else 'alive')
         info = {'state': state, 'why': dead.get(rank, [])}
+        if rank in chaos_events:
+            info['chaos_events'] = chaos_events[rank]
         beat = beats.get(rank)
         if beat is not None:
             info['last_heartbeat_iteration'] = beat.get('iteration')
@@ -701,6 +715,26 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
             'summary': summary,
         },
     }
+
+
+def quick_verdict(outdir, liveness_dirs=()):
+    """Library-callable doctor: the full :func:`diagnose` dict for a
+    capture directory, or ``None`` when there is nothing to diagnose
+    (missing directory, or a capture with no spans, events or flight
+    records).  NEVER raises -- this is the supervisor's cross-check
+    path, and a torn capture from a freshly killed pod must degrade
+    to "no doctor opinion", not crash the component whose whole job
+    is surviving that death."""
+    try:
+        if not os.path.isdir(outdir):
+            return None
+        diag = diagnose(outdir, liveness_dirs=liveness_dirs)
+        if not (diag['n_spans'] or diag['n_events']
+                or diag['n_flight_records']):
+            return None
+        return diag
+    except Exception:
+        return None
 
 
 def skew_summary(spans):

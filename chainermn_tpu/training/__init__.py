@@ -21,5 +21,8 @@ from chainermn_tpu.training import extensions  # noqa
 from chainermn_tpu.training import recovery  # noqa
 from chainermn_tpu.training.recovery import (  # noqa
     PreemptionHandler, auto_resume)
+from chainermn_tpu.training import supervisor  # noqa
+from chainermn_tpu.training.supervisor import (  # noqa
+    Supervisor, RestartPolicy)
 from chainermn_tpu.training import triggers  # noqa
 from chainermn_tpu.training.convert import concat_examples  # noqa

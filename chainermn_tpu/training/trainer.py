@@ -69,25 +69,47 @@ class Trainer:
             os.makedirs(self.out, exist_ok=True)
         start = time.time()
         stop = self.stop_trigger
-        while not (self._stop_requested or stop(self)):
-            if self._async:
-                self.observation = self.updater.update(sync=False)
-                if self.updater.iteration % self._sync_interval == 0:
-                    # fetch ONE scalar: completes everything queued up
-                    # to this step (params chain), bounding run-ahead
-                    import jax
-                    for v in self.observation.values():
-                        jax.device_get(v)  # noqa: shardlint
-                        break
-            else:
-                self.observation = self.updater.update()
-            self.elapsed_time = time.time() - start
-            for entry in sorted(self._extensions,
-                                key=lambda e: -e.priority):
-                if entry.trigger(self):
-                    result = entry.extension(self)
-                    if isinstance(result, dict):
-                        self.observation.update(result)
-                if self._stop_requested:
-                    break  # e.g. preemption checkpoint just written
-        self._done = True
+        try:
+            while not (self._stop_requested or stop(self)):
+                if self._async:
+                    self.observation = self.updater.update(sync=False)
+                    if self.updater.iteration % self._sync_interval == 0:
+                        # fetch ONE scalar: completes everything queued
+                        # up to this step (params chain), bounding
+                        # run-ahead
+                        import jax
+                        for v in self.observation.values():
+                            jax.device_get(v)  # noqa: shardlint
+                            break
+                else:
+                    self.observation = self.updater.update()
+                self.elapsed_time = time.time() - start
+                for entry in sorted(self._extensions,
+                                    key=lambda e: -e.priority):
+                    if entry.trigger(self):
+                        result = entry.extension(self)
+                        if isinstance(result, dict):
+                            self.observation.update(result)
+                    if self._stop_requested:
+                        break  # e.g. preemption checkpoint just written
+        finally:
+            self._done = True
+            self._finalize_extensions()
+
+    def _finalize_extensions(self):
+        """Run every extension's ``finalize`` (when it has one) --
+        resource teardown that must happen however the loop ended:
+        ``heartbeat_extension`` stops its beat thread here (and
+        stamps ``stopped: true``) so a finished trainer cannot keep
+        signalling "alive" to a liveness watcher forever.  A raising
+        finalizer must not mask the loop's own exception or starve
+        its siblings."""
+        for entry in self._extensions:
+            fin = getattr(entry.extension, 'finalize', None)
+            if fin is None:
+                continue
+            try:
+                fin()
+            except Exception:
+                import traceback
+                traceback.print_exc()

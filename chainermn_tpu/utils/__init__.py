@@ -9,8 +9,8 @@ from chainermn_tpu.utils import chaos  # noqa
 from chainermn_tpu.utils.chaos import FaultInjector  # noqa
 from chainermn_tpu.utils.failure import (  # noqa
     NanGuard, DivergenceError, Heartbeat, check_finite, detect_stall,
-    heartbeat_extension, CommFailure, ChannelTimeout, PeerDeadError,
-    Backoff, Deadline, CheckpointCorruptError,
-    CheckpointSkippedWarning)
+    read_heartbeat, heartbeat_extension, CommFailure, ChannelTimeout,
+    PeerDeadError, Backoff, Deadline, CheckpointCorruptError,
+    CheckpointSkippedWarning, exit_code_for, classify_exit)
 from chainermn_tpu.utils.schedules import (  # noqa
     linear_scaled_lr, gradual_warmup, distributed_sgd_schedule)

@@ -59,6 +59,13 @@ Sites (the action is part of the site name):
                     update_core occurrence N (preemption mid-step)
 ``kill_step``       hard-kill (``os._exit(ARG or 42)``) at the start
                     of update_core occurrence N
+``hang_step``       hang this process at the start of update_core
+                    occurrence N: sleep ARG (default 3600) seconds
+                    with the main thread wedged -- heartbeat files
+                    keep getting fresh timestamps but the iteration
+                    freezes, exactly the livelock a supervisor's
+                    progress watch (not a time-based stall probe)
+                    must catch and escalate
 ``kill_recv``       hard-kill at recv_obj call occurrence N (receiver
                     death mid-conversation)
 ``ckpt_kill``       hard-kill (``os._exit(ARG or 43)``) BETWEEN a
@@ -91,8 +98,8 @@ import zlib
 ENV_VAR = 'CHAINERMN_TPU_CHAOS'
 
 SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
-         'nan_batch', 'sigterm_step', 'kill_step', 'kill_recv',
-         'ckpt_kill', 'ckpt_truncate', 'ckpt_flip')
+         'nan_batch', 'sigterm_step', 'kill_step', 'hang_step',
+         'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip')
 
 
 class InjectedFault(RuntimeError):
@@ -203,12 +210,16 @@ class FaultInjector:
             if telemetry._active is not None:
                 telemetry.event('chaos:' + site, kind='chaos',
                                 occurrence=idx, arg=rule.arg)
-                if site in ('kill_step', 'kill_recv', 'ckpt_kill'):
+                if site in ('kill_step', 'kill_recv', 'ckpt_kill',
+                            'hang_step'):
                     # os._exit skips atexit: flush the timeline AND
                     # drop the crash-safe flight record NOW, or the
                     # fatal injection is invisible post-mortem
                     # (dump_flight flushes internally and never
-                    # raises)
+                    # raises).  hang_step dumps too: the hung process
+                    # usually ends SIGKILLed by the supervisor, and
+                    # the flight record is what lets the post-mortem
+                    # name the wedged rank among the frozen ones.
                     telemetry.dump_flight('chaos:' + site,
                                           occurrence=idx)
         return rule if hit else None
@@ -239,6 +250,30 @@ def install(injector):
 def uninstall():
     global _active, _env_checked
     _active, _env_checked = None, False
+
+
+def strip_sites(spec, sites):
+    """``spec`` minus the rules for ``sites`` (``seed=``/``rank=``
+    and every other rule preserved textually; unknown site names in
+    ``sites`` are ignored).
+
+    The supervisor's already-delivered-fault accounting: a
+    deterministic one-shot fault (``kill_step=@3``) that a dead
+    attempt consumed must NOT be re-delivered to the relaunched pod
+    -- per-process occurrence counters restart from zero in a new
+    process, so without stripping, every restart replays the same
+    death and no restart policy can converge.  The supervisor learns
+    *which* site fired from the victim's flight record
+    (``chaos:<site>``) and hands the remaining spec to the next
+    attempt: the environment replays WITHOUT the fault that was
+    already delivered, exactly like a real one-off preemption."""
+    sites = set(sites)
+    kept = []
+    for item in filter(None, (s.strip() for s in spec.split(';'))):
+        if item.partition('=')[0].strip() in sites:
+            continue
+        kept.append(item)
+    return ';'.join(kept)
 
 
 def maybe_install_from_env(env_var=ENV_VAR):
@@ -311,8 +346,10 @@ def on_recv():
 
 def on_step(iteration):
     """Per-train-step hooks: ``sigterm_step`` (graceful preemption --
-    the handler checkpoints and stops) and ``kill_step`` (hard
-    kill)."""
+    the handler checkpoints and stops), ``kill_step`` (hard kill) and
+    ``hang_step`` (wedge the main thread; the heartbeat daemon keeps
+    the liveness file fresh while the iteration freezes -- only a
+    progress-based watcher catches it)."""
     inj = _active
     if inj is None:
         return
@@ -322,6 +359,9 @@ def on_step(iteration):
     r = inj.fires('kill_step')
     if r is not None:
         os._exit(int(r.arg) if r.arg is not None else 42)
+    r = inj.fires('hang_step')
+    if r is not None:
+        time.sleep(r.arg if r.arg is not None else 3600.0)
 
 
 def on_checkpoint_write(tmp_path):

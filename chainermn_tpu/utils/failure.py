@@ -30,6 +30,7 @@ checkpoint + auto-resume); see ``docs/fault_tolerance.md``.
 
 import json
 import os
+import signal as _signal
 import threading
 import time
 
@@ -246,6 +247,75 @@ class DivergenceError(RuntimeError):
     """Raised by NanGuard when training produces non-finite values."""
 
 
+# ----------------------------------------------------------------------
+# Exit-code taxonomy: the typed failures, flattened to the one channel
+# that survives a process death -- its exit status.  The supervisor
+# (:mod:`chainermn_tpu.training.supervisor`) classifies a dead worker
+# from this code first and cross-checks the telemetry doctor's verdict
+# second; ``worker_main`` maps the exceptions on the way out.  Codes
+# live in the 70-79 band (EX_SOFTWARE neighborhood) so they cannot
+# collide with shells (126/127), signals (128+N) or the chaos
+# injector's hard-kill defaults (42/43).
+# ----------------------------------------------------------------------
+
+EXIT_OK = 0
+EXIT_UNCAUGHT = 70         # untyped exception escaped worker_main
+EXIT_PREEMPTED = 71        # clean SIGTERM evacuation, checkpoint written
+EXIT_DIVERGENCE = 72       # NanGuard verdict (DivergenceError)
+EXIT_CHANNEL_TIMEOUT = 73  # bounded wait expired (ChannelTimeout)
+EXIT_PEER_DEAD = 74        # typed peer death observed (PeerDeadError)
+EXIT_CKPT_CORRUPT = 75     # checkpoint trust failure (CheckpointCorruptError)
+
+#: exit status -> taxonomy name (the supervisor's first classifier)
+EXIT_NAMES = {
+    EXIT_OK: 'clean',
+    EXIT_UNCAUGHT: 'uncaught',
+    EXIT_PREEMPTED: 'preempted',
+    EXIT_DIVERGENCE: 'divergence',
+    EXIT_CHANNEL_TIMEOUT: 'channel_timeout',
+    EXIT_PEER_DEAD: 'peer_dead',
+    EXIT_CKPT_CORRUPT: 'checkpoint_corrupt',
+}
+
+
+def exit_code_for(exc):
+    """The taxonomy exit code for an exception instance -- typed
+    failures map to their own code, anything else to
+    :data:`EXIT_UNCAUGHT`.  Subclass checks are ordered most-specific
+    first (``PeerDeadError`` is a ``CommFailure``; ``ChannelTimeout``
+    is also a ``TimeoutError``)."""
+    if isinstance(exc, PeerDeadError):
+        return EXIT_PEER_DEAD
+    if isinstance(exc, ChannelTimeout):
+        return EXIT_CHANNEL_TIMEOUT
+    if isinstance(exc, CheckpointCorruptError):
+        return EXIT_CKPT_CORRUPT
+    if isinstance(exc, DivergenceError):
+        return EXIT_DIVERGENCE
+    return EXIT_UNCAUGHT
+
+
+def classify_exit(returncode):
+    """Taxonomy name for a worker's exit status: ``'clean'`` /
+    ``'running'`` (still alive, status None), a typed name from
+    :data:`EXIT_NAMES`, ``'signal:NAME'`` for signal deaths (Popen
+    reports them as negative), or ``'crash'`` for any other nonzero
+    code (the chaos injector's hard-kill defaults 42/43 land here --
+    deliberately: an ``os._exit`` mid-step looks exactly like a
+    machine loss, and the doctor's flight records are what refine
+    it)."""
+    if returncode is None:
+        return 'running'
+    if returncode == 0:
+        return 'clean'
+    if returncode < 0:
+        try:
+            return 'signal:' + _signal.Signals(-returncode).name
+        except ValueError:
+            return 'signal:%d' % -returncode
+    return EXIT_NAMES.get(returncode, 'crash')
+
+
 class NanGuard:
     """Trainer extension: stop on non-finite metrics (every iteration)
     and, every ``param_interval`` iterations, audit the parameters
@@ -345,13 +415,14 @@ class Heartbeat:
         self._thread.start()
         return self
 
-    def _write(self):
+    def _write(self, stopped=False):
         tmp = self.path + '.tmp'
         with open(tmp, 'w') as f:
             json.dump({'pid': os.getpid(),
                        'process_index': jax.process_index(),
                        'time': time.time(),
-                       'iteration': self.iteration}, f)
+                       'iteration': self.iteration,
+                       'stopped': stopped}, f)
         os.replace(tmp, self.path)
 
     def _run(self):
@@ -368,21 +439,51 @@ class Heartbeat:
             self.iteration = iteration
 
     def stop(self):
+        """Stop the beat thread and stamp a final ``stopped: true``
+        beat, so any observer can distinguish a clean exit from a
+        stall instead of reading one last fresh "alive" timestamp.
+        The final write is guarded like ``_run``'s: teardown on a
+        removed or read-only out dir must not crash the process it
+        was supposed to be cleaning up."""
         self._stop.set()
         if self._thread:
             self._thread.join(timeout=5)
-            self._write()
+            try:
+                self._write(stopped=True)
+            except OSError:
+                pass
 
 
-def detect_stall(path, timeout=60.0, now=None):
-    """True if the heartbeat at ``path`` is older than ``timeout``
-    seconds (or missing) -- the liveness check the reference's MPI
-    stack cannot express short of a hang."""
+def read_heartbeat(path):
+    """The parsed heartbeat dict at ``path``, or None when the file
+    is missing or torn (a beat mid-``os.replace`` can never be torn,
+    but the destination may not exist yet)."""
     try:
         with open(path) as f:
-            beat = json.load(f)
+            return json.load(f)
     except (OSError, ValueError):
-        return True
+        return None
+
+
+def detect_stall(path, timeout=60.0, now=None, missing='stalled'):
+    """True if the heartbeat at ``path`` is older than ``timeout``
+    seconds -- the liveness check the reference's MPI stack cannot
+    express short of a hang.
+
+    ``missing`` decides the never-started case (no file, or an
+    unreadable one): ``'stalled'`` (default; back-compatible --
+    absence of a beat is treated as a stall) or ``'alive'`` (absence
+    is NOT a stall -- the startup-grace mode the supervisor uses
+    while a freshly spawned worker is still booting, so never-started
+    and stalled stop being conflated without call-site
+    special-casing)."""
+    if missing not in ('stalled', 'alive'):
+        raise ValueError(
+            "detect_stall: missing= must be 'stalled' or 'alive', "
+            'got %r' % (missing,))
+    beat = read_heartbeat(path)
+    if beat is None:
+        return missing == 'stalled'
     now = time.time() if now is None else now
     return (now - beat.get('time', 0)) > timeout
 
@@ -402,4 +503,8 @@ def heartbeat_extension(out_dir, interval=10.0):
     ext.priority = 20
     ext.name = 'heartbeat'
     ext.heartbeat = hb
+    # the Trainer calls extension finalizers when the run ends:
+    # without this the daemon thread keeps beating "alive" forever in
+    # a long-lived process -- false liveness to any watcher
+    ext.finalize = hb.stop
     return ext

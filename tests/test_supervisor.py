@@ -1,0 +1,442 @@
+"""Supervisor policy engine, fast half (ISSUE 9).
+
+Everything here runs in milliseconds with NO subprocesses: the policy
+engine, hang escalation and stall watch take injectable clocks and
+fake process tables by design.  The end-to-end proof over real
+``jax.distributed`` worker processes (chaos kill -> classify ->
+elastic shrink -> resume -> oracle match; crash-loop abort; hang ->
+escalation) lives in ``tests/test_supervisor_mp.py`` (slow-marked,
+run by the ci/run_matrix.sh supervisor leg).
+"""
+
+import json
+import os
+
+import pytest
+
+from chainermn_tpu.training import supervisor as sup
+from chainermn_tpu.utils import chaos, failure
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
+
+
+# ----------------------------------------------------------------------
+# exit-code taxonomy
+# ----------------------------------------------------------------------
+
+class TestExitTaxonomy:
+    @pytest.mark.parametrize('exc,code', [
+        (failure.PeerDeadError('x', process_index=1),
+         failure.EXIT_PEER_DEAD),
+        (failure.ChannelTimeout('t'), failure.EXIT_CHANNEL_TIMEOUT),
+        (failure.CheckpointCorruptError('c', kind='crc'),
+         failure.EXIT_CKPT_CORRUPT),
+        (failure.DivergenceError('nan'), failure.EXIT_DIVERGENCE),
+        (RuntimeError('boom'), failure.EXIT_UNCAUGHT),
+    ])
+    def test_exit_code_for(self, exc, code):
+        assert failure.exit_code_for(exc) == code
+
+    def test_classify_exit(self):
+        assert failure.classify_exit(0) == 'clean'
+        assert failure.classify_exit(None) == 'running'
+        assert failure.classify_exit(-9) == 'signal:SIGKILL'
+        assert failure.classify_exit(-15) == 'signal:SIGTERM'
+        assert failure.classify_exit(
+            failure.EXIT_PEER_DEAD) == 'peer_dead'
+        assert failure.classify_exit(
+            failure.EXIT_PREEMPTED) == 'preempted'
+        assert failure.classify_exit(
+            failure.EXIT_CKPT_CORRUPT) == 'checkpoint_corrupt'
+        # the chaos injector's hard-kill default is deliberately NOT
+        # a taxonomy code: an os._exit mid-step reads as a machine
+        # loss until the doctor's flight record refines it
+        assert failure.classify_exit(42) == 'crash'
+
+    def test_every_taxonomy_code_has_a_name(self):
+        for code in (failure.EXIT_OK, failure.EXIT_UNCAUGHT,
+                     failure.EXIT_PREEMPTED, failure.EXIT_DIVERGENCE,
+                     failure.EXIT_CHANNEL_TIMEOUT,
+                     failure.EXIT_PEER_DEAD,
+                     failure.EXIT_CKPT_CORRUPT):
+            assert code in failure.EXIT_NAMES
+
+    def test_worker_main_maps_typed_and_preempted(self):
+        def dies():
+            raise failure.CheckpointCorruptError('bad', kind='crc')
+        with pytest.raises(SystemExit) as ei:
+            sup.worker_main(dies)
+        assert ei.value.code == failure.EXIT_CKPT_CORRUPT
+        with pytest.raises(SystemExit) as ei:
+            sup.worker_main(lambda: 'preempted')
+        assert ei.value.code == failure.EXIT_PREEMPTED
+        with pytest.raises(SystemExit) as ei:
+            sup.worker_main(lambda: None)
+        assert ei.value.code == 0
+
+
+# ----------------------------------------------------------------------
+# restart policy: budget, crash loop, backoff, shrink-vs-restart
+# ----------------------------------------------------------------------
+
+class TestRestartPolicy:
+    def _policy(self, clock, **kw):
+        kw.setdefault('backoff', failure.Backoff(
+            initial=0.5, factor=2.0, max_delay=8.0))
+        return sup.RestartPolicy(clock=clock, **kw)
+
+    def test_restart_budget_exhaustion(self):
+        clock = FakeClock()
+        p = self._policy(clock, max_restarts=2, crash_window=1.0,
+                         crash_threshold=100)
+        d1 = p.on_failure('killed', 2, dead_ranks=[1])
+        clock.t += 100
+        d2 = p.on_failure('uncaught', 2)
+        clock.t += 100
+        d3 = p.on_failure('uncaught', 2)
+        assert d1.action == 'shrink'
+        assert d2.action == 'restart'
+        assert d3.action == 'abort'
+        assert 'restart_budget' in d3.reason
+        assert p.restarts == 2  # the aborted failure spent none
+
+    def test_crash_loop_window(self):
+        clock = FakeClock()
+        p = self._policy(clock, max_restarts=100, crash_window=60.0,
+                         crash_threshold=3)
+        assert p.on_failure('checkpoint_corrupt', 2).action == 'restart'
+        clock.t += 10
+        assert p.on_failure('checkpoint_corrupt', 2).action == 'restart'
+        clock.t += 10
+        d = p.on_failure('checkpoint_corrupt', 2)
+        assert d.action == 'abort'
+        assert 'crash_loop' in d.reason
+
+    def test_crash_loop_needs_failures_inside_window(self):
+        clock = FakeClock()
+        p = self._policy(clock, crash_window=60.0, crash_threshold=3)
+        for _ in range(5):  # spaced failures never trip the window
+            clock.t += 100
+            d = p.on_failure('uncaught', 2)
+            assert d.action == 'restart', d
+        assert p.restarts == 5
+
+    def test_backoff_schedule_paces_restarts(self):
+        clock = FakeClock()
+        p = self._policy(clock, crash_threshold=100)
+        delays = []
+        for _ in range(4):
+            clock.t += 1000
+            delays.append(p.on_failure('uncaught', 2).delay)
+        assert delays == [0.5, 1.0, 2.0, 4.0]
+        p.on_success()  # healthy run resets the schedule
+        clock.t += 1000
+        assert p.on_failure('uncaught', 2).delay == 0.5
+
+    def test_shrink_vs_restart_decision(self):
+        clock = FakeClock()
+        p = self._policy(clock, crash_threshold=100, min_procs=2)
+        # capacity-loss causes with a culprit shrink ...
+        d = p.on_failure('killed', 3, dead_ranks=[1])
+        assert (d.action, d.nprocs) == ('shrink', 2)
+        # ... but never below min_procs
+        clock.t += 1000
+        d = p.on_failure('hang', 2, dead_ranks=[0])
+        assert (d.action, d.nprocs) == ('restart', 2)
+        # state failures restart at full size even with a culprit
+        clock.t += 1000
+        d = p.on_failure('checkpoint_corrupt', 3, dead_ranks=[0])
+        assert (d.action, d.nprocs) == ('restart', 3)
+        clock.t += 1000
+        d = p.on_failure('divergence', 3, dead_ranks=[0])
+        assert d.action == 'restart'
+        # no culprit named -> nothing to subtract
+        clock.t += 1000
+        d = p.on_failure('killed', 3)
+        assert (d.action, d.nprocs) == ('restart', 3)
+
+    def test_describe_is_ledger_serializable(self):
+        p = self._policy(FakeClock())
+        json.dumps(p.describe())
+
+
+# ----------------------------------------------------------------------
+# hang escalation ordering (fake proc table, fake clock)
+# ----------------------------------------------------------------------
+
+class FakeTable:
+    """Scripted process table: ``exits_after[rank]`` seconds after its
+    SIGTERM the rank exits on its own; None means it never does."""
+
+    def __init__(self, exits_after, clock):
+        self.exits_after = dict(exits_after)
+        self.clock = clock
+        self.term_t = {}
+        self.killed = []
+        self.log = []
+
+    def live_ranks(self):
+        out = []
+        for r, dt in sorted(self.exits_after.items()):
+            if r in self.killed:
+                continue
+            t0 = self.term_t.get(r)
+            if t0 is not None and dt is not None \
+                    and self.clock() - t0 >= dt:
+                continue
+            out.append(r)
+        return out
+
+    def terminate(self, rank):
+        self.term_t[rank] = self.clock()
+        self.log.append(('sigterm', rank))
+
+    def kill(self, rank):
+        self.killed.append(rank)
+        self.log.append(('sigkill', rank))
+
+
+class TestEscalation:
+    def test_graceful_exit_within_grace_no_sigkill(self):
+        clock = FakeClock()
+        table = FakeTable({0: 0.3, 1: 0.5}, clock)
+        log = sup.escalate(table, term_grace=5.0, clock=clock,
+                           sleep=clock.sleep, poll_interval=0.1)
+        assert log == [('sigterm', 0), ('sigterm', 1)]
+        assert table.killed == []
+        assert clock.t < 5.0  # returned as soon as everyone left
+
+    def test_stragglers_sigkilled_only_after_grace(self):
+        clock = FakeClock()
+        table = FakeTable({0: 0.2, 1: None}, clock)
+        log = sup.escalate(table, term_grace=2.0, clock=clock,
+                           sleep=clock.sleep, poll_interval=0.1)
+        # ordering: every SIGTERM precedes any SIGKILL; only the
+        # unresponsive rank is killed, and only once the grace passed
+        assert log[:2] == [('sigterm', 0), ('sigterm', 1)]
+        assert log[2:] == [('sigkill', 1)]
+        assert clock.t >= 2.0
+
+    def test_already_dead_ranks_untouched(self):
+        clock = FakeClock()
+        table = FakeTable({1: None}, clock)  # rank 0 already gone
+        log = sup.escalate(table, term_grace=0.5, clock=clock,
+                           sleep=clock.sleep)
+        assert ('sigterm', 0) not in log
+        assert ('sigkill', 0) not in log
+
+
+# ----------------------------------------------------------------------
+# stall watch: missing/fresh/stale x grace, frozen-iteration hangs
+# ----------------------------------------------------------------------
+
+def _beat(live, rank, t, iteration, stopped=False):
+    os.makedirs(live, exist_ok=True)
+    with open(os.path.join(live, 'heartbeat-%d.json' % rank),
+              'w') as f:
+        json.dump({'pid': 1, 'process_index': rank, 'time': t,
+                   'iteration': iteration, 'stopped': stopped}, f)
+
+
+class TestStallWatch:
+    def _watch(self, tmp_path, clock, **kw):
+        kw.setdefault('stall_timeout', 5.0)
+        kw.setdefault('startup_grace', 30.0)
+        return sup.StallWatch(str(tmp_path), [0, 1], clock=clock, **kw)
+
+    def test_missing_file_inside_grace_is_alive(self, tmp_path):
+        clock = FakeClock(100.0)
+        w = self._watch(tmp_path, clock)
+        assert w.poll() == []
+
+    def test_missing_file_after_grace_is_stalled(self, tmp_path):
+        clock = FakeClock(100.0)
+        w = self._watch(tmp_path, clock)
+        clock.t += 31.0
+        assert w.poll() == [0, 1]
+
+    def test_frozen_iteration_after_progress_is_hang(self, tmp_path):
+        import time as _time
+        clock = FakeClock(_time.time())
+        w = self._watch(tmp_path, clock)
+        _beat(str(tmp_path), 0, clock.t, 1)
+        _beat(str(tmp_path), 1, clock.t, 1)
+        assert w.poll() == []
+        clock.t += 2.0
+        _beat(str(tmp_path), 0, clock.t, 2)  # rank 0 progresses
+        _beat(str(tmp_path), 1, clock.t, 2)
+        assert w.poll() == []
+        assert w.first_progress_t is not None
+        # rank 1's iteration freezes but its beat TIME stays fresh
+        # (daemon thread alive, main thread wedged): only the
+        # progress probe can catch this
+        for dt in (2.0, 2.0, 2.0):
+            clock.t += dt
+            _beat(str(tmp_path), 0, clock.t, int(clock.t))
+            _beat(str(tmp_path), 1, clock.t, 2)
+        assert w.poll() == [1]
+
+    def test_stopped_beat_is_exempt(self, tmp_path):
+        import time as _time
+        clock = FakeClock(_time.time())
+        w = self._watch(tmp_path, clock)
+        _beat(str(tmp_path), 0, clock.t, 3)
+        _beat(str(tmp_path), 1, clock.t, 3, stopped=True)
+        clock.t += 40.0
+        _beat(str(tmp_path), 0, clock.t, 9)
+        # rank 1 exited cleanly: never a stall verdict, even with the
+        # grace long gone and its file old
+        assert w.poll() == []
+
+    def test_stale_file_after_grace_is_stalled(self, tmp_path):
+        import time as _time
+        clock = FakeClock(_time.time())
+        w = self._watch(tmp_path, clock, startup_grace=1.0)
+        _beat(str(tmp_path), 0, clock.t, 1)
+        _beat(str(tmp_path), 1, clock.t, 1)
+        assert w.poll() == []
+        clock.t += 10.0  # no new beats at all: both threads dead
+        _beat(str(tmp_path), 0, clock.t, 2)
+        assert w.poll() == [1]
+
+
+# ----------------------------------------------------------------------
+# classification: exit codes cross-checked with the doctor
+# ----------------------------------------------------------------------
+
+def _doctor(dead_ranks=(), flights=None):
+    return {
+        'crash': {'per_rank': {
+            r: {'flight_reason': reason}
+            for r, reason in (flights or {}).items()}},
+        'verdict': {'dead_ranks': list(dead_ranks),
+                    'summary': ['test']},
+    }
+
+
+class TestClassifyFailure:
+    def test_typed_exit_code_wins(self):
+        cause, culprit, details = sup.classify_failure(
+            (0, failure.EXIT_CKPT_CORRUPT),
+            {0: failure.EXIT_CKPT_CORRUPT, 1: -9})
+        assert cause == 'checkpoint_corrupt'
+        assert culprit == 0
+        assert details['exit_classes'][1] == 'signal:SIGKILL'
+
+    def test_doctor_refines_unknown_crash_to_chaos_kill(self):
+        doc = _doctor(dead_ranks=[1],
+                      flights={1: 'chaos:kill_step'})
+        cause, culprit, details = sup.classify_failure(
+            (1, 42), {0: -9, 1: 42, 2: -9}, doctor=doc)
+        assert cause == 'killed'
+        assert culprit == 1
+        assert details['chaos_site'] == 'kill_step'
+        assert details['doctor_agrees'] is True
+
+    def test_survivor_peer_dead_reattributed_to_corpse(self):
+        doc = _doctor(dead_ranks=[1],
+                      flights={0: 'PeerDeadError',
+                               1: 'chaos:kill_recv'})
+        cause, culprit, details = sup.classify_failure(
+            (0, failure.EXIT_PEER_DEAD),
+            {0: failure.EXIT_PEER_DEAD, 1: 42}, doctor=doc)
+        assert cause == 'killed'
+        assert culprit == 1
+        assert details['chaos_site'] == 'kill_recv'
+
+    def test_hang_culprit_from_flight_record(self):
+        doc = _doctor(flights={1: 'chaos:hang_step'})
+        cause, culprit, details = sup.classify_failure(
+            None, {0: -9, 1: -9}, doctor=doc, hang_ranks=(0, 1))
+        assert cause == 'hang'
+        assert culprit == 1
+        assert details['chaos_site'] == 'hang_step'
+        assert details['hang_ranks'] == [0, 1]
+
+    def test_single_hang_rank_is_culprit_without_doctor(self):
+        cause, culprit, _ = sup.classify_failure(
+            None, {0: -9, 1: 0}, hang_ranks=(0,))
+        assert (cause, culprit) == ('hang', 0)
+
+    def test_ambiguous_hang_without_doctor_has_no_culprit(self):
+        cause, culprit, _ = sup.classify_failure(
+            None, {0: -9, 1: -9}, hang_ranks=(0, 1))
+        assert cause == 'hang'
+        assert culprit is None  # policy will restart, not shrink
+
+    def test_sigterm_death_is_killed(self):
+        cause, culprit, details = sup.classify_failure(
+            (1, -15), {0: 0, 1: -15})
+        assert (cause, culprit) == ('killed', 1)
+        assert details['signal'] == 'SIGTERM'
+
+
+# ----------------------------------------------------------------------
+# ledger
+# ----------------------------------------------------------------------
+
+class TestLedger:
+    def test_append_read_roundtrip(self, tmp_path):
+        path = str(tmp_path / 'supervisor_ledger.jsonl')
+        led = sup.Ledger(path)
+        led.append('start', nprocs=3)
+        led.append('failure', cause='killed', rank=1,
+                   doctor_dead_ranks=[1])
+        led.append('decision', action='shrink', world_before=3,
+                   world_after=2)
+        entries = sup.Ledger.read(path)
+        assert [e['event'] for e in entries] == [
+            'start', 'failure', 'decision']
+        assert entries[1]['cause'] == 'killed'
+        assert entries[2]['world_after'] == 2
+        assert all('t' in e for e in entries)
+
+    def test_read_skips_torn_tail(self, tmp_path):
+        path = str(tmp_path / 'l.jsonl')
+        sup.Ledger(path).append('start', nprocs=2)
+        with open(path, 'a') as f:
+            f.write('{"event": "fail')  # torn mid-write
+        assert [e['event'] for e in sup.Ledger.read(path)] == ['start']
+
+    def test_read_missing_file_is_empty(self, tmp_path):
+        assert sup.Ledger.read(str(tmp_path / 'nope.jsonl')) == []
+
+
+# ----------------------------------------------------------------------
+# chaos: hang_step site + supervisor fault accounting
+# ----------------------------------------------------------------------
+
+class TestChaosSupervisorSites:
+    def test_hang_step_parses_and_fires(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr(chaos.time, 'sleep',
+                            lambda s: slept.append(s))
+        inj = chaos.FaultInjector('hang_step=@1:0.25')
+        chaos.install(inj)
+        try:
+            chaos.on_step(0)
+            assert slept == []
+            chaos.on_step(1)
+            assert slept == [0.25]
+        finally:
+            chaos.uninstall()
+
+    def test_strip_sites_preserves_everything_else(self):
+        spec = 'seed=7;rank=1;kill_step=@3;ckpt_flip=*;delay_send=p0.5'
+        out = chaos.strip_sites(spec, ['kill_step'])
+        assert out == 'seed=7;rank=1;ckpt_flip=*;delay_send=p0.5'
+        # stripping the only rule leaves a valid (possibly att-only)
+        # spec; unknown names are ignored
+        assert chaos.strip_sites('kill_step=@3', ['kill_step']) == ''
+        assert chaos.strip_sites(spec, ['nope']) == spec
+        # the stripped spec still parses
+        chaos.parse_spec(out)

@@ -1,0 +1,200 @@
+"""Supervisor end-to-end over REAL jax.distributed processes
+(ISSUE 9 acceptance).  One ``python -m chainermn_tpu.supervisor``
+invocation per scenario -- no manual relaunch anywhere:
+
+- chaos ``kill_step`` mid-train: detected, classified to the same
+  rank the doctor accuses, elastically shrunk N -> N-1, resumed from
+  the periodic checkpoint, and the finished run matches the
+  fixed-topology oracle (atol 1e-4) -- with the ledger naming the
+  rank, the cause, the resumed step and the recovery downtime;
+- a crash-looping run (checkpoint corrupted on every restart -> each
+  relaunch dies typed ``EXIT_CKPT_CORRUPT``) aborts within its
+  restart budget with a non-zero exit and a machine-readable ledger
+  verdict;
+- a chaos ``hang_step`` wedge (heartbeat time fresh, iteration
+  frozen): the progress watch catches it, escalation runs SIGTERM ->
+  grace -> SIGKILL, the doctor's chaos-event history names the wedged
+  rank, and the pod comes back smaller and finishes.
+
+The fast policy units (no subprocesses) are in
+``tests/test_supervisor.py``; ``ci/run_matrix.sh`` runs this file in
+its supervisor leg.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.training.supervisor import Ledger
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: flags sized for CI: short grace/backoff so a scenario stays in
+#: tens of seconds, stall detection slower than a CPU compile
+FAST_FLAGS = ['--steps', '6', '--drain-grace', '3',
+              '--term-grace', '6', '--backoff-initial', '0.2',
+              '--startup-grace', '150', '--attempt-timeout', '360']
+
+
+def _run_supervisor(out, args, chaos=None, timeout=420):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS',
+                        'CHAINERMN_TPU_CHAOS',
+                        'CHAINERMN_TPU_TELEMETRY')}
+    env['PYTHONPATH'] = (
+        ROOT + os.pathsep + env.get('PYTHONPATH', ''))
+    if chaos:
+        env['CHAINERMN_TPU_CHAOS'] = chaos
+    proc = subprocess.run(
+        [sys.executable, '-m', 'chainermn_tpu.supervisor',
+         '--out', str(out)] + FAST_FLAGS + args,
+        env=env, capture_output=True, text=True, timeout=timeout)
+    ledger = Ledger.read(os.path.join(str(out), 'supervisor_ledger.jsonl'))
+    return proc, ledger
+
+
+def _events(ledger, kind):
+    return [e for e in ledger if e['event'] == kind]
+
+
+def _worker_json(out, attempt, rank):
+    path = os.path.join(str(out), 'workers',
+                        'a%d-rank%d.json' % (attempt, rank))
+    with open(path) as f:
+        return json.load(f)
+
+
+@pytest.mark.slow
+def test_chaos_kill_classified_shrunk_resumed_matches_oracle(tmp_path):
+    """THE acceptance run: ``rank=1;kill_step=@3`` at 3 procs -- one
+    supervisor invocation finishes training at 2 procs with the final
+    params matching the fixed-topology oracle, the ledger naming rank
+    1, the classified cause, and the resumed step."""
+    out = tmp_path / 'run'
+    proc, ledger = _run_supervisor(
+        out, ['-n', '3', '--stall-timeout', '60'],
+        chaos='rank=1;kill_step=@3')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # CLASSIFY: the ledger names rank 1 with the injected site, and
+    # the doctor's independent verdict accuses the same rank
+    fails = _events(ledger, 'failure')
+    assert len(fails) == 1, fails
+    f = fails[0]
+    assert f['cause'] == 'killed'
+    assert f['rank'] == 1
+    assert f['chaos_site'] == 'kill_step'
+    assert 1 in f['doctor_dead_ranks']
+    assert f['doctor_agrees'] is True
+    assert f['world_size'] == 3
+
+    # DECIDE: elastic shrink 3 -> 2 (not a same-size restart)
+    decs = _events(ledger, 'decision')
+    assert len(decs) == 1
+    assert decs[0]['action'] == 'shrink'
+    assert (decs[0]['world_before'], decs[0]['world_after']) == (3, 2)
+
+    # RESUME + RECORD: recovered from the periodic checkpoint at
+    # iteration 2, with downtime measured; completed at 2 procs
+    recs = _events(ledger, 'recovered')
+    assert len(recs) == 1
+    assert recs[0]['resumed_step'] == 2
+    assert recs[0]['downtime_s'] > 0
+    comp = _events(ledger, 'complete')
+    assert len(comp) == 1
+    assert comp[0]['world_size'] == 2
+    assert comp[0]['resumed_step'] == 2
+    assert comp[0]['restarts'] == 1
+    assert comp[0]['mttr_s'] == recs[0]['downtime_s']
+
+    # the finished run matches the fixed-topology oracle: the
+    # resumed-attempt losses continue the uninterrupted curve and the
+    # final params agree to atol 1e-4, on every surviving rank
+    for rank in (0, 1):
+        res = _worker_json(out, 1, rank)
+        assert res['world_size'] == 2
+        assert res['resumed_at'] == 2
+        assert res['final_iteration'] == 6
+        np.testing.assert_allclose(res['losses'], res['oracle'][2:],
+                                   rtol=0, atol=1e-5)
+        assert abs(res['param_sum'] - res['oracle_param_sum']) < 1e-4
+    assert (_worker_json(out, 1, 0)['param_sum']
+            == pytest.approx(_worker_json(out, 1, 1)['param_sum'],
+                             abs=1e-6))
+
+    # per-rank log capture: one file per (attempt, rank), non-empty
+    logs = sorted(os.listdir(os.path.join(str(out), 'logs')))
+    assert {'a0-rank0.log', 'a0-rank1.log', 'a0-rank2.log',
+            'a1-rank0.log', 'a1-rank1.log'} <= set(logs)
+
+
+@pytest.mark.slow
+def test_crash_loop_aborts_within_budget_with_ledger_verdict(tmp_path):
+    """Checkpoint corrupted on every restart (``ckpt_flip=*``): each
+    relaunch finds snapshots but none valid, dies typed
+    ``EXIT_CKPT_CORRUPT``, and the supervisor aborts within its
+    restart budget with a non-zero exit and a machine-readable
+    crash-loop verdict."""
+    out = tmp_path / 'run'
+    proc, ledger = _run_supervisor(
+        out, ['-n', '2', '--stall-timeout', '60',
+              '--crash-threshold', '3', '--max-restarts', '8'],
+        chaos='rank=0;kill_step=@3;ckpt_flip=*')
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+
+    fails = _events(ledger, 'failure')
+    # first failure is the injected kill; every later one is the
+    # typed checkpoint-trust refusal from the relaunch
+    assert fails[0]['cause'] == 'killed'
+    assert all(f['cause'] == 'checkpoint_corrupt'
+               for f in fails[1:]), fails
+    assert all(75 in f['rank_exit_codes'].values()
+               for f in fails[1:])
+    aborts = _events(ledger, 'abort')
+    assert len(aborts) == 1
+    assert 'crash_loop' in aborts[0]['reason']
+    assert aborts[0]['restarts'] <= 8  # within the budget
+    assert not _events(ledger, 'complete')
+
+
+@pytest.mark.slow
+def test_hang_escalated_culprit_named_and_pod_shrinks(tmp_path):
+    """Chaos ``hang_step`` wedges rank 1's main thread while its
+    heartbeat daemon keeps the file fresh: only the supervisor's
+    frozen-iteration probe can see it.  Escalation (SIGTERM grace ->
+    SIGKILL) ends the attempt, the doctor's chaos-event history names
+    the wedged rank (its flight record was overwritten by the
+    escalation SIGTERM dump -- exactly the case the event history
+    exists for), and the pod resumes smaller and finishes."""
+    out = tmp_path / 'run'
+    proc, ledger = _run_supervisor(
+        out, ['-n', '2', '--stall-timeout', '8'],
+        chaos='rank=1;hang_step=@3')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    fails = _events(ledger, 'failure')
+    assert len(fails) == 1
+    f = fails[0]
+    assert f['cause'] == 'hang'
+    assert f['rank'] == 1
+    assert f['chaos_site'] == 'hang_step'
+    assert sorted(f['hang_ranks']) == [0, 1]  # victim froze too
+    # the hung rank was SIGKILLed by the escalation ladder (it sat in
+    # a 1-hour sleep; SIGTERM could not move it)
+    assert f['exit_classes']['1'] in ('signal:SIGKILL',
+                                      'signal:SIGTERM')
+    decs = _events(ledger, 'decision')
+    assert decs[0]['action'] == 'shrink'
+    assert (decs[0]['world_before'], decs[0]['world_after']) == (2, 1)
+    comp = _events(ledger, 'complete')
+    assert len(comp) == 1
+    assert comp[0]['world_size'] == 1
+    assert comp[0]['resumed_step'] == 2
+    res = _worker_json(out, 1, 0)
+    np.testing.assert_allclose(res['losses'], res['oracle'][2:],
+                               rtol=0, atol=1e-5)
+    assert abs(res['param_sum'] - res['oracle_param_sum']) < 1e-4

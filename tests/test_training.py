@@ -158,6 +158,32 @@ def test_iteration_stop_trigger_runs(tmp_path):
     assert upd.iteration == 3
 
 
+def test_trainer_finalizes_extensions(tmp_path):
+    """ISSUE 9: extensions with a ``finalize`` are torn down when the
+    run ends -- normally AND when the loop raises (the
+    heartbeat_extension daemon-thread-leak fix rides this hook)."""
+    tr, upd = _small_trainer(tmp_path)
+    done = []
+
+    def probe(t):
+        pass
+    probe.finalize = lambda: done.append('probe')
+    tr.extend(probe, trigger=(1, 'iteration'), name='probe')
+    tr.run()
+    assert done == ['probe']
+
+    tr2, _ = _small_trainer(tmp_path)
+    tr2.extend(probe, trigger=(1, 'iteration'), name='probe')
+
+    def boom(t):
+        raise RuntimeError('loop died')
+    tr2.extend(boom, trigger=(2, 'iteration'), name='boom')
+    done.clear()
+    with pytest.raises(RuntimeError):
+        tr2.run()
+    assert done == ['probe']  # finalized despite the crash
+
+
 def test_log_report_averages(tmp_path):
     tr, upd = _small_trainer(tmp_path, n_epoch=1)
     log = extensions.LogReport()

@@ -79,6 +79,62 @@ class TestHeartbeat:
     def test_missing_file_is_stall(self, tmp_path):
         assert utils.detect_stall(str(tmp_path / 'nope.json'))
 
+    def test_detect_stall_missing_mode_three_states(self, tmp_path):
+        """ISSUE 9 satellite: never-started (missing file), fresh and
+        stale are three DISTINCT states -- ``missing=`` lets a
+        supervisor apply a startup grace without special-casing."""
+        path = str(tmp_path / 'hb.json')
+        # 1. missing: verdict is the caller's policy
+        assert utils.detect_stall(path, missing='stalled') is True
+        assert utils.detect_stall(path, missing='alive') is False
+        with pytest.raises(ValueError):
+            utils.detect_stall(path, missing='maybe')
+        # 2. fresh: not a stall under either mode
+        hb = utils.Heartbeat(path, interval=0.05).start()
+        time.sleep(0.1)
+        hb.stop()
+        assert utils.detect_stall(path, timeout=60,
+                                  missing='alive') is False
+        assert utils.detect_stall(path, timeout=60,
+                                  missing='stalled') is False
+        # 3. stale: a stall under either mode (missing= is about
+        # absence only, never about age)
+        late = time.time() + 100
+        assert utils.detect_stall(path, timeout=1.0, now=late,
+                                  missing='alive') is True
+        assert utils.detect_stall(path, timeout=1.0, now=late,
+                                  missing='stalled') is True
+
+    def test_stop_stamps_stopped_and_survives_removed_dir(self,
+                                                         tmp_path):
+        """ISSUE 9 satellite: the final beat carries ``stopped: true``
+        (clean exit vs stall is observable), and teardown on a
+        removed out dir must not crash the process."""
+        d = tmp_path / 'live'
+        d.mkdir()
+        path = str(d / 'hb.json')
+        hb = utils.Heartbeat(path, interval=0.05).start()
+        time.sleep(0.1)
+        hb.stop()
+        beat = utils.read_heartbeat(path)
+        assert beat['stopped'] is True
+        # mid-run beats are NOT stamped
+        hb2 = utils.Heartbeat(str(d / 'hb2.json'),
+                              interval=0.02).start()
+        time.sleep(0.1)
+        assert utils.read_heartbeat(str(d / 'hb2.json'))[
+            'stopped'] is False
+        hb2.stop()
+        # teardown on a vanished directory: no crash (long interval
+        # so the daemon wrote exactly once and is idle when the dir
+        # disappears under it)
+        import shutil
+        hb3 = utils.Heartbeat(str(d / 'sub' / 'hb3.json'),
+                              interval=30.0).start()
+        time.sleep(0.1)
+        shutil.rmtree(str(d / 'sub'))
+        hb3.stop()  # must not raise
+
     def test_extension_wiring(self, tmp_path):
         ext = utils.heartbeat_extension(str(tmp_path), interval=0.05)
         ext(_FakeTrainer({'loss': 0.0}))
@@ -87,6 +143,20 @@ class TestHeartbeat:
         assert any(f.startswith('heartbeat-') for f in files)
         with open(os.path.join(tmp_path, files[0])) as f:
             assert json.load(f)['iteration'] == 100
+
+    def test_extension_finalizer_stops_beat_thread(self, tmp_path):
+        """ISSUE 9 satellite: the extension carries a ``finalize``
+        wired to ``hb.stop()`` -- a finished trainer must not keep
+        beating "alive" forever from its daemon thread."""
+        ext = utils.heartbeat_extension(str(tmp_path), interval=0.05)
+        ext(_FakeTrainer({'loss': 0.0}))
+        assert ext.finalize == ext.heartbeat.stop
+        ext.finalize()
+        assert not ext.heartbeat._thread.is_alive()
+        files = [f for f in os.listdir(tmp_path)
+                 if f.startswith('heartbeat-')]
+        beat = utils.read_heartbeat(os.path.join(tmp_path, files[0]))
+        assert beat['stopped'] is True
 
 
 class TestProfiling:
