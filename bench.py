@@ -75,7 +75,14 @@ when a banked MFU-sweep artifact crowns a faster one; see
 carry ``tp``/``mesh``/per-axis collective bytes and the PERF.md
 90-115k tok/s/chip anchor; ``docs/mesh_parallelism.md``),
 ``--donate`` (resnet50 only: donation + remat headline arm -- how
-real training runs; PERF.md knob #6).
+real training runs; PERF.md knob #6),
+``--serve`` (open-loop serving arm over
+``chainermn_tpu/serving`` -- AOT per-bucket executables + dynamic
+batching; the row's value is served req/s/chip with p50/p99 latency
+from telemetry histograms, pad-waste fraction, bucket hit-rate and
+typed-shed fraction; ``--int8`` serves int8-quantized weights,
+``--serve-rate``/``--serve-requests``/``--serve-max-batch`` tune the
+load; see ``docs/serving.md``).
 """
 
 import json
@@ -151,6 +158,12 @@ _log.t0 = time.monotonic()
 
 
 def metric_stub(model):
+    if model.startswith('serve_'):
+        # the serving arms (--serve): request throughput, not
+        # training items -- 'serve_<model>' keys the banked-artifact
+        # lookup at bench_serve_<model>_rN.out
+        return {'metric': '%s_requests_per_sec_per_chip' % model,
+                'unit': 'req/sec/chip'}
     unit = {'seq2seq': 'tokens/sec/chip',
             'transformer': 'tokens/sec/chip',
             'mlp': 'images/sec/chip'}.get(model, 'images/sec/chip')
@@ -1862,12 +1875,209 @@ def measure_recovery(argv):
         shutil.rmtree(out, ignore_errors=True)
 
 
+#: serve-row sidecar fields carried through backend_unavailable
+#: windows (the serving twin of BANKED_SIDECAR_KEYS)
+SERVE_SIDECAR_KEYS = (
+    'latency_p50_ms', 'latency_p99_ms', 'pad_waste_fraction',
+    'bucket_hit_rate', 'shed_fraction', 'capacity_req_per_s')
+
+
+def _flag_value(argv, flag, default, cast=float):
+    if flag not in argv:
+        return default
+    i = argv.index(flag)
+    if i + 1 >= len(argv):
+        emit(dict(metric_stub('resnet50'), value=0.0,
+                  vs_baseline=0.0, error='bad_flag',
+                  detail='%s needs a value' % flag), rc=1)
+    try:
+        return cast(argv[i + 1])
+    except ValueError:
+        emit(dict(metric_stub('resnet50'), value=0.0,
+                  vs_baseline=0.0, error='bad_flag',
+                  detail='%s %r' % (flag, argv[i + 1])), rc=1)
+
+
+def measure_serve(argv):
+    """``--serve``: the open-loop serving row (ISSUE 10).
+
+    Builds a zoo model's :class:`~chainermn_tpu.serving.
+    InferenceEngine` (AOT per-bucket executables over the persistent
+    compile cache, ``--int8`` for the quantized-weight policy),
+    probes its batch capacity, then offers an OPEN-loop request
+    stream ABOVE capacity by default (``--serve-rate`` overrides) so
+    the row measures the whole contract: served req/s/chip as the
+    value, p50/p99 latency from the telemetry raw-sample histograms,
+    pad-waste fraction, bucket hit-rate, and the typed-shed fraction
+    -- overload degrading gracefully IS the product claim
+    (``docs/serving.md``)."""
+    quick = '--quick' in argv
+    model_name = parse_model(argv)
+    stub = metric_stub('serve_' + model_name)
+
+    import numpy as np
+
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         '.jax_compile_cache')
+    from chainermn_tpu.utils.platform import enable_host_cpu_backend
+    enable_host_cpu_backend()
+    if '--cpu' in argv:
+        from chainermn_tpu.utils import force_host_devices
+        force_host_devices(8)
+    n_dev = jax.device_count()
+    on_cpu = jax.default_backend() == 'cpu'
+    _log('serve: backend=%s n_dev=%d model=%s'
+         % (jax.default_backend(), n_dev, model_name))
+
+    from chainermn_tpu import serving
+    from chainermn_tpu.precision import (Int8Policy, Policy,
+                                         quantization_error)
+
+    int8 = '--int8' in argv
+    if int8:
+        policy = Int8Policy() if on_cpu else Int8Policy.bf16()
+    else:
+        policy = None if on_cpu else Policy.bf16()
+
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    if model_name == 'mlp':
+        from chainermn_tpu.models import MLP
+        model = MLP(n_units=1000, n_out=10)
+        example = rng.rand(784).astype(np.float32)
+        variables = init_on_host(
+            model.init, jax.random.PRNGKey(0), jnp.zeros((1, 784)))
+        apply_kwargs = {}
+    elif model_name in ('resnet50', 'vgg16', 'googlenetbn'):
+        from chainermn_tpu import models as zoo
+        insize = 64 if (quick or on_cpu) else 224
+        model = zoo.get_arch(model_name, num_classes=1000)
+        example = rng.rand(insize, insize, 3).astype(np.float32)
+        variables = init_on_host(
+            model.init, {'params': jax.random.PRNGKey(0)},
+            jnp.zeros((1, insize, insize, 3)), train=False)
+        apply_kwargs = {'train': False}
+    else:
+        emit(dict(stub, value=0.0, vs_baseline=0.0,
+                  error='unknown_model',
+                  detail='--serve supports mlp/resnet50/vgg16/'
+                         'googlenetbn, got %r' % model_name), rc=1)
+
+    max_batch = int(_flag_value(argv, '--serve-max-batch',
+                                32 if not on_cpu else 16, int))
+    engine = serving.InferenceEngine.for_model(
+        model, variables, example, apply_kwargs=apply_kwargs,
+        max_batch=max_batch, policy=policy, cache_dir=cache)
+    _log('serve: warmup over buckets %s (AOT + persistent cache)'
+         % list(engine.edges))
+    t0 = time.perf_counter()
+    aot_map = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    # capacity probe: steady-state max-bucket throughput bounds what
+    # any admission policy can serve; the offered rate defaults to
+    # 2x it so the row exercises overload shedding for real
+    big = engine.edges[-1]
+    x = np.repeat(example[None], big, axis=0)
+    engine.infer(x)
+    t0 = time.perf_counter()
+    probe_reps = 3 if quick else 6
+    for _ in range(probe_reps):
+        engine.infer(x)
+    batch_s = (time.perf_counter() - t0) / probe_reps
+    max_items = max(1, max_batch // 2)
+    mean_req_items = (1 + max_items) / 2.0
+    capacity = big / batch_s / mean_req_items
+    rate = _flag_value(argv, '--serve-rate', 2.0 * capacity)
+    n_requests = int(_flag_value(argv, '--serve-requests',
+                                 200 if quick else 1000, int))
+    _log('serve: capacity ~%.0f req/s; offering %.0f req/s x %d '
+         'requests' % (capacity, rate, n_requests))
+
+    queue = serving.RequestQueue(
+        max_batch=max_batch, max_wait=0.005,
+        max_queue=max(4 * max_batch, 64), edges=engine.edges)
+    rep = serving.open_loop(engine, queue, rate=rate,
+                            n_requests=n_requests, seed=0)
+
+    row = dict(
+        stub,
+        value=round(rep['served_req_per_s'] / n_dev, 2),
+        # no serving baseline exists yet -- first round of this
+        # metric family; the reference never served (PAPER.md)
+        vs_baseline=0.0,
+        baseline_derivation='none: first serving metric family '
+                            'round (reference has no serving path)',
+        n_devices=n_dev,
+        backend=jax.default_backend(),
+        device_kind=jax.devices()[0].device_kind,
+        quick=quick,
+        model=model_name,
+        offered_req_per_s=round(rate, 1),
+        capacity_req_per_s=round(capacity, 1),
+        served_req_per_s=round(rep['served_req_per_s'], 2),
+        latency_p50_ms=rep['latency_p50_ms'],
+        latency_p99_ms=rep['latency_p99_ms'],
+        queue_wait_p50_ms=rep['queue_wait_p50_ms'],
+        queue_wait_p99_ms=rep['queue_wait_p99_ms'],
+        pad_waste_fraction=rep['pad_waste_fraction'],
+        bucket_hit_rate=rep['bucket_hit_rate'],
+        shed_fraction=round(rep['shed_fraction'], 4),
+        served=rep['served'],
+        offered=rep['offered'],
+        buckets=list(engine.edges),
+        max_batch=max_batch,
+        aot=all(aot_map.values()),
+        cache_persistent=engine.cache_persistent,
+        warmup_s=round(warmup_s, 3),
+        compile_count=rep['compile_count'],
+        trace_count=rep['trace_count'],
+        int8=int8,
+        policy={'compute': str(policy.compute_dtype),
+                'param': str(policy.param_dtype)}
+        if policy is not None else None,
+    )
+    if int8:
+        row['quantization_rel_error'] = round(quantization_error(
+            variables['params'], engine.params['params']), 5)
+    if rep['served'] == 0:
+        row['error'] = 'serve_no_completions'
+    emit(row, rc=0 if rep['served'] else 1)
+
+
 def main():
     argv = [a for a in sys.argv[1:]]
     if '--recovery' in argv:
         # self-contained CPU-subprocess scenario: no backend probe,
         # no watchdog child (the supervisor bounds its own attempts)
         measure_recovery(argv)
+        return
+    if '--serve' in argv:
+        # serving arm: same probe/child/banked-row conventions as
+        # training arms, keyed on the 'serve_<model>' metric family
+        model = parse_model(argv)
+        if '--child' in argv:
+            measure_serve([a for a in argv if a != '--child'])
+            return
+        if '--cpu' not in argv:
+            ok = probe_backend()
+            if ok is not True:
+                row = dict(metric_stub('serve_' + model), value=0.0,
+                           vs_baseline=0.0,
+                           error='backend_unavailable', detail=ok)
+                brow, banked, tag, src = banked_last_good_row(
+                    'serve_' + model)
+                if banked is not None:
+                    row.update(banked_value=banked, banked_round=tag,
+                               banked_source=src)
+                    for key in SERVE_SIDECAR_KEYS:
+                        if brow.get(key) is not None:
+                            row['banked_' + key] = brow[key]
+                emit(row, rc=1)
+        run_child(argv, 'serve_' + model)
         return
     model = parse_model(argv)
     # fail fast on flag mistakes BEFORE the backend probe

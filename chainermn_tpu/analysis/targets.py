@@ -389,12 +389,61 @@ def transformer_tp_step_target(policy=None, tp=2):
                            plan_axes=tuple(plan.mesh.axis_names))
 
 
+def serve_forward_target(policy=None, tp=2, bucket=None):
+    """The serving engine's forward-only apply over the MeshPlan
+    (``docs/serving.md``): a tensor-parallel ``TransformerLM`` served
+    through :class:`chainermn_tpu.serving.InferenceEngine` -- the
+    EXACT shard_mapped callable the engine AOT-compiles per bucket,
+    traced at its largest plan-divisible bucket shape.
+
+    Declares ``plan_axes=('model',)`` only: a forward-only request
+    path is embarrassingly parallel along ``data`` (no gradient
+    reduction exists to combine along it), so the data axis is
+    deliberately NOT a declared collective axis -- the model axis's
+    tensor-parallel psums are the serving path's only collectives,
+    and SL010 audits exactly those.  ``make_args`` returns an
+    iteration-independent signature: serving is stateless, so SL007
+    doubles as the static twin of the engine's runtime no-recompile
+    guard."""
+    import numpy as np
+    from chainermn_tpu.models import TransformerLM, tp_oracle
+    from chainermn_tpu.models import tp_param_specs
+    from chainermn_tpu.parallel.meshplan import MeshPlan
+    from chainermn_tpu.serving import InferenceEngine
+
+    plan = MeshPlan.create(tp=tp)
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, d_ff=64, max_len=64,
+                          tp_axis=plan.model_axis)
+    params = tp_oracle(model).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))['params']
+    specs = tp_param_specs(params, plan.model_axis)
+    from chainermn_tpu.precision import Policy
+    # the transformer computes bf16-native; serving it over f32
+    # weights would materialize exactly the upcasts SL008 flags, so
+    # the engine casts weights to compute dtype at load (the serving
+    # twin of the updater's cast-inside-the-loss) -- bf16 unless the
+    # sweep imposes its own policy
+    engine = InferenceEngine(
+        lambda p, t: model.apply({'params': p}, t),
+        params, np.zeros((16,), np.int32), max_batch=16,
+        policy=policy or Policy.bf16(), plan=plan, param_specs=specs)
+    bucket = bucket or engine.edges[-1]
+    fn, args = engine.traceable_forward(bucket)
+    return LintTarget(
+        'step:serve_forward', fn, args, dict(plan.mesh.shape),
+        compute_dtype='bfloat16', items=bucket * 16,
+        plan_axes=(plan.model_axis,),
+        make_args=lambda it: engine.traceable_forward(bucket)[1])
+
+
 def step_targets(include_resnet50=True, policy=None):
     out = [mlp_step_target(policy=policy), zero_core_target(),
            zero_step_target(policy=policy),
            bucketed_overlap_step_target(policy=policy),
            pipeline_step_target(policy=policy),
-           transformer_tp_step_target(policy=policy)]
+           transformer_tp_step_target(policy=policy),
+           serve_forward_target(policy=policy)]
     if include_resnet50:
         # unfused (flax-oracle) AND fused train steps: the SL008 /
         # memtraffic A/B pair ci/run_staticcheck.sh sweeps in both

@@ -23,3 +23,5 @@ from chainermn_tpu.ops.layer_norm import layer_norm, layer_norm_reference  # noq
 from chainermn_tpu.ops.batch_norm_act import (  # noqa
     batch_norm_act, batch_norm_act_inference, batch_norm_act_reference)
 from chainermn_tpu.ops.optimizer import fused_momentum_sgd, momentum_sgd  # noqa
+from chainermn_tpu.ops.int8_matmul import (  # noqa
+    dequant, dequant_matmul, dequant_matmul_reference)

@@ -1,0 +1,68 @@
+"""Int8-weight inference matmul: dequantize-in-matmul primitives.
+
+The int8 inference policy (:class:`chainermn_tpu.precision.Int8Policy`)
+stores weights as ``(int8 q, f32 per-channel scale)`` pairs -- 4x less
+HBM than f32 masters, 2x less than bf16 -- and the serving engine must
+never materialize the dequantized f32/bf16 weight in HBM (that would
+give the memory win back on every forward).  Two forms, both exact for
+per-OUTPUT-channel symmetric scales:
+
+- :func:`dequant_matmul` -- ``(x @ q.astype(compute)) * scale``: the
+  scale multiplies the MATMUL OUTPUT (per output channel), so the
+  int8 weight feeds the dot directly; on TPU the int8->bf16 convert
+  happens in the MXU operand path and no wide weight tensor ever
+  exists.  This is the kernel-shaped primitive for custom serving
+  heads.
+- :func:`dequant` -- leafwise ``q.astype(compute) * scale``: the
+  generic form the engine applies inside the compiled forward for
+  arbitrary zoo models (flax modules consume a plain weight tree).
+  The per-channel broadcast multiply feeding each consumer matmul is
+  a producer-fusion XLA performs on both backends, so the dequantized
+  weight lives in registers/VMEM of the consuming op, not in HBM --
+  the fusion twin of the explicit form above.
+
+Pure-``jnp`` by design (the ``ops/`` fallback convention): the int8
+contraction already lowers to the native mixed-precision dot on TPU
+via ``preferred_element_type``, so a Pallas kernel would re-derive
+what XLA emits; the function boundary is here so a hand-scheduled
+Mosaic version can land without touching callers.
+
+Quantization itself (scale computation, rounding) lives in
+:mod:`chainermn_tpu.precision` next to the policy that owns it.
+"""
+
+import jax.numpy as jnp
+
+
+def dequant(q, scale, dtype=jnp.float32):
+    """Dequantized weight ``q * scale`` in ``dtype`` (per-channel
+    ``scale`` broadcasts on the LAST axis -- the output-feature axis
+    of Dense/conv kernels).  Meant to be called INSIDE a jitted
+    forward: XLA fuses the convert+multiply into the consuming
+    matmul's operand read."""
+    return q.astype(dtype) * scale.astype(dtype)
+
+
+def dequant_matmul(x, q, scale, dtype=None):
+    """``x @ dequant(q, scale)`` without materializing the wide
+    weight: the contraction runs ``x`` (f32/bf16) against the int8
+    ``q`` with ``preferred_element_type`` set to the activation
+    dtype, and the per-output-channel ``scale`` multiplies the
+    (batch, out) RESULT -- exactly equal to dequantize-then-matmul
+    because the scale is constant along the contracted axis.
+
+    ``x``: (..., in); ``q``: int8 (in, out); ``scale``: (out,) or
+    scalar.  ``dtype`` overrides the accumulation/output dtype
+    (default: ``x.dtype``)."""
+    out_dtype = jnp.dtype(dtype) if dtype is not None else x.dtype
+    y = jnp.matmul(x, q, preferred_element_type=out_dtype)
+    return y * scale.astype(out_dtype)
+
+
+def dequant_matmul_reference(x, q, scale, dtype=None):
+    """Oracle: materialize the dequantized weight, then matmul -- the
+    semantics :func:`dequant_matmul` must match bit-for-bit up to
+    reassociation (tests pin the pair)."""
+    out_dtype = jnp.dtype(dtype) if dtype is not None else x.dtype
+    return jnp.matmul(x.astype(out_dtype),
+                      dequant(q, scale, out_dtype))

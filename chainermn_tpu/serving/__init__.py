@@ -1,0 +1,32 @@
+"""Serving subsystem: dynamic request batching over AOT-compiled
+forward executables (ROADMAP item 2 -- the repo's first forward-only
+request path).
+
+Three cooperating layers (``docs/serving.md``):
+
+- :mod:`~chainermn_tpu.serving.batcher` -- a bounded
+  :class:`RequestQueue` that coalesces variable-size requests into
+  padded, power-of-two-bucketed batches with deterministic packing,
+  deadline tagging and typed
+  :class:`~chainermn_tpu.utils.failure.OverloadError` shedding;
+- :mod:`~chainermn_tpu.serving.engine` -- an :class:`InferenceEngine`
+  holding one pre-lowered executable per bucket
+  (``jax.jit(...).lower(...).compile()`` with a persistent
+  compilation cache; plain-jit fallback on runtimes without the AOT
+  surface), a warmup that compiles all buckets eagerly, an
+  SL007-signature no-recompile runtime guard, MeshPlan-sharded and
+  int8-quantized (:class:`~chainermn_tpu.precision.Int8Policy`)
+  serving modes, and topology-portable parameter loading from
+  elastic-resume checkpoints;
+- :mod:`~chainermn_tpu.serving.loadgen` -- the synthetic OPEN-loop
+  generator behind ``bench.py --serve`` and the tier-1 end-to-end
+  proof (overload must shed typed, never wedge).
+"""
+
+from chainermn_tpu.serving.batcher import (  # noqa: F401
+    PackedBatch, Request, RequestQueue, bucket_edges, bucket_of,
+    pack_sizes)
+from chainermn_tpu.serving.engine import (  # noqa: F401
+    InferenceEngine, load_params)
+from chainermn_tpu.serving.loadgen import open_loop  # noqa: F401
+from chainermn_tpu.utils.failure import OverloadError  # noqa: F401

@@ -1,0 +1,166 @@
+"""Synthetic open-loop load generator for the serving engine.
+
+OPEN loop means arrivals are scheduled by a clock, not by completions
+(a closed-loop generator waits for each response and therefore can
+never observe queueing collapse -- the p99 it reports under overload
+is a fiction).  Requests are submitted at ``t0 + i/rate`` regardless
+of how the engine is doing; when the engine falls behind, the bounded
+queue fills and submissions start shedding with the typed
+``OverloadError`` -- which is the MEASUREMENT, not a failure: the
+report separates served throughput/latency from shed fraction, so a
+rate above capacity shows up as graceful degradation, never a wedge.
+
+Determinism: the size mix comes from a seeded ``numpy`` rng, so two
+runs at the same (seed, rate, n) offer the identical request
+sequence.  Latency percentiles come from the telemetry registry's
+raw-sample histograms (exact merge semantics), never from averaged
+percentiles.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from chainermn_tpu import telemetry as _telemetry
+from chainermn_tpu.utils.failure import OverloadError
+
+
+def _hist_summary(reg, name):
+    if reg is None:
+        return {}
+    snap = reg.snapshot().get(name)
+    return (snap or {}).get('summary') or {}
+
+
+def open_loop(engine, queue, rate, n_requests, seed=0,
+              max_request_items=None, deadline_s=None,
+              result_timeout=30.0, clock=time.monotonic,
+              capture_dir=None):
+    """Drive ``engine`` through ``queue`` with an open-loop arrival
+    process and return the serving report.
+
+    Args:
+      rate: offered request rate (req/s); arrivals at ``i / rate``.
+      n_requests: total offered requests.
+      seed: request-size mix seed (sizes uniform in
+        ``[1, max_request_items]``).
+      max_request_items: per-request item-count cap (default: half
+        the queue's max_batch, so coalescing has something to do).
+      deadline_s: per-request deadline; expired requests shed typed.
+      result_timeout: drain allowance after the last arrival.
+      capture_dir: when set, the telemetry window (events + serve
+        histograms) is flushed there -- a capture ``python -m
+        chainermn_tpu.telemetry doctor`` can read.
+
+    Returns a dict: offered/admitted/served/shed counts + fractions,
+    measured req/s over the serve window, latency and queue-wait
+    p50/p99 (ms, from raw-sample histograms), pad-waste fraction,
+    bucket hit-rate, and the engine's compile/trace accounting.
+    """
+    max_items = max_request_items or max(1, queue.max_batch // 2)
+    rng = np.random.RandomState(seed)
+    sizes = rng.randint(1, max_items + 1,
+                        size=n_requests).astype(int)
+    item_shape = engine._item_shape
+    payload = rng.rand(max_items, *item_shape).astype(np.float32) \
+        if np.issubdtype(engine._in_dtype, np.floating) else \
+        rng.randint(0, 2, size=(max_items,) + item_shape)
+
+    # latency/wait/pad percentiles come from the telemetry registry;
+    # when the caller runs telemetry-free, install an in-memory
+    # recorder for the window (the bench skew-capture idiom) so the
+    # report never fabricates and never comes back empty-handed
+    _installed = None
+    if _telemetry.active() is None:
+        _installed = _telemetry.enable()
+
+    compiles_before = engine.compile_count
+    stop = threading.Event()
+    worker = threading.Thread(target=engine.run, args=(queue, stop),
+                              daemon=True)
+    worker.start()
+
+    try:
+        admitted, shed_submit = [], 0
+        t0 = clock()
+        for i, n in enumerate(sizes):
+            target = t0 + i / float(rate)
+            delay = target - clock()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                admitted.append(queue.submit(
+                    payload[:n],
+                    deadline=(None if deadline_s is None
+                              else clock() + deadline_s)))
+            except OverloadError:
+                shed_submit += 1
+        # drain: wait for every admitted request to resolve (result
+        # or typed shed), then stop the worker
+        served = shed_deadline = errored = 0
+        for req in admitted:
+            try:
+                req.result(timeout=result_timeout)
+                served += 1
+            except OverloadError:
+                shed_deadline += 1
+            except Exception:
+                errored += 1
+        t1 = clock()
+        reg = _telemetry.registry()
+    finally:
+        stop.set()
+        worker.join(timeout=result_timeout)
+        queue.close()
+        if capture_dir is not None and _telemetry.active() is not None:
+            try:
+                _telemetry.active().flush(capture_dir)
+            except Exception:
+                pass  # the report below is the primary artifact
+        if _installed is not None:
+            _telemetry.disable()
+    lat = _hist_summary(reg, 'serve_latency_seconds')
+    wait = _hist_summary(reg, 'serve_queue_wait')
+    pad = _hist_summary(reg, 'serve_pad_waste')
+    st = engine.stats()
+    warm = len(st['buckets'])
+    wall = max(t1 - t0, 1e-9)
+    offered = int(n_requests)
+    shed = shed_submit + shed_deadline
+    return {
+        'offered': offered,
+        'offered_rate': float(rate),
+        'admitted': len(admitted),
+        'served': served,
+        'shed_submit': shed_submit,
+        'shed_deadline': shed_deadline,
+        'errored': errored,
+        'shed_fraction': shed / float(offered) if offered else 0.0,
+        'served_req_per_s': served / wall,
+        'wall_s': wall,
+        'latency_p50_ms': (lat.get('p50') or 0.0) * 1e3
+        if lat else None,
+        'latency_p99_ms': (lat.get('p99') or 0.0) * 1e3
+        if lat else None,
+        'queue_wait_p50_ms': (wait.get('p50') or 0.0) * 1e3
+        if wait else None,
+        'queue_wait_p99_ms': (wait.get('p99') or 0.0) * 1e3
+        if wait else None,
+        'pad_waste_fraction': (pad.get('mean') if pad else None),
+        # hit rate: executions that reused an executable compiled
+        # BEFORE the traffic window -- with an eager warmup every
+        # execution is a hit; a miss means the batcher produced a
+        # bucket warmup did not compile (the signature guard refuses
+        # shapes outside the edge set entirely)
+        'bucket_hit_rate': (
+            (st['executions']
+             - max(0, st['compile_count'] - compiles_before))
+            / float(st['executions']) if st['executions'] else None),
+        'buckets_compiled': warm,
+        'compile_count': st['compile_count'],
+        'trace_count': st['trace_count'],
+        'executions': st['executions'],
+        'aot': st['aot'],
+        'quantized': st['quantized'],
+    }

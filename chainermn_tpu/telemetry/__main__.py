@@ -80,7 +80,11 @@ def _cmd_report(args):
     else:
         print(report_mod.render_text(report,
                                      max_steps=args.max_steps))
-    if report['n_spans'] + report['n_events'] == 0:
+    if (report['n_spans'] + report['n_events'] == 0
+            and not report.get('serve')):
+        # a serving capture may legitimately hold only serve_*
+        # metrics (the engine's in-memory window exports histograms,
+        # no event log) -- that is a real capture, not an empty one
         print('telemetry: EMPTY capture under %s (was '
               'CHAINERMN_TPU_TELEMETRY set, and did the run flush?)'
               % args.outdir, file=sys.stderr)
@@ -107,7 +111,9 @@ def _cmd_doctor(args):
     else:
         print(diagnosis.render_doctor_text(diag))
     if (diag['n_spans'] + diag['n_events']
-            + diag['n_flight_records'] == 0):
+            + diag['n_flight_records'] == 0
+            and not diag.get('serve')):
+        # serve-metrics-only captures are non-empty (see _cmd_report)
         print('telemetry doctor: EMPTY capture under %s (was '
               'CHAINERMN_TPU_TELEMETRY set, and did the run flush?)'
               % args.outdir, file=sys.stderr)

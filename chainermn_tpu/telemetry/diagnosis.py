@@ -55,8 +55,15 @@ import json
 import os
 
 from chainermn_tpu.telemetry.report import (
-    STEP_PHASES, exposed_time, load_rank_logs, merge_intervals,
-    _percentile)
+    SERVE_PHASES, STEP_PHASES, exposed_time, load_rank_logs,
+    load_rank_metrics, aggregate_metrics, merge_intervals,
+    serve_summary, _percentile)
+
+#: phases the within-run anomaly scan pools samples for: the training
+#: step phases plus the serve-batch phases (``serve_execute`` spans
+#: carry ``iteration`` = batch index, so a latency-cliff batch is
+#: attributable exactly like a slow training step)
+ANOMALY_PHASES = STEP_PHASES + SERVE_PHASES
 
 #: eager collectives whose EXIT is a rendezvous (every rank leaves
 #: when the last arrives) -- the clock-offset anchors.  The eager
@@ -395,7 +402,7 @@ def step_anomalies(spans, z=MAD_Z, max_rows=16):
     first_it = {}  # (phase, rank) -> smallest iteration seen
     for s in spans:
         name = s.get('name')
-        if name not in STEP_PHASES or 'iteration' not in s:
+        if name not in ANOMALY_PHASES or 'iteration' not in s:
             continue
         rank, it = int(s.get('rank', 0)), int(s['iteration'])
         cur = first_it.get((name, rank))
@@ -629,6 +636,11 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
     under a single machine-readable ``verdict``."""
     metas, spans, events, bad = load_rank_logs(outdir)
     flights = load_flight_records(outdir)
+    # serve recognition: a forward-only serving capture may hold ONLY
+    # metrics (the bench's in-memory window exports histograms, no
+    # event log) -- the serve summary is computed from the metrics
+    # files so such a capture is diagnosable, not "empty"
+    serve = serve_summary(aggregate_metrics(load_rank_metrics(outdir)))
     skew = collective_skew(spans)
     stragglers = find_stragglers(spans, skew)
     anomalies = step_anomalies(spans, z=z)
@@ -691,6 +703,14 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
             '%s %.1f ms (median %.1f ms, z=%.1f)'
             % (len(anomalies), a['iteration'], a['rank'], a['phase'],
                a['value_ms'], a['median_ms'], a['z']))
+    if serve:
+        lat = serve.get('latency_ms') or {}
+        summary.append(
+            'serving capture: %.0f requests / %.0f batches, %.0f shed'
+            % (serve['requests'], serve['batches'], serve['shed'])
+            + ('; latency p50 %.3f ms p99 %.3f ms'
+               % (lat['p50'], lat['p99'])
+               if lat.get('p50') is not None else ''))
     if healthy:
         summary.append('no cross-rank skew, stragglers, anomalies or '
                        'deaths detected')
@@ -700,6 +720,7 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
         'n_spans': len(spans),
         'n_events': len(events),
         'n_flight_records': len(flights),
+        'serve': serve,
         'n_unparseable_lines': bad,
         'collective_skew': skew,
         'stragglers': stragglers,
@@ -730,7 +751,7 @@ def quick_verdict(outdir, liveness_dirs=()):
             return None
         diag = diagnose(outdir, liveness_dirs=liveness_dirs)
         if not (diag['n_spans'] or diag['n_events']
-                or diag['n_flight_records']):
+                or diag['n_flight_records'] or diag['serve']):
             return None
         return diag
     except Exception:
@@ -777,6 +798,15 @@ def render_doctor_text(diag):
                 '  widest: %s seq %s  skew %.3f ms  (rank %d last)'
                 % (row['name'], row['seq'], row['skew_ms'],
                    row['late_rank']))
+    serve = diag.get('serve')
+    if serve:
+        lat = serve.get('latency_ms') or {}
+        lines.append(
+            'serving: %.0f requests / %.0f batches, %.0f shed%s'
+            % (serve['requests'], serve['batches'], serve['shed'],
+               '  (latency p50 %.3f ms  p99 %.3f ms)'
+               % (lat['p50'], lat['p99'])
+               if lat.get('p50') is not None else ''))
     for s in diag['stragglers']:
         lines.append('straggler: rank %d (%s, phase: %s)'
                      % (s['rank'], s['evidence'],

@@ -32,6 +32,15 @@ from chainermn_tpu.telemetry.recorder import (
 STEP_PHASES = ('host_batch_prep', 'h2d', 'jitted_step',
                'metrics_sync')
 
+#: serve-phase vocabulary (``chainermn_tpu/serving``): per-batch
+#: spans/events the engine emits and the registry histograms of the
+#: same names.  The doctor/report layers recognize these so a
+#: forward-only serving capture -- which records NO training step
+#: spans, and in the bench's in-memory mode no events at all, only
+#: metrics -- is never misreported as an empty capture (exit 2)
+SERVE_PHASES = ('serve_queue_wait', 'serve_h2d', 'serve_execute',
+                'serve_warmup')
+
 #: span kinds whose time counts as "compute the collective could
 #: hide behind"
 COMPUTE_KINDS = ('compute',)
@@ -251,6 +260,47 @@ def step_table(spans):
     return [rows[k] for k in sorted(rows)]
 
 
+def serve_summary(metrics):
+    """The serving view of an aggregated metrics snapshot: request /
+    batch / shed totals and the latency / queue-wait / pad-waste
+    distributions the ``serve_*`` histograms carry (p50/p99 from the
+    merged raw samples).  ``None`` when the snapshot records no
+    serving activity -- the presence test the empty-capture checks
+    consult."""
+    if not metrics:
+        return None
+    serve = {k: v for k, v in metrics.items()
+             if k.startswith('serve_')}
+    if not serve:
+        return None
+
+    def summ(name):
+        return (serve.get(name) or {}).get('summary') or {}
+
+    def total(name):
+        return (serve.get(name) or {}).get('value') or 0.0
+
+    lat, wait, pad = (summ('serve_latency_seconds'),
+                      summ('serve_queue_wait'),
+                      summ('serve_pad_waste'))
+    return {
+        'requests': total('serve_requests_total'),
+        'batches': total('serve_batches_total'),
+        'shed': total('serve_shed_total'),
+        'latency_ms': {
+            'count': lat.get('count', 0),
+            'p50': (lat.get('p50') or 0.0) * 1e3 if lat else None,
+            'p99': (lat.get('p99') or 0.0) * 1e3 if lat else None,
+        } if lat else None,
+        'queue_wait_ms': {
+            'p50': (wait.get('p50') or 0.0) * 1e3,
+            'p99': (wait.get('p99') or 0.0) * 1e3,
+        } if wait else None,
+        'pad_waste_mean': pad.get('mean') if pad else None,
+        'metrics': sorted(serve),
+    }
+
+
 def build_report(outdir):
     """The merged session report: timeline summary, per-step phase
     table, overlap statistics, aggregated metrics, chaos events."""
@@ -290,6 +340,7 @@ def build_report(outdir):
              'name': e.get('name')} for e in chaos_events],
         'metrics': aggregate_metrics(rank_metrics),
     }
+    report['serve'] = serve_summary(report['metrics'])
     return report
 
 
@@ -342,6 +393,17 @@ def render_text(report, max_steps=24):
                    agg['total_collective_s'] * 1e3,
                    agg['exposed_collective_s'] * 1e3,
                    '-' if frac is None else '%.3f' % frac))
+    serve = report.get('serve')
+    if serve:
+        lat = serve.get('latency_ms') or {}
+        lines.append(
+            'serving: %.0f requests in %.0f batches, %.0f shed'
+            % (serve['requests'], serve['batches'], serve['shed'])
+            + ('; latency p50 %.3f ms p99 %.3f ms'
+               % (lat['p50'], lat['p99'])
+               if lat.get('p50') is not None else '')
+            + ('; pad waste %.1f%%' % (serve['pad_waste_mean'] * 100)
+               if serve.get('pad_waste_mean') is not None else ''))
     if report['chaos_events']:
         lines.append('chaos events in timeline: %d (%s)'
                      % (len(report['chaos_events']),

@@ -79,6 +79,11 @@ Sites (the action is part of the site name):
 ``ckpt_flip``       XOR-flip ARG (default 8) evenly-spaced bytes of
                     the just-committed checkpoint -- silent bit rot;
                     crc verification must reject it
+``serve_burst``     amplify a serving-queue submission: enqueue ARG
+                    (default 4) extra synthetic copies of the
+                    request -- a traffic spike the bounded queue must
+                    absorb or SHED with a typed ``OverloadError``,
+                    never wedge on (``chainermn_tpu/serving``)
 ==================  ====================================================
 
 Example -- drop the first publish, delay half the rest, stall the
@@ -99,7 +104,8 @@ ENV_VAR = 'CHAINERMN_TPU_CHAOS'
 
 SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
          'nan_batch', 'sigterm_step', 'kill_step', 'hang_step',
-         'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip')
+         'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip',
+         'serve_burst')
 
 
 class InjectedFault(RuntimeError):
@@ -414,6 +420,21 @@ def corrupt_checkpoint(path):
                 byte = f.read(1)
                 f.seek(off)
                 f.write(bytes([byte[0] ^ 0xFF]))
+
+
+def on_serve_submit():
+    """``serve_burst``: the number of EXTRA synthetic copies of the
+    incoming request the serving queue should enqueue (0 = no burst).
+    The queue enqueues them through its normal bounded admission path,
+    so a burst past capacity exercises the typed-shed contract, not a
+    special case."""
+    inj = _active
+    if inj is None:
+        return 0
+    r = inj.fires('serve_burst')
+    if r is None:
+        return 0
+    return max(1, int(r.arg) if r.arg is not None else 4)
 
 
 def corrupt_batch(arrays):

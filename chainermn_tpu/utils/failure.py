@@ -128,6 +128,34 @@ class CheckpointCorruptError(ValueError):
                      path=path, leaf=leaf, corruption_kind=kind)
 
 
+class OverloadError(CommFailure):
+    """The serving admission layer REFUSED work instead of wedging:
+    the bounded request queue is full, or a request's deadline expired
+    before (or while) it could be batched/executed.  The load-shedding
+    member of the failure taxonomy -- under sustained overload the
+    engine keeps serving what it admitted at a bounded latency and
+    answers the rest with this typed verdict, which a client can back
+    off on (``docs/serving.md``).
+
+    ``reason`` classifies the shed: ``'queue_full'`` |
+    ``'deadline'`` | ``'shutdown'``.  ``queue_depth`` records the
+    depth observed at the decision.
+
+    Unlike the other typed constructors this one does NOT drop a
+    telemetry flight record: sheds fire at request rate when
+    saturated (thousands/s), and a black-box dump per shed would
+    thrash the disk the flight recorder exists to protect.  The
+    batcher counts sheds in the ``serve_shed_total`` metric instead.
+    """
+
+    status_name = 'CMN_OVERLOAD'
+
+    def __init__(self, message, reason='queue_full', queue_depth=None):
+        super().__init__(message)
+        self.reason = reason
+        self.queue_depth = queue_depth
+
+
 class CheckpointSkippedWarning(UserWarning):
     """Emitted (via ``warnings.warn``) each time ``auto_resume`` skips
     a corrupt or incomplete snapshot while walking the chain

@@ -47,3 +47,79 @@ def ensure():
     if not hasattr(jax.lax, 'axis_size'):
         jax.lax.axis_size = _axis_size
     return jax
+
+
+# ----------------------------------------------------------------------
+# AOT compilation + persistent compilation cache (the serving engine's
+# cold-start surface, ``chainermn_tpu/serving/engine.py``).  Same shim
+# discipline as ``jax.shard_map`` above: the engine is written against
+# the modern ``jax.jit(...).lower(...).compile()`` AOT API and the
+# ``jax_compilation_cache_dir`` config knob; on a runtime that lacks
+# either, these helpers DEGRADE (return None / False) instead of
+# raising, and the engine falls back to plain ``jit`` -- slower cold
+# start, identical results.
+# ----------------------------------------------------------------------
+
+def aot_compile(jitted, *args, **kwargs):
+    """``jitted.lower(*args).compile()`` guarded across jax versions:
+    the compiled executable, or ``None`` when this runtime's jit
+    wrapper has no usable AOT surface (missing ``lower``/``compile``,
+    or a lowering that rejects these arguments).  Genuine COMPILE
+    errors (the function itself is broken) still propagate: only the
+    absence of the AOT API degrades."""
+    lower = getattr(jitted, 'lower', None)
+    if lower is None:
+        return None
+    try:
+        lowered = lower(*args, **kwargs)
+        compile_ = getattr(lowered, 'compile', None)
+        if compile_ is None:
+            return None
+        return compile_()
+    except (AttributeError, NotImplementedError, TypeError):
+        return None
+
+
+def enable_compilation_cache(cache_dir, min_compile_time_secs=0.0):
+    """Point jax's persistent compilation cache at ``cache_dir`` so
+    AOT executables survive process restarts (cold start becomes a
+    file read).  Returns True when the cache knobs exist and were set,
+    False when this runtime has no persistent-cache surface -- the
+    caller keeps working, just without persistence.
+
+    ``min_compile_time_secs=0`` persists even fast compiles: a
+    serving engine's bucket set is small and every avoided retrace is
+    a cold-start win (the default threshold of ~1s would skip exactly
+    the small-model executables the CPU tier exercises)."""
+    ok = False
+    for knob, value in (
+            ('jax_compilation_cache_dir', cache_dir),
+            ('jax_persistent_cache_min_compile_time_secs',
+             min_compile_time_secs),
+            ('jax_persistent_cache_min_entry_size_bytes', -1)):
+        try:
+            jax.config.update(knob, value)
+            if knob == 'jax_compilation_cache_dir':
+                ok = True
+        except (AttributeError, ValueError):
+            if knob == 'jax_compilation_cache_dir':
+                # older surface: the experimental module's setter
+                try:
+                    from jax.experimental.compilation_cache import (
+                        compilation_cache as cc)
+                    cc.set_cache_dir(cache_dir)
+                    ok = True
+                except Exception:
+                    return False
+    if ok:
+        # the cache object is created lazily ONCE at the first
+        # compile; a dir configured after that (any jit ran before
+        # the engine was built) would silently never persist --
+        # reset so the new dir takes effect.  Private-module probe
+        # by necessity; failure degrades to in-process-only caching.
+        try:
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+        except Exception:
+            pass
+    return ok

@@ -91,6 +91,38 @@ print('SL010 OK: transformer_tp swept and clean under the '
 " "$1"
 }
 
+# serve-forward gate (docs/serving.md): the serving engine's
+# forward-only apply over the MeshPlan must be IN the sweep (the
+# request path gets the same SL001-SL012 machine checks as training
+# steps) and clean under the multi-axis family and every
+# ERROR-severity rule.  ONE warning is expected and pinned: the
+# transformer's lm head deliberately contracts logits in f32
+# (models/transformer.py vocab-head numerics), which SL008 flags at
+# serve bucket shapes -- any finding beyond that set fails the gate.
+check_serve() {
+  python -c "
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert 'step:serve_forward' in report['targets'], report['targets']
+fs = [f for f in report['findings']
+      if f['target'] == 'step:serve_forward']
+errors = [f for f in fs if f['severity'] == 'error']
+assert not errors, (
+    'serve_forward must carry no error findings: %r' % errors)
+multi = [f for f in fs if f['rule'] in ('SL010', 'SL011', 'SL012')]
+assert not multi, (
+    'serve_forward must lint clean under the SL010 family: %r'
+    % multi)
+unexpected = [f for f in fs if f['rule'] != 'SL008']
+assert not unexpected, (
+    'serve_forward grew findings beyond the pinned lm-head SL008 '
+    'warning: %r' % unexpected)
+print('serve OK: serve_forward swept, no errors, SL010 family '
+      'clean (%d pinned SL008 warning(s))'
+      % len([f for f in fs if f['rule'] == 'SL008']))
+" "$1"
+}
+
 out_f32=$(mktemp)
 out_bf16=$(mktemp)
 trap 'rm -f "$out_f32" "$out_bf16"' EXIT
@@ -99,7 +131,9 @@ JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json | tee "$out_f32"
 check_memtraffic "$out_f32"
 check_sl009 "$out_f32"
 check_sl010 "$out_f32"
+check_serve "$out_f32"
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16 | tee "$out_bf16"
 check_memtraffic "$out_bf16"
 check_sl009 "$out_bf16"
 check_sl010 "$out_bf16"
+check_serve "$out_bf16"
