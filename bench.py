@@ -82,7 +82,14 @@ batching; the row's value is served req/s/chip with p50/p99 latency
 from telemetry histograms, pad-waste fraction, bucket hit-rate and
 typed-shed fraction; ``--int8`` serves int8-quantized weights,
 ``--serve-rate``/``--serve-requests``/``--serve-max-batch`` tune the
-load; see ``docs/serving.md``).
+load; see ``docs/serving.md``),
+``--serve --generate`` (autoregressive arm over
+``chainermn_tpu/serving/generate.py`` -- bucketed KV-cache decode
+with continuous token-level batching over a prefill/decode AOT
+split; the row's value is generated tokens/s/chip with TTFT and
+inter-token p50/p99 sidecars, anchored against PERF.md's ~290k
+tok/s/chip perfect-MXU number; ``--int8-kv`` stores the KV cache
+int8, ``--gen-slots``/``--gen-max-new`` size the slot table).
 """
 
 import json
@@ -158,6 +165,12 @@ _log.t0 = time.monotonic()
 
 
 def metric_stub(model):
+    if model.startswith('serve_generate'):
+        # the autoregressive arm (--serve --generate): generated
+        # tokens, not requests -- decode throughput is the product
+        # number (docs/serving.md)
+        return {'metric': '%s_tokens_per_sec_per_chip' % model,
+                'unit': 'tokens/sec/chip'}
     if model.startswith('serve_'):
         # the serving arms (--serve): request throughput, not
         # training items -- 'serve_<model>' keys the banked-artifact
@@ -1881,6 +1894,13 @@ SERVE_SIDECAR_KEYS = (
     'latency_p50_ms', 'latency_p99_ms', 'pad_waste_fraction',
     'bucket_hit_rate', 'shed_fraction', 'capacity_req_per_s')
 
+#: generate-row sidecars (--serve --generate): the decode regime's
+#: own vocabulary -- tokens/s, TTFT and inter-token latency
+GENERATE_SIDECAR_KEYS = (
+    'tokens_per_s', 'ttft_p50_ms', 'ttft_p99_ms',
+    'intertoken_p50_ms', 'intertoken_p99_ms', 'shed_fraction',
+    'capacity_tok_per_s')
+
 
 def _flag_value(argv, flag, default, cast=float):
     if flag not in argv:
@@ -2048,6 +2068,163 @@ def measure_serve(argv):
     emit(row, rc=0 if rep['served'] else 1)
 
 
+def generate_family(argv):
+    """Metric-family name for the autoregressive arm: the --int8-kv
+    A/B banks under its own tag so sidecars never cross-pollinate."""
+    return ('serve_generate_int8kv' if '--int8-kv' in argv
+            else 'serve_generate')
+
+
+def measure_generate(argv):
+    """``--serve --generate``: the autoregressive serving row
+    (ISSUE 11).
+
+    Builds a ``TransformerLM`` :class:`~chainermn_tpu.serving.
+    GenerationEngine` (prefill bucketed by prompt length, decode by
+    active-slot count, AOT over the persistent cache; ``--int8-kv``
+    stores the KV cache int8), probes steady-state decode capacity at
+    full occupancy, then offers an OPEN-loop prompt stream above
+    capacity so continuous batching and typed shedding are both in
+    the measurement.  Row value = generated tokens/s/chip; TTFT and
+    inter-token p50/p99 ride as sidecars, anchored against PERF.md's
+    ~290k tok/s/chip perfect-MXU transformer number (decode is
+    HBM-bound -- the fraction of that ceiling it reaches IS the
+    bandwidth story; ``docs/serving.md``)."""
+    quick = '--quick' in argv
+    stub = metric_stub(generate_family(argv))
+
+    import numpy as np  # noqa: F401
+
+    import jax
+
+    cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         '.jax_compile_cache')
+    from chainermn_tpu.utils.platform import enable_host_cpu_backend
+    enable_host_cpu_backend()
+    if '--cpu' in argv:
+        from chainermn_tpu.utils import force_host_devices
+        force_host_devices(8)
+    n_dev = jax.device_count()
+    on_cpu = jax.default_backend() == 'cpu'
+    int8_kv = '--int8-kv' in argv
+    _log('generate: backend=%s n_dev=%d int8_kv=%s'
+         % (jax.default_backend(), n_dev, int8_kv))
+
+    import jax.numpy as jnp
+
+    from chainermn_tpu import serving
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu.precision import Policy
+
+    small = quick or on_cpu
+    if small:
+        model = TransformerLM(vocab_size=2048, d_model=128, n_heads=8,
+                              n_layers=2, d_ff=512, max_len=256,
+                              dtype=jnp.float32 if on_cpu
+                              else jnp.bfloat16)
+        n_slots, max_prompt, max_new = 8, 32, 12
+    else:
+        # the PERF.md anchor config family (d512/L6/V32k), cache depth
+        # sized to prompt + generation
+        model = TransformerLM(vocab_size=32000, d_model=512,
+                              n_heads=8, n_layers=6, d_ff=2048,
+                              max_len=512)
+        n_slots, max_prompt, max_new = 32, 128, 32
+    n_slots = int(_flag_value(argv, '--gen-slots', n_slots, int))
+    max_new = int(_flag_value(argv, '--gen-max-new', max_new, int))
+    policy = None if on_cpu else Policy.bf16()
+
+    params = init_on_host(
+        lambda *a: model.init(*a)['params'], jax.random.PRNGKey(0),
+        jnp.zeros((1, 8), jnp.int32))
+    engine = serving.GenerationEngine(
+        model, params, n_slots=n_slots, max_prompt_len=max_prompt,
+        policy=policy, int8_kv=int8_kv, cache_dir=cache)
+    _log('generate: warmup over prefill buckets %s + decode buckets '
+         '%s' % (list(engine.prefill_edges),
+                 list(engine.decode_edges)))
+    t0 = time.perf_counter()
+    aot_map = engine.warmup()
+    warmup_s = time.perf_counter() - t0
+
+    # capacity probe: saturate every slot once (arrivals effectively
+    # instantaneous, queue sized to hold them all) and read the
+    # steady-state token rate -- the ceiling any open-loop offered
+    # rate is then set against
+    probe_q = serving.GenerationQueue(max_prompt_len=max_prompt,
+                                      max_queue=4 * n_slots)
+    probe = serving.open_loop_generate(
+        engine, probe_q, rate=1e9, n_requests=2 * n_slots, seed=1,
+        prompt_len_range=(4, max_prompt), max_new_tokens=max_new)
+    capacity_tok = probe['tokens_per_s']
+    capacity_req = capacity_tok / float(max_new)
+    rate = _flag_value(argv, '--serve-rate', 2.0 * capacity_req)
+    n_requests = int(_flag_value(argv, '--serve-requests',
+                                 4 * n_slots if quick
+                                 else 12 * n_slots, int))
+    _log('generate: capacity ~%.0f tok/s (~%.1f req/s); offering '
+         '%.1f req/s x %d requests'
+         % (capacity_tok, capacity_req, rate, n_requests))
+
+    queue = serving.GenerationQueue(max_prompt_len=max_prompt,
+                                    max_queue=max(2 * n_slots, 16))
+    rep = serving.open_loop_generate(
+        engine, queue, rate=rate, n_requests=n_requests, seed=0,
+        prompt_len_range=(4, max_prompt), max_new_tokens=max_new)
+
+    mxu_anchor = 290000.0
+    value = rep['tokens_per_s'] / n_dev
+    row = dict(
+        stub,
+        value=round(value, 2),
+        vs_baseline=0.0,
+        baseline_derivation='none: first autoregressive serving '
+                            'metric family round (reference has no '
+                            'serving path)',
+        n_devices=n_dev,
+        backend=jax.default_backend(),
+        device_kind=jax.devices()[0].device_kind,
+        quick=quick,
+        model='transformer',
+        mxu_anchor_tok_s_per_chip=mxu_anchor,
+        anchor_source='PERF.md: perfect-MXU d512/L6/seq1024/V32k @ '
+                      '197 TF/s on v5e (decode is HBM-bound; the '
+                      'gap to this ceiling is the bandwidth story)',
+        anchor_config_match=bool(not small),
+        pct_of_mxu_anchor=round(100.0 * value / mxu_anchor, 3),
+        offered_req_per_s=round(rate, 2),
+        capacity_tok_per_s=round(capacity_tok, 1),
+        tokens_per_s=round(rep['tokens_per_s'], 1),
+        tokens_served=rep['tokens_served'],
+        served=rep['served'],
+        offered=rep['offered'],
+        shed_fraction=round(rep['shed_fraction'], 4),
+        cancelled=rep['cancelled'],
+        ttft_p50_ms=rep['ttft_p50_ms'],
+        ttft_p99_ms=rep['ttft_p99_ms'],
+        intertoken_p50_ms=rep['intertoken_p50_ms'],
+        intertoken_p99_ms=rep['intertoken_p99_ms'],
+        decode_step_p50_ms=rep['decode_step_p50_ms'],
+        n_slots=n_slots,
+        max_new_tokens=max_new,
+        prefill_buckets=list(engine.prefill_edges),
+        decode_buckets=list(engine.decode_edges),
+        aot=all(list(aot_map['prefill'].values())
+                + list(aot_map['decode'].values())),
+        cache_persistent=engine.cache_persistent,
+        warmup_s=round(warmup_s, 3),
+        compile_count=rep['compile_count'],
+        prefill_trace_count=rep['prefill_trace_count'],
+        decode_trace_count=rep['decode_trace_count'],
+        int8_kv=int8_kv,
+        policy={'compute': str(policy.compute_dtype)}
+        if policy is not None else None,
+    )
+    if rep['served'] == 0:
+        row['error'] = 'generate_no_completions'
+    emit(row, rc=0 if rep['served'] else 1)
+
+
 def main():
     argv = [a for a in sys.argv[1:]]
     if '--recovery' in argv:
@@ -2056,28 +2233,39 @@ def main():
         measure_recovery(argv)
         return
     if '--serve' in argv:
-        # serving arm: same probe/child/banked-row conventions as
+        # serving arms: same probe/child/banked-row conventions as
         # training arms, keyed on the 'serve_<model>' metric family
-        model = parse_model(argv)
+        # (--generate: the autoregressive tokens/s family, with its
+        # own sidecar vocabulary)
+        generate = '--generate' in argv
+        if generate:
+            family = generate_family(argv)
+            sidecars = GENERATE_SIDECAR_KEYS
+        else:
+            family = 'serve_' + parse_model(argv)
+            sidecars = SERVE_SIDECAR_KEYS
         if '--child' in argv:
-            measure_serve([a for a in argv if a != '--child'])
+            child_argv = [a for a in argv if a != '--child']
+            if generate:
+                measure_generate(child_argv)
+            else:
+                measure_serve(child_argv)
             return
         if '--cpu' not in argv:
             ok = probe_backend()
             if ok is not True:
-                row = dict(metric_stub('serve_' + model), value=0.0,
+                row = dict(metric_stub(family), value=0.0,
                            vs_baseline=0.0,
                            error='backend_unavailable', detail=ok)
-                brow, banked, tag, src = banked_last_good_row(
-                    'serve_' + model)
+                brow, banked, tag, src = banked_last_good_row(family)
                 if banked is not None:
                     row.update(banked_value=banked, banked_round=tag,
                                banked_source=src)
-                    for key in SERVE_SIDECAR_KEYS:
+                    for key in sidecars:
                         if brow.get(key) is not None:
                             row['banked_' + key] = brow[key]
                 emit(row, rc=1)
-        run_child(argv, 'serve_' + model)
+        run_child(argv, family)
         return
     model = parse_model(argv)
     # fail fast on flag mistakes BEFORE the backend probe

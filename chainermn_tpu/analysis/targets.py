@@ -437,13 +437,57 @@ def serve_forward_target(policy=None, tp=2, bucket=None):
         make_args=lambda it: engine.traceable_forward(bucket)[1])
 
 
+def decode_forward_target(policy=None, tp=2, bucket=None):
+    """The autoregressive decode step over the MeshPlan
+    (``docs/serving.md``): a tensor-parallel ``TransformerLM``'s
+    KV-cache decode as the :class:`chainermn_tpu.serving.
+    GenerationEngine` compiles it -- the EXACT shard_mapped callable
+    behind every token of continuous batching, traced at the
+    full-slot bucket (cache read in place, no gather).
+
+    Declares ``plan_axes=('model',)`` like ``step:serve_forward``:
+    decode slots are embarrassingly parallel (no reduction exists
+    along data), so the tp psums -- one per half-block plus the
+    embedding and lm-head reductions -- are the path's only
+    collectives and SL010 audits exactly those.  ``make_args`` is
+    iteration-independent: the decode executable's shape depends on
+    the BUCKET, never the step, which is precisely the SL007 static
+    twin of the engine's runtime no-recompile guard (the acceptance
+    pin that slot refills never retrace)."""
+    import numpy as np  # noqa: F401  (parity with serve_forward)
+    from chainermn_tpu.models import (TransformerLM, tp_oracle,
+                                      tp_param_specs)
+    from chainermn_tpu.parallel.meshplan import MeshPlan
+    from chainermn_tpu.precision import Policy
+    from chainermn_tpu.serving import GenerationEngine
+
+    plan = MeshPlan.create(tp=tp)
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, d_ff=64, max_len=64,
+                          tp_axis=plan.model_axis)
+    params = tp_oracle(model).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))['params']
+    specs = tp_param_specs(params, plan.model_axis)
+    engine = GenerationEngine(
+        model, params, n_slots=8, max_prompt_len=16,
+        policy=policy or Policy.bf16(), plan=plan, param_specs=specs)
+    bucket = bucket or engine.n_slots
+    fn, args = engine.traceable_decode(bucket)
+    return LintTarget(
+        'step:decode_forward', fn, args, dict(plan.mesh.shape),
+        compute_dtype='bfloat16', items=bucket,
+        plan_axes=(plan.model_axis,),
+        make_args=lambda it: engine.traceable_decode(bucket)[1])
+
+
 def step_targets(include_resnet50=True, policy=None):
     out = [mlp_step_target(policy=policy), zero_core_target(),
            zero_step_target(policy=policy),
            bucketed_overlap_step_target(policy=policy),
            pipeline_step_target(policy=policy),
            transformer_tp_step_target(policy=policy),
-           serve_forward_target(policy=policy)]
+           serve_forward_target(policy=policy),
+           decode_forward_target(policy=policy)]
     if include_resnet50:
         # unfused (flax-oracle) AND fused train steps: the SL008 /
         # memtraffic A/B pair ci/run_staticcheck.sh sweeps in both

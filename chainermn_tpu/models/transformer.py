@@ -326,6 +326,318 @@ def tp_param_specs(params, axis='model'):
     return jax.tree_util.tree_map_with_path(one, params)
 
 
+# ---------------------------------------------------------------------
+# incremental decode: slot-addressed KV cache (ISSUE 11)
+#
+# Autoregressive serving never re-runs the prompt: the PREFILL pass
+# computes the full causal forward once and banks every layer's K/V in
+# a cache SLOT; each DECODE step then runs one token per live slot,
+# appends its K/V at the slot's position, and attends the single query
+# row against the cache (ops.flash_attention_decode -- one HBM pass,
+# per-slot dynamic lengths).  The cache is a plain pytree of stacked
+# per-layer arrays, so it threads through jit/AOT executables, is
+# donatable (the serving engine updates it in place across calls), and
+# shards over a MeshPlan 'model' axis on its HEAD dim exactly like the
+# attention weights (kv_cache_specs).
+#
+# These are module-level functions doing the SAME arithmetic as
+# TransformerLM.__call__ over the SAME parameter tree (the
+# pipeline_parts idiom): the flax module stays the single source of
+# the parameters, and the parity pins in tests/test_transformer.py
+# hold the two paths together (f32 rtol 1e-5, bf16/int8-KV 5e-2).
+
+def init_kv_cache(model, n_slots, max_len=None, dtype=None, tp=1,
+                  int8_kv=False):
+    """Zeroed slot-addressed KV cache for ``model``.
+
+    Layout: ``{'k'|'v': (n_layers, n_slots, S, H_local, d_head)}``
+    with ``S = max_len or model.max_len`` and ``H_local =
+    n_heads / tp`` (pass the mesh's model-axis size as ``tp`` when the
+    cache lives sharded inside ``shard_map``).  ``int8_kv=True`` adds
+    ``'k_scale'``/``'v_scale'`` ``(n_layers, n_slots, S, H_local)``
+    f32 trees and stores k/v as int8 (:func:`chainermn_tpu.precision.
+    quantize_kv` at write time) -- half the decode-bound HBM bytes of
+    bf16.  Slots are REUSED without zeroing: reads mask by the live
+    length, so a previous occupant's stale rows are never attended.
+    """
+    if model.n_heads % tp:
+        raise ValueError('tp=%d must divide n_heads=%d'
+                         % (tp, model.n_heads))
+    n_layers = model.n_layers
+    h_local = model.n_heads // tp
+    d_head = model.d_model // model.n_heads
+    s = int(max_len or model.max_len)
+    dtype = dtype or model.dtype
+    shape = (n_layers, int(n_slots), s, h_local, d_head)
+    if int8_kv:
+        return {'k': jnp.zeros(shape, jnp.int8),
+                'v': jnp.zeros(shape, jnp.int8),
+                'k_scale': jnp.zeros(shape[:-1], jnp.float32),
+                'v_scale': jnp.zeros(shape[:-1], jnp.float32)}
+    return {'k': jnp.zeros(shape, dtype),
+            'v': jnp.zeros(shape, dtype)}
+
+
+def kv_cache_specs(cache, axis='model'):
+    """``PartitionSpec`` tree for a cache under tensor parallelism:
+    the head dim shards with the attention heads, everything else
+    replicated (slots are NOT data-sharded -- continuous batching
+    refills them independently of the mesh)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def one(leaf):
+        if leaf.ndim == 5:                      # k / v
+            return P(None, None, None, axis, None)
+        return P(None, None, None, axis)        # scales
+    return jax.tree_util.tree_map(one, cache)
+
+
+def _cache_int8(cache):
+    return 'k_scale' in cache
+
+
+def _dense(x, p, dtype):
+    """``nn.Dense`` twin: promote input/kernel/bias to ``dtype``."""
+    return (x.astype(dtype) @ p['kernel'].astype(dtype)
+            + p['bias'].astype(dtype))
+
+
+def _qkv_proj(h, bp, dtype):
+    """``nn.DenseGeneral((3, H, d_head), axis=-1)`` twin over (..., d)
+    activations: returns (..., 3, H, d_head)."""
+    w = bp['qkv']['kernel'].astype(dtype)
+    b = bp['qkv']['bias'].astype(dtype)
+    return jnp.einsum('...d,dchf->...chf', h.astype(dtype), w) + b
+
+
+def _write_kv(cache, layer, k_new, v_new, slots, positions):
+    """Append one token's K/V per row: ``k_new``/``v_new``
+    (N, H_local, d_head) written at ``(layer, slots[i],
+    positions[i])``.  ``slots=None`` means row i IS slot i."""
+    from chainermn_tpu.precision import quantize_kv
+    n = k_new.shape[0]
+    idx_slots = (jnp.arange(n) if slots is None
+                 else slots.astype(jnp.int32))
+    out = dict(cache)
+    if _cache_int8(cache):
+        for name, val in (('k', k_new), ('v', v_new)):
+            q, scale = quantize_kv(val)
+            out[name] = cache[name].at[
+                layer, idx_slots, positions].set(q)
+            out[name + '_scale'] = cache[name + '_scale'].at[
+                layer, idx_slots, positions].set(scale)
+        return out
+    dt = cache['k'].dtype
+    out['k'] = cache['k'].at[layer, idx_slots, positions].set(
+        k_new.astype(dt))
+    out['v'] = cache['v'].at[layer, idx_slots, positions].set(
+        v_new.astype(dt))
+    return out
+
+
+def _attend_cache(cache, layer, q, slots, lengths):
+    """One decode-attention read: row i's query against its slot's
+    cache prefix.  With ``slots=None`` (full-slot decode bucket) the
+    cache rows are consumed IN PLACE -- one HBM read, the jaxpr pin in
+    tests/test_transformer.py; a compacted bucket gathers its rows
+    first (one extra pass -- the cost of running a smaller executable,
+    documented in docs/serving.md)."""
+    from chainermn_tpu import ops
+
+    def rows(name):
+        full = cache[name][layer]
+        return full if slots is None else jnp.take(
+            full, slots.astype(jnp.int32), axis=0)
+
+    if _cache_int8(cache):
+        return ops.flash_attention_decode(
+            q, rows('k'), rows('v'), lengths,
+            k_scale=rows('k_scale'), v_scale=rows('v_scale'))
+    return ops.flash_attention_decode(q, rows('k'), rows('v'),
+                                      lengths)
+
+
+def _tp_embed_rows(params, tokens, vocab_size, d_model, dtype, axis):
+    """Forward-only twin of ``TransformerLM._tp_embed`` for a flat
+    (N,) token vector: masked local lookup + one psum."""
+    tp = lax.axis_size(axis)
+    v_local = vocab_size // tp
+    emb = params['embed']['embedding']
+    local = tokens - lax.axis_index(axis) * v_local
+    in_shard = (local >= 0) & (local < v_local)
+    rows = jnp.take(emb, jnp.clip(local, 0, v_local - 1), axis=0)
+    x = jnp.where(in_shard[..., None], rows,
+                  jnp.zeros((), rows.dtype)).astype(dtype)
+    return lax.psum(x, axis)
+
+
+def _head_logits(model, params, x):
+    """The lm head on (..., d_model) activations -- non-tp
+    ``nn.Dense(vocab, dtype=f32)`` twin or the row-parallel tp form
+    (one psum), matching ``TransformerLM._tp_head``."""
+    from chainermn_tpu.parallel import tensor
+
+    if model.tp_axis is None:
+        return _dense(x.astype(model.dtype), params['lm_head'],
+                      jnp.float32)
+    tp = lax.axis_size(model.tp_axis)
+    d_local = model.d_model // tp
+    xh = x.astype(model.dtype)
+    x_local = lax.dynamic_slice_in_dim(
+        xh, lax.axis_index(model.tp_axis) * d_local, d_local, axis=-1)
+    return tensor.row_parallel_dense(
+        x_local.astype(jnp.float32),
+        params['lm_head']['kernel'].astype(jnp.float32),
+        model.tp_axis, params['lm_head']['bias'])
+
+
+def decode_step(model, params, cache, tokens, positions, slots=None):
+    """One incremental decode step: ``tokens`` (N,) int32 -- the last
+    sampled token per row -- at ``positions`` (N,) int32 (0-based;
+    this token's K/V lands there and attention covers
+    ``positions + 1`` cache entries).  ``slots`` (N,) int32 maps rows
+    to cache slots for a compacted active-slot bucket; ``None`` (the
+    full bucket) requires ``N == n_slots`` and reads the cache in
+    place.  Returns ``(logits (N, vocab) f32, new_cache)``.
+
+    Works under ``tp_axis`` inside ``shard_map`` exactly like
+    ``__call__`` (heads and cache sharded over the axis, one psum per
+    half-block); parity vs the full-sequence causal forward is pinned
+    in tests/test_transformer.py, including across slot refills.
+    """
+    from chainermn_tpu import ops
+    from chainermn_tpu.parallel import tensor
+
+    if slots is None and tokens.shape[0] != cache['k'].shape[1]:
+        raise ValueError(
+            'full-bucket decode needs one row per cache slot '
+            '(%d rows vs %d slots); pass slots= for a compacted '
+            'bucket' % (tokens.shape[0], cache['k'].shape[1]))
+    dtype = model.dtype
+    tp_mode = model.tp_axis is not None
+    if tp_mode:
+        x = _tp_embed_rows(params, tokens, model.vocab_size,
+                           model.d_model, dtype, model.tp_axis)
+    else:
+        x = jnp.take(params['embed']['embedding'], tokens,
+                     axis=0).astype(dtype)
+    x = x + jnp.take(params['pos_embed'], positions,
+                     axis=0).astype(dtype)
+    lengths = positions.astype(jnp.int32) + 1
+    for i in range(model.n_layers):
+        bp = params['block_%d' % i]
+        h = ops.layer_norm(x, bp['ln1_scale'],
+                           bp['ln1_bias']).astype(dtype)
+        qkv = _qkv_proj(h, bp, dtype)               # (N, 3, H, d_head)
+        q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+        cache = _write_kv(cache, i, k_new, v_new, slots, positions)
+        attn = _attend_cache(cache, i, q, slots, lengths)
+        attn = attn.reshape(attn.shape[0], -1)
+        if tp_mode:
+            out = tensor.row_parallel_dense(
+                attn, bp['proj']['kernel'].astype(dtype),
+                model.tp_axis, bp['proj']['bias'].astype(dtype))
+        else:
+            out = _dense(attn, bp['proj'], dtype)
+        x = x + out
+        h = ops.layer_norm(x, bp['ln2_scale'],
+                           bp['ln2_bias']).astype(dtype)
+        if tp_mode:
+            g = nn.gelu(tensor.column_parallel_dense(
+                h, bp['ff_in']['kernel'].astype(dtype),
+                bp['ff_in']['bias'].astype(dtype)))
+            x = x + tensor.row_parallel_dense(
+                g, bp['ff_out']['kernel'].astype(dtype),
+                model.tp_axis, bp['ff_out']['bias'].astype(dtype))
+        else:
+            x = x + _dense(nn.gelu(_dense(h, bp['ff_in'], dtype)),
+                           bp['ff_out'], dtype)
+    x = ops.layer_norm(x, params['lnf_scale'], params['lnf_bias'])
+    return _head_logits(model, params, x), cache
+
+
+def prefill(model, params, cache, tokens, length, slot):
+    """Prefill one prompt into cache slot ``slot``: ``tokens``
+    (1, T) int32 padded to a prompt bucket, ``length`` scalar int32
+    (valid prefix; positions beyond it are written but never attended
+    -- decode lengths start at ``length``).  Runs the full causal
+    forward ONCE (the compute-bound regime: whole-prompt matmuls
+    through the fused flash kernel), banks every layer's K/V at
+    ``cache[:, slot, :T]``, and returns ``(logits (vocab,) f32 at
+    position length-1, new_cache)`` -- the distribution the first
+    generated token is sampled from."""
+    from chainermn_tpu import ops
+    from chainermn_tpu.parallel import tensor
+    from chainermn_tpu.precision import quantize_kv
+
+    dtype = model.dtype
+    tp_mode = model.tp_axis is not None
+    b, t = tokens.shape
+    if b != 1:
+        raise ValueError('prefill takes one prompt per call, got '
+                         'batch %d (prompt-length bucketing would be '
+                         'meaningless across a batch)' % b)
+    if tp_mode:
+        x = _tp_embed_rows(params, tokens, model.vocab_size,
+                           model.d_model, dtype, model.tp_axis)
+    else:
+        x = jnp.take(params['embed']['embedding'], tokens,
+                     axis=0).astype(dtype)
+    x = x + params['pos_embed'][:t].astype(dtype)
+    slot = jnp.asarray(slot, jnp.int32)
+    int8_kv = _cache_int8(cache)
+    cache = dict(cache)
+    for i in range(model.n_layers):
+        bp = params['block_%d' % i]
+        h = ops.layer_norm(x, bp['ln1_scale'],
+                           bp['ln1_bias']).astype(dtype)
+        qkv = _qkv_proj(h, bp, dtype)           # (1, T, 3, H, d_head)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = ops.flash_attention(q, k, v, causal=True)
+        attn = attn.reshape(1, t, -1)
+        for name, val in (('k', k[0]), ('v', v[0])):
+            if int8_kv:
+                qv, scale = quantize_kv(val)
+                cache[name] = lax.dynamic_update_slice(
+                    cache[name], qv[None, None],
+                    (i, slot, 0, 0, 0))
+                cache[name + '_scale'] = lax.dynamic_update_slice(
+                    cache[name + '_scale'], scale[None, None],
+                    (i, slot, 0, 0))
+            else:
+                cache[name] = lax.dynamic_update_slice(
+                    cache[name],
+                    val.astype(cache[name].dtype)[None, None],
+                    (i, slot, 0, 0, 0))
+        if tp_mode:
+            out = tensor.row_parallel_dense(
+                attn, bp['proj']['kernel'].astype(dtype),
+                model.tp_axis, bp['proj']['bias'].astype(dtype))
+        else:
+            out = _dense(attn, bp['proj'], dtype)
+        x = x + out
+        h = ops.layer_norm(x, bp['ln2_scale'],
+                           bp['ln2_bias']).astype(dtype)
+        if tp_mode:
+            g = nn.gelu(tensor.column_parallel_dense(
+                h, bp['ff_in']['kernel'].astype(dtype),
+                bp['ff_in']['bias'].astype(dtype)))
+            x = x + tensor.row_parallel_dense(
+                g, bp['ff_out']['kernel'].astype(dtype),
+                model.tp_axis, bp['ff_out']['bias'].astype(dtype))
+        else:
+            x = x + _dense(nn.gelu(_dense(h, bp['ff_in'], dtype)),
+                           bp['ff_out'], dtype)
+    # the head only needs the LAST VALID position's activation --
+    # a (1, d) slice instead of a (T, vocab) logits block
+    x_last = lax.dynamic_slice_in_dim(
+        x[0], jnp.asarray(length, jnp.int32) - 1, 1, axis=0)
+    x_last = ops.layer_norm(x_last, params['lnf_scale'],
+                            params['lnf_bias'])
+    return _head_logits(model, params, x_last)[0], cache
+
+
 def pipeline_parts(model, params, n_stages, pad_id=-1):
     """Split a ``TransformerLM`` parameter tree into
     :class:`~chainermn_tpu.training.PipelineUpdater` pieces.

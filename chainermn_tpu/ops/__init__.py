@@ -16,7 +16,8 @@ interpret mode only when explicitly requested
 """
 
 from chainermn_tpu.ops.flash_attention import (  # noqa
-    flash_attention, mha_reference)
+    decode_attention_reference, flash_attention,
+    flash_attention_decode, mha_reference)
 from chainermn_tpu.ops.cross_entropy import (  # noqa
     softmax_cross_entropy, softmax_cross_entropy_reference)
 from chainermn_tpu.ops.layer_norm import layer_norm, layer_norm_reference  # noqa

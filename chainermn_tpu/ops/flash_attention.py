@@ -483,6 +483,268 @@ def _flash_bwd(causal, scale, kv_len, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+# ----------------------------------------------------------------------
+# decode attention -- one query row per sequence against a KV cache.
+#
+# The serving regime flips the bound: prefill streams the whole prompt
+# through the MXU, but every subsequent token attends ONE query row
+# against the sequence's cached K/V -- pure HBM bandwidth, no reuse.
+# The decode kernel therefore reuses the forward kernel's
+# online-softmax recurrence (running m/l/acc in VMEM scratch) but
+# carries a single query row per grid cell, streams the cache in ONE
+# HBM pass, and masks by a PER-SEQUENCE dynamic length (each cache
+# slot is filled to a different depth under continuous batching).
+# Forward-only by design: decode is inference, there is no backward.
+#
+# int8 KV cache: pass int8 k/v plus per-(position, head) symmetric
+# scales (precision.quantize_kv) and the dequant multiply runs in
+# VMEM right before each tile's matmul -- the HBM bytes the step is
+# bound by are the int8 ones.
+# ----------------------------------------------------------------------
+
+def decode_attention_reference(q, k, v, lengths, scale=None,
+                               k_scale=None, v_scale=None):
+    """Pure-jnp oracle for :func:`flash_attention_decode`.
+
+    q: (B, H, D) -- the current token's query per sequence;
+    k/v: (B, S, H, D) cache (float, or int8 with ``k_scale``/
+    ``v_scale`` (B, S, H) per-(position, head) scales);
+    lengths: (B,) int32 -- positions ``>= lengths[b]`` are masked out.
+    Returns (B, H, D) in q's dtype.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    if k_scale is not None:
+        kf = kf * k_scale.astype(jnp.float32)[..., None]
+    if v_scale is not None:
+        vf = vf * v_scale.astype(jnp.float32)[..., None]
+    s = jnp.einsum('bhd,bkhd->bhk', q.astype(jnp.float32), kf) * scale
+    k_pos = jnp.arange(k.shape[1])
+    ok = k_pos[None, None, :] < lengths[:, None, None]
+    s = jnp.where(ok, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bhk,bkhd->bhd', p, vf).astype(q.dtype)
+
+
+def _decode_blockwise_jnp(q, k, v, lengths, scale, block_k,
+                          k_scale=None, v_scale=None):
+    """Fallback decode: the kernel's online-softmax recurrence via
+    ``lax.scan`` over key blocks -- ONE consumption of the cache
+    operands, never a materialized (S,)-wide probability row in f32
+    beyond the per-block tile."""
+    bh, t_kv, d = k.shape
+    n_blocks = t_kv // block_k
+    qf = q.astype(jnp.float32) * scale                 # (bh, d)
+    kb = jnp.swapaxes(k.reshape(bh, n_blocks, block_k, d), 0, 1)
+    vb = jnp.swapaxes(v.reshape(bh, n_blocks, block_k, d), 0, 1)
+    scan_over = [jnp.arange(n_blocks), kb, vb]
+    if k_scale is not None:
+        scan_over.append(jnp.swapaxes(
+            k_scale.reshape(bh, n_blocks, block_k), 0, 1))
+        scan_over.append(jnp.swapaxes(
+            v_scale.reshape(bh, n_blocks, block_k), 0, 1))
+
+    def body(carry, inp):
+        m, l, acc = carry
+        if k_scale is not None:
+            j, kj, vj, ksj, vsj = inp
+            kjf = kj.astype(jnp.float32) * ksj[..., None]
+            vjf = vj.astype(jnp.float32) * vsj[..., None]
+        else:
+            j, kj, vj = inp
+            kjf = kj.astype(jnp.float32)
+            vjf = vj.astype(jnp.float32)
+        s = jnp.einsum('bd,bkd->bk', qf, kjf)          # (bh, block_k)
+        k_pos = j * block_k + jnp.arange(block_k)
+        s = jnp.where(k_pos[None, :] < lengths[:, None], s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jnp.einsum('bk,bkd->bd', p, vjf)
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((bh,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh,), jnp.float32)
+    acc0 = jnp.zeros((bh, d), jnp.float32)
+    (m, l, acc), _ = lax.scan(body, (m0, l0, acc0), tuple(scan_over))
+    l_safe = jnp.maximum(l, 1e-30)
+    return (acc / l_safe[:, None]).astype(q.dtype)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+                   o_ref, m_ref, l_ref, acc_ref, *, scale, block_k,
+                   quantized):
+    """One (batch*head, key-block) grid cell: a single query row's
+    online-softmax update against one cache tile.  The running
+    (m, l, acc) state lives in VMEM scratch across the sequential
+    key-block axis; the per-sequence length arrives via SMEM and
+    gates both the mask and the whole-tile skip."""
+    import jax.experimental.pallas as pl
+
+    kj = pl.program_id(1)
+    n_kv = pl.num_programs(1)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[0, 0]
+
+    # tiles entirely beyond this sequence's fill level contribute
+    # nothing; the dynamic pl.when skips their VPU/MXU work
+    @pl.when(kj * block_k < length)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32) * scale       # (1, D)
+        k = k_ref[0].astype(jnp.float32)               # (block_k, D)
+        v = v_ref[0].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0].astype(jnp.float32)
+            v = v * vs_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (1, block_k)
+        k_pos = (kj * block_k
+                 + lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+        s = jnp.where(k_pos < length, s, NEG_INF)
+        m_prev = m_ref[...]                            # (1, 128)
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        m_ref[...] = m_new
+        l_ref[...] = (l_prev * alpha
+                      + jnp.sum(p, axis=-1, keepdims=True))
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == n_kv - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k, v, lengths, scale, block_k,
+                   k_scale=None, v_scale=None):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_kv, d = k.shape
+    quantized = k_scale is not None
+    q3 = q[:, None, :]                                 # (bh, 1, d)
+    len2 = lengths.astype(jnp.int32)[:, None]          # (bh, 1)
+    if quantized:
+        ks3 = k_scale[..., None].astype(jnp.float32)   # (bh, S, 1)
+        vs3 = v_scale[..., None].astype(jnp.float32)
+    else:
+        # zero-size placeholders keep one kernel signature; the
+        # quantized flag compiles the dequant multiply in or out
+        ks3 = jnp.zeros((bh, t_kv, 1), jnp.float32)
+        vs3 = ks3
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale,
+                          block_k=block_k, quantized=quantized),
+        grid=(bh, t_kv // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda b, j: (b, 0),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, 1), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, 1), lambda b, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda b, j: (b, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bh, 1, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((1, 128), jnp.float32),         # m (replicated)
+            pltpu.VMEM((1, 128), jnp.float32),         # l (replicated)
+            pltpu.VMEM((1, d), jnp.float32),           # acc
+        ],
+        interpret=interpret_flag(),
+    )(len2, q3, k, v, ks3, vs3)
+    return out[:, 0, :]
+
+
+def flash_attention_decode(q, k, v, lengths, scale=None,
+                           k_scale=None, v_scale=None, block_k=None):
+    """Single-token decode attention against a per-sequence KV cache.
+
+    q: (B, H, D) -- one query row per sequence (the token being
+    generated); k/v: (B, S, H, D) -- the cache, filled to
+    ``lengths[b]`` positions per sequence (the current token's K/V
+    already written at ``lengths[b] - 1``).  Positions at or beyond
+    ``lengths[b]`` -- padding, stale rows from a previous occupant of
+    the cache slot -- receive no probability mass, which is what makes
+    slot REUSE safe without zeroing (``docs/serving.md``).
+
+    Causality is implicit: future positions are simply not in the
+    cache yet.  The cache is streamed in ONE HBM pass (the grid's
+    sequential key-block axis) with the online-softmax running state
+    in VMEM scratch; nothing (S,)-sized is materialized beyond the
+    per-block tile.  Forward-only -- decode is inference.
+
+    int8 KV mode: pass int8 ``k``/``v`` with per-(position, head)
+    symmetric scales ``k_scale``/``v_scale`` (B, S, H) from
+    :func:`chainermn_tpu.precision.quantize_kv`; dequantization runs
+    in VMEM per tile, so the HBM traffic the decode step is bound by
+    is halved vs bf16 (quartered vs f32).
+
+    ``block_k`` defaults to 128 (``CHAINERMN_TPU_FA_BLOCK_K``
+    overrides, same knob as :func:`flash_attention`).
+    """
+    if block_k is None:
+        block_k = _env_block('CHAINERMN_TPU_FA_BLOCK_K')
+    b, h, d = q.shape
+    t_kv = k.shape[1]
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError('int8 KV decode needs BOTH k_scale and '
+                         'v_scale (or neither)')
+    if scale is None:
+        scale = d ** -0.5
+    block_k = min(block_k, max(t_kv, 1))
+
+    def merge(x):
+        # (B, S, H, D) -> (B*H, S, D)
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    def merge_scale(s):
+        # (B, S, H) -> (B*H, S)
+        return jnp.swapaxes(s, 1, 2).reshape(b * h, s.shape[1])
+
+    qm = q.reshape(b * h, d)
+    km, vm = merge(k), merge(v)
+    ksm = merge_scale(k_scale) if k_scale is not None else None
+    vsm = merge_scale(v_scale) if v_scale is not None else None
+    lengths_bh = jnp.repeat(lengths.astype(jnp.int32), h)
+    pad_k = (-t_kv) % block_k
+    if pad_k:
+        km = jnp.pad(km, ((0, 0), (0, pad_k), (0, 0)))
+        vm = jnp.pad(vm, ((0, 0), (0, pad_k), (0, 0)))
+        if ksm is not None:
+            ksm = jnp.pad(ksm, ((0, 0), (0, pad_k)))
+            vsm = jnp.pad(vsm, ((0, 0), (0, pad_k)))
+    if pallas_mode() == 'fallback':
+        out = _decode_blockwise_jnp(qm, km, vm, lengths_bh, scale,
+                                    block_k, ksm, vsm)
+    else:
+        out = _decode_pallas(qm, km, vm, lengths_bh, scale, block_k,
+                             ksm, vsm)
+    return out.reshape(b, h, d)
+
+
 def _env_block(name, default=128):
     """Validated env-sourced block size: a fleet-wide launcher knob
     must fail naming itself, not as an opaque int()/ZeroDivision deep

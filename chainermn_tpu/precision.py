@@ -233,6 +233,34 @@ def dequantize_int8(tree, dtype=jnp.float32):
     return jax.tree_util.tree_map(one, tree, is_leaf=is_quantized)
 
 
+def quantize_kv(x):
+    """Per-vector symmetric int8 quantization over the LAST axis --
+    the KV-cache member of the :func:`quantize_int8` family.
+
+    Where weight quantization reduces over every axis but the output
+    channel (static content, computed once at load), a KV cache is
+    written one token at a time and each (position, head) vector's
+    dynamic range is its own: ``scale = max|x| / 127`` over the head
+    dim, ``q = round(x / scale)`` clipped to +-127.  Returns
+    ``(q int8 of x.shape, scale f32 of x.shape[:-1])`` -- what
+    :func:`chainermn_tpu.ops.flash_attention_decode` consumes as
+    ``k_scale``/``v_scale`` and dequantizes per tile in VMEM, so the
+    HBM bytes the decode step streams are the int8 ones
+    (``docs/serving.md``)."""
+    xf = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_kv(q, scale, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv` (up to rounding): a per-vector
+    multiply that XLA/Pallas fuses into the consumer's operand read."""
+    return q.astype(dtype) * scale[..., None].astype(dtype)
+
+
 def quantization_error(tree, qtree):
     """Worst relative Frobenius error over quantized leaves --
     the load-time sanity number the engine logs (int8 per-channel

@@ -20,7 +20,14 @@ Three cooperating layers (``docs/serving.md``):
   elastic-resume checkpoints;
 - :mod:`~chainermn_tpu.serving.loadgen` -- the synthetic OPEN-loop
   generator behind ``bench.py --serve`` and the tier-1 end-to-end
-  proof (overload must shed typed, never wedge).
+  proof (overload must shed typed, never wedge);
+- :mod:`~chainermn_tpu.serving.generate` -- the AUTOREGRESSIVE path
+  (ISSUE 11): a :class:`GenerationEngine` with a slot-addressed,
+  bucketed KV cache living across calls, continuous token-level
+  batching (a finished or cancelled sequence's slot refills from the
+  queue at the next decode step), a prefill/decode AOT split (prefill
+  bucketed by prompt length, decode by active-slot count), int8
+  KV-cache mode, and the same no-recompile signature guard.
 """
 
 from chainermn_tpu.serving.batcher import (  # noqa: F401
@@ -28,5 +35,8 @@ from chainermn_tpu.serving.batcher import (  # noqa: F401
     pack_sizes)
 from chainermn_tpu.serving.engine import (  # noqa: F401
     InferenceEngine, load_params)
-from chainermn_tpu.serving.loadgen import open_loop  # noqa: F401
+from chainermn_tpu.serving.generate import (  # noqa: F401
+    GenerationEngine, GenerationQueue, GenRequest)
+from chainermn_tpu.serving.loadgen import (  # noqa: F401
+    open_loop, open_loop_generate)
 from chainermn_tpu.utils.failure import OverloadError  # noqa: F401

@@ -1,0 +1,761 @@
+"""Autoregressive generation: bucketed KV-cache decode with
+continuous token-level batching over a prefill/decode AOT split.
+
+The forward-only :class:`~chainermn_tpu.serving.InferenceEngine`
+serves one batch per request mix; token-by-token generation is a
+different machine with a different bound in each phase:
+
+- **Prefill** (the prompt pass) is compute-bound -- whole-prompt
+  matmuls through the fused flash kernel -- and its natural bucket
+  axis is PROMPT LENGTH: one AOT executable per power-of-two token
+  length, one prompt per call, writing every layer's K/V into one
+  cache SLOT (:func:`chainermn_tpu.models.prefill`).
+- **Decode** (every subsequent token) is HBM-bandwidth-bound -- one
+  query row per live sequence against its cached K/V
+  (:func:`chainermn_tpu.ops.flash_attention_decode`, one HBM pass)
+  -- and its bucket axis is ACTIVE-SLOT COUNT: one AOT executable per
+  power-of-two slot count over the SAME persistent cache
+  (:func:`chainermn_tpu.models.decode_step`).
+
+Between the two sits **continuous batching**: admission happens at
+TOKEN granularity, not batch granularity.  A sequence that finishes
+(or whose deadline expires mid-generation -- the ``serve_cancel``
+chaos site drives exactly this) frees its cache slot, and the slot is
+refilled from the queue at the NEXT decode step; the rest of the
+in-flight batch never waits for stragglers, which is what makes
+tokens/s/chip under a mixed-length workload approach the steady-state
+decode rate instead of the worst sequence's (the batch-level
+alternative idles every finished slot until the whole batch drains).
+
+Both executable families reuse the engine machinery wholesale: AOT
+compilation through :func:`~chainermn_tpu.utils.jax_compat.
+aot_compile` over the persistent compilation cache, the SL007
+``abstract_signature`` set as a runtime no-recompile guard (refused,
+never retraced -- the static twin is the ``step:decode_forward``
+shardlint target), :class:`~chainermn_tpu.parallel.MeshPlan`
+tensor-parallel sharding (cache heads shard with the attention
+weights, :func:`chainermn_tpu.models.kv_cache_specs`), float policies
+cast weights at load, :class:`~chainermn_tpu.precision.Int8Policy`
+quantizes them, and ``int8_kv=True`` stores the CACHE itself int8
+with per-(position, head) scales
+(:func:`~chainermn_tpu.precision.quantize_kv`) -- halving the bytes
+the decode step is bound by.
+
+The cache is DONATED into every prefill/decode executable and the
+returned buffer rebound, so steady-state decode allocates nothing
+cache-sized.  Telemetry: ``serve_prefill``/``serve_decode`` spans
+(``iteration`` = decode step index), a per-step ``active_slots``
+gauge, ``serve_ttft_seconds`` / ``serve_intertoken_seconds`` /
+``serve_decode_seconds`` raw-sample histograms and
+``serve_tokens_total`` -- the ``telemetry report``/``doctor`` serve
+section renders tokens/s and TTFT from them (``docs/serving.md``).
+"""
+
+import threading
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from chainermn_tpu import telemetry as _telemetry
+from chainermn_tpu.analysis.walker import abstract_signature
+from chainermn_tpu.serving.batcher import bucket_edges, bucket_of
+from chainermn_tpu.utils import chaos as _chaos
+from chainermn_tpu.utils import jax_compat
+from chainermn_tpu.utils.failure import OverloadError
+
+#: default admission knobs (the generation twins of batcher's)
+DEFAULT_MAX_QUEUE = 256
+
+
+class GenRequest:
+    """One in-flight generation request: ``prompt`` (1-D int32 token
+    ids), ``max_new_tokens``, optional absolute ``deadline``
+    (``clock()`` units, enforced at admission AND between decode
+    steps), and a one-shot completion cell filled with the generated
+    token ids or a typed error."""
+
+    __slots__ = ('prompt', 'max_new_tokens', 'deadline', 'seq',
+                 't_submit', 'synthetic', '_done', '_result', '_error')
+
+    def __init__(self, prompt, max_new_tokens, deadline=None, seq=0,
+                 t_submit=0.0, synthetic=False):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError('empty prompt')
+        if max_new_tokens < 1:
+            raise ValueError('max_new_tokens must be >= 1, got %d'
+                             % max_new_tokens)
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline
+        self.seq = seq
+        self.t_submit = t_submit
+        self.synthetic = synthetic
+        self._done = threading.Event()
+        self._result = None
+        self._error = None
+
+    def set_result(self, tokens):
+        self._result = np.asarray(tokens, np.int32)
+        self._done.set()
+
+    def set_error(self, exc):
+        self._error = exc
+        self._done.set()
+
+    def done(self):
+        return self._done.is_set()
+
+    def result(self, timeout=None):
+        """Block for the generated tokens; re-raises the typed shed
+        error (``OverloadError`` with reason queue_full / deadline /
+        shutdown)."""
+        if not self._done.wait(timeout):
+            raise TimeoutError('request %d not completed within %rs'
+                               % (self.seq, timeout))
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class GenerationQueue:
+    """Bounded admission queue for generation requests.
+
+    Unlike the batch queue there is no packing: the engine pops AT
+    MOST as many requests as it has free cache slots each decode step
+    (token-level admission).  The bounded-backlog / typed-shed /
+    ``serve_burst`` contracts are identical to
+    :class:`~chainermn_tpu.serving.RequestQueue`."""
+
+    def __init__(self, max_prompt_len, max_queue=DEFAULT_MAX_QUEUE,
+                 clock=time.monotonic):
+        self.max_prompt_len = int(max_prompt_len)
+        self.max_queue = int(max_queue)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._waiting = []
+        self._seq = 0
+        self._closed = False
+        self.submitted = 0
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
+
+    def submit(self, prompt, max_new_tokens, deadline=None):
+        """Enqueue one prompt; returns the :class:`GenRequest`.
+        Over-length prompts raise ``ValueError`` before touching
+        queue state; a full or closed queue sheds typed."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size > self.max_prompt_len:
+            raise ValueError(
+                'prompt of %d tokens exceeds max_prompt_len %d; '
+                'truncate client-side or raise the engine limit'
+                % (prompt.size, self.max_prompt_len))
+        burst = (_chaos.on_serve_submit()
+                 if _chaos._active is not None else 0)
+        with self._lock:
+            req = self._admit(prompt, max_new_tokens, deadline)
+            for _ in range(burst):
+                try:
+                    self._admit(prompt, max_new_tokens, deadline,
+                                synthetic=True)
+                except OverloadError:
+                    break
+        return req
+
+    def _admit(self, prompt, max_new_tokens, deadline,
+               synthetic=False):
+        if self._closed:
+            raise OverloadError('generation queue is shut down',
+                                reason='shutdown',
+                                queue_depth=len(self._waiting))
+        if len(self._waiting) >= self.max_queue:
+            self.shed_queue_full += 1
+            reg = _telemetry.registry()
+            if reg is not None:
+                reg.counter('serve_shed_total',
+                            help='requests shed by the admission '
+                                 'layer (queue_full + deadline)').inc()
+            raise OverloadError(
+                'generation queue full (%d waiting); retry with '
+                'backoff' % len(self._waiting),
+                reason='queue_full', queue_depth=len(self._waiting))
+        self._seq += 1
+        self.submitted += 1
+        req = GenRequest(prompt, max_new_tokens, deadline=deadline,
+                         seq=self._seq, t_submit=self._clock(),
+                         synthetic=synthetic)
+        self._waiting.append(req)
+        return req
+
+    def pop(self, k):
+        """Up to ``k`` live requests in arrival order; requests whose
+        deadline already expired while queued are shed typed here (the
+        queue-side twin of the engine's mid-generation expiry)."""
+        now = self._clock()
+        out = []
+        with self._lock:
+            while self._waiting and len(out) < k:
+                req = self._waiting.pop(0)
+                if req.deadline is not None and now > req.deadline:
+                    self.shed_deadline += 1
+                    reg = _telemetry.registry()
+                    if reg is not None:
+                        reg.counter('serve_shed_total').inc()
+                    req.set_error(OverloadError(
+                        'deadline expired after %.1f ms in queue'
+                        % ((now - req.t_submit) * 1e3),
+                        reason='deadline'))
+                    continue
+                out.append(req)
+        return out
+
+    def depth(self):
+        with self._lock:
+            return len(self._waiting)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            pending, self._waiting = self._waiting, []
+        for req in pending:
+            req.set_error(OverloadError('generation queue shut down',
+                                        reason='shutdown'))
+
+    def stats(self):
+        return {'submitted': self.submitted,
+                'shed_queue_full': self.shed_queue_full,
+                'shed_deadline': self.shed_deadline,
+                'depth': self.depth()}
+
+
+class _Slot:
+    """Host-side state of one cache slot."""
+
+    __slots__ = ('request', 'position', 'remaining', 'generated',
+                 't_last_token')
+
+    def __init__(self, request, position, remaining, first_token,
+                 t_now):
+        self.request = request
+        self.position = position          # next token's position
+        self.remaining = remaining        # tokens still to generate
+        self.generated = [first_token]
+        self.t_last_token = t_now
+
+
+class GenerationEngine:
+    """Continuous-batching autoregressive server for one
+    :class:`~chainermn_tpu.models.TransformerLM`.
+
+    Args:
+      model: the flax module (``tp_axis`` set when serving over
+        ``plan``/``param_specs``).
+      params: the parameter pytree (the UNSHARDED oracle tree; tp
+        placement is spec-driven).
+      n_slots: cache slots = max concurrent sequences.  Decode
+        executables are bucketed by power-of-two ACTIVE-slot count up
+        to this.
+      max_prompt_len: prompt-length cap; prefill executables are
+        bucketed by power-of-two prompt length up to this.
+      max_len: cache depth per slot (prompt + generated tokens;
+        default ``model.max_len``).
+      eos_id: optional stop token (greedy decode stops early on it).
+      policy: float policy casts weights at load;
+        :class:`~chainermn_tpu.precision.Int8Policy` quantizes them
+        (dequant in-graph; refused under ``param_specs`` like the
+        batch engine).
+      int8_kv: store the KV cache int8 with per-(position, head)
+        scales -- half the decode-bound HBM bytes of bf16.
+      plan / param_specs: MeshPlan tensor-parallel serving (the cache
+        shards its head dim over ``plan.model_axis``).
+      cache_dir / aot: the engine's persistent-compilation-cache and
+        AOT knobs, verbatim.
+
+    Decoding is GREEDY (argmax in-graph -- the sampled token never
+    round-trips a vocab-sized buffer to the host), which also makes
+    every test and A/B deterministic.
+    """
+
+    def __init__(self, model, params, n_slots=8, max_prompt_len=64,
+                 max_len=None, eos_id=None, policy=None,
+                 int8_kv=False, plan=None, param_specs=None,
+                 cache_dir=None, aot=True):
+        import os
+
+        from chainermn_tpu.models import init_kv_cache, kv_cache_specs
+
+        self.model = model
+        self.n_slots = int(n_slots)
+        self.max_prompt_len = int(max_prompt_len)
+        self.max_len = int(max_len or model.max_len)
+        if self.max_prompt_len > self.max_len:
+            raise ValueError('max_prompt_len %d exceeds cache depth '
+                             '%d' % (self.max_prompt_len, self.max_len))
+        self.eos_id = eos_id
+        self.policy = policy
+        self.plan = plan
+        if param_specs is not None and plan is None:
+            raise ValueError('param_specs requires a plan')
+        self.param_specs = param_specs
+        if (plan is not None) != (model.tp_axis is not None):
+            raise ValueError(
+                'serve a tp_axis model over a plan and a plain model '
+                'without one (tp_axis=%r, plan=%r)'
+                % (model.tp_axis, plan))
+        self.cache_dir = cache_dir
+        self.cache_persistent = False
+        if cache_dir:
+            os.makedirs(cache_dir, exist_ok=True)
+            self.cache_persistent = jax_compat.enable_compilation_cache(
+                cache_dir)
+        self.aot_requested = bool(aot)
+
+        self.prefill_edges = bucket_edges(self.max_prompt_len)
+        self.decode_edges = bucket_edges(self.n_slots)
+
+        # load-time parameter transform, the engine.py idiom
+        quantize = getattr(policy, 'quantize', None)
+        if quantize is not None:
+            if param_specs is not None:
+                raise NotImplementedError(
+                    'int8 weights under tensor-parallel param_specs '
+                    'are not wired yet (quantize per shard after '
+                    'resharding); int8_kv composes with tp, int8 '
+                    'WEIGHTS do not')
+            self.params = jax.device_put(quantize(params),
+                                         self._param_sharding())
+            self.quantized = True
+        else:
+            host = params
+            if policy is not None:
+                from chainermn_tpu.precision import cast_floating
+                host = cast_floating(host, policy.compute_dtype)
+            self.params = jax.device_put(host, self._param_sharding())
+            self.quantized = False
+
+        self.int8_kv = bool(int8_kv)
+        tp = plan.model_size if plan is not None else 1
+        del tp  # the GLOBAL cache is built unsharded; specs shard it
+        cache = init_kv_cache(model, self.n_slots, self.max_len,
+                              int8_kv=self.int8_kv, tp=1)
+        self._cache_specs = (kv_cache_specs(cache, plan.model_axis)
+                             if plan is not None else None)
+        self._cache = jax.device_put(cache, self._cache_sharding())
+
+        self._slots = {}      # slot id -> _Slot (active only)
+        self._free = list(range(self.n_slots))
+        self._prefill = {}    # prompt bucket -> callable
+        self._decode = {}     # slot bucket -> callable
+        self._signatures = set()
+        self._lock = threading.Lock()
+        self.prefill_trace_count = 0
+        self.decode_trace_count = 0
+        self.compile_count = 0
+        self.prefills = 0
+        self.decode_steps = 0
+        self.tokens_generated = 0
+        self.cancelled = 0
+        self._step_index = 0
+
+    # -- sharding ------------------------------------------------------
+    def _param_sharding(self):
+        if self.plan is None:
+            return jax.devices()[0]
+        if self.param_specs is None:
+            return self.plan.replicated()
+        return self.plan.param_shardings(self.param_specs)
+
+    def _cache_sharding(self):
+        if self.plan is None:
+            return jax.devices()[0]
+        return self.plan.param_shardings(self._cache_specs)
+
+    # -- traced bodies -------------------------------------------------
+    def _prepare_params(self, params):
+        if self.quantized:
+            return self.policy.dequantize(params)
+        return params
+
+    def _prefill_body(self, params, cache, tokens, length, slot):
+        from chainermn_tpu.models import prefill as model_prefill
+        self.prefill_trace_count += 1  # trace-time counter
+        logits, cache = model_prefill(
+            self.model, self._prepare_params(params), cache, tokens,
+            length, slot)
+        return jnp.argmax(logits).astype(jnp.int32), cache
+
+    def _decode_body(self, params, cache, tokens, positions,
+                     slots=None):
+        from chainermn_tpu.models import decode_step
+        self.decode_trace_count += 1   # trace-time counter
+        logits, cache = decode_step(
+            self.model, self._prepare_params(params), cache, tokens,
+            positions, slots=slots)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    def _mapped(self, body, n_extra):
+        """Wrap a traced body in the plan's shard_map (params sharded
+        per spec, cache per its spec, small int operands replicated)."""
+        if self.plan is None:
+            return body
+        from jax.sharding import PartitionSpec as P
+        pspecs = (self.param_specs if self.param_specs is not None
+                  else P())
+        return jax.shard_map(
+            body, mesh=self.plan.mesh,
+            in_specs=(pspecs, self._cache_specs) + (P(),) * n_extra,
+            out_specs=(P(), self._cache_specs), check_vma=False)
+
+    # -- compilation ---------------------------------------------------
+    def _compile(self, fn, args, table, key):
+        jitted = jax.jit(fn, donate_argnums=(1,))
+        exe = None
+        if self.aot_requested:
+            exe = jax_compat.aot_compile(jitted, self.params, *args)
+        aot = exe is not None
+        if exe is None:
+            exe = jitted
+        table[key] = (exe, aot)
+        self._signatures.add(abstract_signature(args))
+        self.compile_count += 1
+        return exe, aot
+
+    def _token_structs(self, bucket):
+        i32 = jnp.int32
+        return (jax.ShapeDtypeStruct((1, bucket), i32),
+                jax.ShapeDtypeStruct((), i32),
+                jax.ShapeDtypeStruct((), i32))
+
+    def _decode_structs(self, bucket):
+        i32 = jnp.int32
+        if bucket == self.n_slots:
+            return (jax.ShapeDtypeStruct((bucket,), i32),
+                    jax.ShapeDtypeStruct((bucket,), i32))
+        return (jax.ShapeDtypeStruct((bucket,), i32),
+                jax.ShapeDtypeStruct((bucket,), i32),
+                jax.ShapeDtypeStruct((bucket,), i32))
+
+    def _cache_struct(self):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self._cache)
+
+    def _get_prefill(self, bucket):
+        hit = self._prefill.get(bucket)
+        if hit is not None:
+            return hit[0]
+        with self._lock:
+            hit = self._prefill.get(bucket)
+            if hit is not None:
+                return hit[0]
+            if bucket not in self.prefill_edges:
+                raise RuntimeError(
+                    'prompt bucket %d is not an edge %r'
+                    % (bucket, list(self.prefill_edges)))
+            exe, _ = self._compile(
+                self._mapped(self._prefill_body, 3),
+                (self._cache_struct(),) + self._token_structs(bucket),
+                self._prefill, bucket)
+            return exe
+
+    def _decode_mapped(self, bucket):
+        """The decode callable for one slot-count bucket -- what gets
+        AOT-compiled, and what ``traceable_decode`` hands shardlint."""
+        if bucket == self.n_slots:
+            # full bucket: every slot decodes, the cache is read IN
+            # PLACE (no gather); rows are slots in order
+            return self._mapped(
+                lambda p, c, t, pos: self._decode_body(p, c, t, pos),
+                2)
+        # compacted bucket operand order: (tokens, slots, positions)
+        # -- what _decode_structs declares and the scheduler passes
+        return self._mapped(
+            lambda p, c, t, s, pos: self._decode_body(
+                p, c, t, pos, slots=s), 3)
+
+    def _get_decode(self, bucket):
+        hit = self._decode.get(bucket)
+        if hit is not None:
+            return hit[0]
+        with self._lock:
+            hit = self._decode.get(bucket)
+            if hit is not None:
+                return hit[0]
+            if bucket not in self.decode_edges:
+                raise RuntimeError(
+                    'decode bucket %d is not an edge %r'
+                    % (bucket, list(self.decode_edges)))
+            exe, _ = self._compile(
+                self._decode_mapped(bucket),
+                (self._cache_struct(),) + self._decode_structs(bucket),
+                self._decode, bucket)
+            return exe
+
+    def traceable_decode(self, bucket=None):
+        """``(fn, args)`` for ``jax.make_jaxpr`` -- the EXACT mapped
+        decode callable the engine compiles for ``bucket`` (default:
+        the full-slot bucket, whose cache read is in place), on zero
+        operands over the real cache/params: the shardlint
+        ``step:decode_forward`` target traces production code."""
+        bucket = bucket or self.n_slots
+        fn = self._decode_mapped(bucket)
+        args = [self.params, self._cache,
+                jnp.zeros((bucket,), jnp.int32)]
+        if bucket != self.n_slots:
+            args.append(jnp.arange(bucket, dtype=jnp.int32))
+        args.append(jnp.zeros((bucket,), jnp.int32))
+        return fn, tuple(args)
+
+    def warmup(self):
+        """Compile (or cache-load) every prefill and decode bucket
+        executable eagerly, largest first.  Fallback (plain-jit)
+        executables are forced to compile by running them on the real
+        cache -- slots are all free, so the garbage they write is
+        never attended (reads mask by live length).  Returns
+        ``{'prefill': {bucket: aot}, 'decode': {bucket: aot}}``."""
+        for bucket in sorted(self.prefill_edges, reverse=True):
+            with _telemetry.span('serve_warmup', kind='serve',
+                                 phase='prefill', bucket=bucket):
+                exe = self._get_prefill(bucket)
+                if not self._prefill[bucket][1]:
+                    tok, cache = exe(
+                        self.params, self._cache,
+                        jnp.zeros((1, bucket), jnp.int32),
+                        jnp.asarray(1, jnp.int32),
+                        jnp.asarray(0, jnp.int32))
+                    jax.block_until_ready(tok)
+                    self._cache = cache
+        for bucket in sorted(self.decode_edges, reverse=True):
+            with _telemetry.span('serve_warmup', kind='serve',
+                                 phase='decode', bucket=bucket):
+                exe = self._get_decode(bucket)
+                if not self._decode[bucket][1]:
+                    args = [jnp.zeros((bucket,), jnp.int32),
+                            jnp.zeros((bucket,), jnp.int32)]
+                    if bucket != self.n_slots:
+                        args.insert(1, jnp.arange(bucket,
+                                                  dtype=jnp.int32))
+                    tok, cache = exe(self.params, self._cache,
+                                     args[0], *args[1:])
+                    jax.block_until_ready(tok)
+                    self._cache = cache
+        return {'prefill': {b: a for b, (_, a)
+                            in sorted(self._prefill.items())},
+                'decode': {b: a for b, (_, a)
+                           in sorted(self._decode.items())}}
+
+    def guard_signature(self, args):
+        """The SL007 machinery as a runtime pin (the engine.py
+        contract): refuse any operand signature outside the
+        precompiled prefill/decode set instead of silently
+        retracing."""
+        sig = abstract_signature(args)
+        if sig not in self._signatures:
+            raise RuntimeError(
+                'no-recompile guard: operand signature %r is outside '
+                'the precompiled prefill/decode bucket set -- the '
+                'scheduler and executables disagree on bucket '
+                'geometry' % (sig,))
+        return sig
+
+    # -- the continuous-batching scheduler -----------------------------
+    def _expire(self, now, force=0):
+        """Shed active requests whose deadline passed (or the
+        ``force`` oldest, for the serve_cancel chaos site): typed
+        ``OverloadError(reason='deadline')`` NOW, slot freed for
+        refill at the next step's admission."""
+        doomed = []
+        for sid, slot in self._slots.items():
+            dl = slot.request.deadline
+            if dl is not None and now > dl:
+                doomed.append(sid)
+        if force:
+            for sid in sorted(
+                    (s for s in self._slots if s not in doomed),
+                    key=lambda s: self._slots[s].request.t_submit
+            )[:force]:
+                doomed.append(sid)
+        reg = _telemetry.registry()
+        for sid in doomed:
+            slot = self._slots.pop(sid)
+            self._free.append(sid)
+            self.cancelled += 1
+            slot.request.set_error(OverloadError(
+                'deadline expired mid-generation after %d tokens'
+                % len(slot.generated), reason='deadline'))
+            _telemetry.event('serve_cancel', kind='serve', slot=sid,
+                             tokens=len(slot.generated))
+            if reg is not None:
+                reg.counter('serve_shed_total',
+                            help='requests shed by the admission '
+                                 'layer (queue_full + deadline)').inc()
+        return len(doomed)
+
+    def _admit(self, queue, now, clock):
+        """Refill free slots from the queue: one PREFILL per request
+        (bucketed by prompt length), TTFT recorded when its first
+        token lands."""
+        reg = _telemetry.registry()
+        for req in queue.pop(len(self._free)):
+            sid = self._free.pop(0)
+            prompt = req.prompt
+            bucket = bucket_of(prompt.size, self.prefill_edges)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :prompt.size] = prompt
+            exe = self._get_prefill(bucket)
+            args = (jnp.asarray(tokens),
+                    jnp.asarray(prompt.size, jnp.int32),
+                    jnp.asarray(sid, jnp.int32))
+            self.guard_signature((self._cache_struct(),) + tuple(
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args))
+            with _telemetry.span('serve_prefill', kind='serve',
+                                 bucket=bucket, slot=sid,
+                                 iteration=self._step_index):
+                tok, cache = exe(self.params, self._cache, *args)
+                tok = int(jax.block_until_ready(tok))
+            self._cache = cache
+            self.prefills += 1
+            self.tokens_generated += 1
+            t_first = clock()
+            if reg is not None:
+                reg.histogram(
+                    'serve_ttft_seconds',
+                    help='submit-to-first-token latency (s)'
+                ).observe(t_first - req.t_submit)
+                reg.counter('serve_tokens_total',
+                            help='generated tokens').inc()
+            if self.eos_id is not None and tok == self.eos_id \
+                    or req.max_new_tokens == 1:
+                req.set_result([tok])
+                self._free.append(sid)
+                continue
+            self._slots[sid] = _Slot(req, prompt.size,
+                                     req.max_new_tokens - 1, tok,
+                                     t_first)
+
+    def _decode_once(self, clock):
+        """One decode step over every active slot, compacted to the
+        smallest slot-count bucket; finished sequences resolve and
+        free their slots (refilled at the NEXT step)."""
+        active = sorted(self._slots)
+        k = len(active)
+        bucket = bucket_of(k, self.decode_edges)
+        # pad with FREE slots (guaranteed available: bucket <= n_slots
+        # and only k are active) -- their writes land at position 0 of
+        # an unoccupied slot and are overwritten by the next prefill
+        pad = [s for s in self._free if s not in active]
+        rows = active + pad[:bucket - k]
+        tokens = np.asarray(
+            [self._slots[s].generated[-1] if s in self._slots else 0
+             for s in rows], np.int32)
+        positions = np.asarray(
+            [self._slots[s].position if s in self._slots else 0
+             for s in rows], np.int32)
+        exe = self._get_decode(bucket)
+        if bucket == self.n_slots:
+            args = (jnp.asarray(tokens), jnp.asarray(positions))
+        else:
+            args = (jnp.asarray(tokens),
+                    jnp.asarray(np.asarray(rows, np.int32)),
+                    jnp.asarray(positions))
+        self.guard_signature((self._cache_struct(),) + tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args))
+        reg = _telemetry.registry()
+        if reg is not None:
+            reg.gauge('active_slots',
+                      help='live sequences at this decode step'
+                      ).set(k)
+        t0 = clock()
+        with _telemetry.span('serve_decode', kind='serve',
+                             iteration=self._step_index,
+                             active_slots=k, bucket=bucket):
+            toks, cache = exe(self.params, self._cache, *args)
+            toks = np.asarray(jax.block_until_ready(toks))
+        self._cache = cache
+        now = clock()
+        if reg is not None:
+            reg.histogram('serve_decode_seconds',
+                          help='per-decode-step wall time (s)'
+                          ).observe(now - t0)
+            reg.counter('serve_tokens_total',
+                        help='generated tokens').inc(k)
+        itl = (reg.histogram('serve_intertoken_seconds',
+                             help='per-sequence gap between '
+                                  'consecutive tokens (s)')
+               if reg is not None else None)
+        for i, sid in enumerate(active):
+            slot = self._slots[sid]
+            tok = int(toks[i])
+            slot.generated.append(tok)
+            slot.position += 1
+            slot.remaining -= 1
+            if itl is not None:
+                itl.observe(now - slot.t_last_token)
+            slot.t_last_token = now
+            if slot.remaining == 0 or (self.eos_id is not None
+                                       and tok == self.eos_id):
+                slot.request.set_result(slot.generated)
+                del self._slots[sid]
+                self._free.append(sid)
+        self.decode_steps += 1
+        self.tokens_generated += k
+
+    def step(self, queue, clock=time.monotonic):
+        """One scheduler tick: expire -> admit (slot refill) -> one
+        decode step.  Returns True when any work happened."""
+        now = clock()
+        force = (_chaos.on_serve_cancel()
+                 if _chaos._active is not None else 0)
+        self._expire(now, force=force)
+        self._admit(queue, now, clock)
+        if not self._slots:
+            return False
+        self._decode_once(clock)
+        self._step_index += 1
+        return True
+
+    def run(self, queue, stop=None, idle_sleep=0.002):
+        """Scheduler loop: tick until ``stop`` is set AND the queue
+        and slot table are drained (the loadgen worker loop)."""
+        while True:
+            worked = self.step(queue)
+            if not worked:
+                if stop is not None and stop.is_set() \
+                        and queue.depth() == 0 and not self._slots:
+                    return
+                time.sleep(idle_sleep)
+
+    def stats(self):
+        return {
+            'prefill_buckets': sorted(self._prefill),
+            'decode_buckets': sorted(self._decode),
+            'prefill_edges': list(self.prefill_edges),
+            'decode_edges': list(self.decode_edges),
+            'n_slots': self.n_slots,
+            'aot': {'prefill': {b: a for b, (_, a)
+                                in sorted(self._prefill.items())},
+                    'decode': {b: a for b, (_, a)
+                               in sorted(self._decode.items())}},
+            'aot_requested': self.aot_requested,
+            'cache_persistent': self.cache_persistent,
+            'quantized': self.quantized,
+            'int8_kv': self.int8_kv,
+            'prefill_trace_count': self.prefill_trace_count,
+            'decode_trace_count': self.decode_trace_count,
+            'compile_count': self.compile_count,
+            'prefills': self.prefills,
+            'decode_steps': self.decode_steps,
+            'tokens_generated': self.tokens_generated,
+            'cancelled': self.cancelled,
+            'active_slots': len(self._slots),
+        }
+
+    # -- constructors --------------------------------------------------
+    @classmethod
+    def from_checkpoint(cls, path, model, params_template, **kw):
+        """Engine loaded from an elastic-resume training checkpoint
+        (the :func:`chainermn_tpu.serving.load_params` contract)."""
+        from chainermn_tpu.serving.engine import load_params
+        return cls(model, load_params(path, params_template), **kw)

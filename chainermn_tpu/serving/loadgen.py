@@ -1,4 +1,7 @@
-"""Synthetic open-loop load generator for the serving engine.
+"""Synthetic open-loop load generators for the serving engines
+(:func:`open_loop` for the batch :class:`InferenceEngine`,
+:func:`open_loop_generate` for the autoregressive
+:class:`GenerationEngine`).
 
 OPEN loop means arrivals are scheduled by a clock, not by completions
 (a closed-loop generator waits for each response and therefore can
@@ -31,6 +34,132 @@ def _hist_summary(reg, name):
         return {}
     snap = reg.snapshot().get(name)
     return (snap or {}).get('summary') or {}
+
+
+def open_loop_generate(engine, queue, rate, n_requests, seed=0,
+                       prompt_len_range=None, max_new_tokens=16,
+                       vocab_size=None, deadline_s=None,
+                       result_timeout=60.0, clock=time.monotonic,
+                       capture_dir=None):
+    """Open-loop driver for the autoregressive
+    :class:`~chainermn_tpu.serving.GenerationEngine` -- same
+    clock-scheduled arrival contract as :func:`open_loop` (shedding
+    IS the measurement), but the unit of work is a SEQUENCE and the
+    report's currency is TOKENS: generated tokens/s over the serve
+    window, time-to-first-token and inter-token p50/p99 from the
+    telemetry raw-sample histograms, plus the prefill/decode split's
+    compile/trace accounting (flat decode trace count across slot
+    refills is the continuous-batching no-recompile pin).
+
+    Args:
+      rate: offered request rate (req/s).
+      prompt_len_range: ``(lo, hi)`` inclusive prompt-length mix
+        (default ``(1, engine.max_prompt_len)``).
+      max_new_tokens: tokens to generate per request.
+      vocab_size: token-id range for the synthetic prompts (default
+        the engine model's).
+      deadline_s: per-request deadline -- expiry mid-generation sheds
+        typed through the serve_cancel path.
+    """
+    lo, hi = prompt_len_range or (1, engine.max_prompt_len)
+    vocab = vocab_size or engine.model.vocab_size
+    rng = np.random.RandomState(seed)
+    lens = rng.randint(lo, hi + 1, size=n_requests)
+    prompts = [rng.randint(0, vocab, size=n).astype(np.int32)
+               for n in lens]
+
+    _installed = None
+    if _telemetry.active() is None:
+        _installed = _telemetry.enable()
+
+    st0 = engine.stats()
+    stop = threading.Event()
+    worker = threading.Thread(target=engine.run, args=(queue, stop),
+                              daemon=True)
+    worker.start()
+
+    try:
+        admitted, shed_submit = [], 0
+        t0 = clock()
+        for i, prompt in enumerate(prompts):
+            target = t0 + i / float(rate)
+            delay = target - clock()
+            if delay > 0:
+                time.sleep(delay)
+            try:
+                admitted.append(queue.submit(
+                    prompt, max_new_tokens,
+                    deadline=(None if deadline_s is None
+                              else clock() + deadline_s)))
+            except OverloadError:
+                shed_submit += 1
+        served = shed_deadline = errored = 0
+        tokens_served = 0
+        for req in admitted:
+            try:
+                out = req.result(timeout=result_timeout)
+                served += 1
+                tokens_served += len(out)
+            except OverloadError:
+                shed_deadline += 1
+            except Exception:
+                errored += 1
+        t1 = clock()
+        reg = _telemetry.registry()
+    finally:
+        stop.set()
+        worker.join(timeout=result_timeout)
+        queue.close()
+        if capture_dir is not None and _telemetry.active() is not None:
+            try:
+                _telemetry.active().flush(capture_dir)
+            except Exception:
+                pass  # the report below is the primary artifact
+        if _installed is not None:
+            _telemetry.disable()
+    ttft = _hist_summary(reg, 'serve_ttft_seconds')
+    itl = _hist_summary(reg, 'serve_intertoken_seconds')
+    dstep = _hist_summary(reg, 'serve_decode_seconds')
+    st = engine.stats()
+    wall = max(t1 - t0, 1e-9)
+    offered = int(n_requests)
+    shed = shed_submit + shed_deadline
+    return {
+        'offered': offered,
+        'offered_rate': float(rate),
+        'admitted': len(admitted),
+        'served': served,
+        'shed_submit': shed_submit,
+        'shed_deadline': shed_deadline,
+        'errored': errored,
+        'shed_fraction': shed / float(offered) if offered else 0.0,
+        'served_req_per_s': served / wall,
+        'tokens_served': tokens_served,
+        'tokens_generated': (st['tokens_generated']
+                             - st0['tokens_generated']),
+        'tokens_per_s': tokens_served / wall,
+        'wall_s': wall,
+        'ttft_p50_ms': (ttft.get('p50') or 0.0) * 1e3
+        if ttft else None,
+        'ttft_p99_ms': (ttft.get('p99') or 0.0) * 1e3
+        if ttft else None,
+        'intertoken_p50_ms': (itl.get('p50') or 0.0) * 1e3
+        if itl else None,
+        'intertoken_p99_ms': (itl.get('p99') or 0.0) * 1e3
+        if itl else None,
+        'decode_step_p50_ms': (dstep.get('p50') or 0.0) * 1e3
+        if dstep else None,
+        'prefills': st['prefills'] - st0['prefills'],
+        'decode_steps': st['decode_steps'] - st0['decode_steps'],
+        'cancelled': st['cancelled'] - st0['cancelled'],
+        'compile_count': st['compile_count'],
+        'prefill_trace_count': st['prefill_trace_count'],
+        'decode_trace_count': st['decode_trace_count'],
+        'aot': st['aot'],
+        'int8_kv': st['int8_kv'],
+        'quantized': st['quantized'],
+        'n_slots': st['n_slots'],
+    }
 
 
 def open_loop(engine, queue, rate, n_requests, seed=0,

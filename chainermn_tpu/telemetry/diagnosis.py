@@ -711,6 +711,21 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
             + ('; latency p50 %.3f ms p99 %.3f ms'
                % (lat['p50'], lat['p99'])
                if lat.get('p50') is not None else ''))
+        gen = serve.get('generate')
+        if gen:
+            ttft = gen.get('ttft_ms') or {}
+            itl = gen.get('intertoken_ms') or {}
+            line = ('decode capture: %.0f tokens over %.0f decode '
+                    'steps' % (gen['tokens'], gen['decode_steps']))
+            if gen.get('tokens_per_s'):
+                line += ' (%.0f tok/s)' % gen['tokens_per_s']
+            if ttft.get('p50') is not None:
+                line += ('; TTFT p50 %.3f ms p99 %.3f ms'
+                         % (ttft['p50'], ttft['p99']))
+            if itl.get('p50') is not None:
+                line += ('; inter-token p50 %.3f ms p99 %.3f ms'
+                         % (itl['p50'], itl['p99']))
+            summary.append(line)
     if healthy:
         summary.append('no cross-rank skew, stragglers, anomalies or '
                        'deaths detected')

@@ -38,8 +38,13 @@ STEP_PHASES = ('host_batch_prep', 'h2d', 'jitted_step',
 #: forward-only serving capture -- which records NO training step
 #: spans, and in the bench's in-memory mode no events at all, only
 #: metrics -- is never misreported as an empty capture (exit 2)
+#: ``serve_prefill``/``serve_decode`` are the autoregressive-path
+#: phases (``serving/generate.py``): prefill spans carry the prompt
+#: bucket, decode spans the step index (``iteration``) and
+#: ``active_slots`` -- both feed the doctor's anomaly scan the way
+#: ``serve_execute`` batches do
 SERVE_PHASES = ('serve_queue_wait', 'serve_h2d', 'serve_execute',
-                'serve_warmup')
+                'serve_warmup', 'serve_prefill', 'serve_decode')
 
 #: span kinds whose time counts as "compute the collective could
 #: hide behind"
@@ -283,7 +288,7 @@ def serve_summary(metrics):
     lat, wait, pad = (summ('serve_latency_seconds'),
                       summ('serve_queue_wait'),
                       summ('serve_pad_waste'))
-    return {
+    out = {
         'requests': total('serve_requests_total'),
         'batches': total('serve_batches_total'),
         'shed': total('serve_shed_total'),
@@ -299,6 +304,38 @@ def serve_summary(metrics):
         'pad_waste_mean': pad.get('mean') if pad else None,
         'metrics': sorted(serve),
     }
+    # the autoregressive-decode view (serving/generate.py): tokens
+    # generated, TTFT and inter-token distributions, and tokens/s
+    # derived from the decode-step histogram's own wall time (sum =
+    # mean * count -- raw samples, never an averaged percentile)
+    ttft = summ('serve_ttft_seconds')
+    itl = summ('serve_intertoken_seconds')
+    dstep = summ('serve_decode_seconds')
+    tokens = total('serve_tokens_total')
+    if tokens or ttft or itl:
+        decode_wall = ((dstep.get('mean') or 0.0)
+                       * dstep.get('count', 0)) if dstep else 0.0
+        # the gauge is named per the scheduler's vocabulary (no
+        # serve_ prefix), so read it off the full snapshot
+        gauge = metrics.get('active_slots') or {}
+        out['generate'] = {
+            'tokens': tokens,
+            'ttft_ms': {
+                'count': ttft.get('count', 0),
+                'p50': (ttft.get('p50') or 0.0) * 1e3,
+                'p99': (ttft.get('p99') or 0.0) * 1e3,
+            } if ttft else None,
+            'intertoken_ms': {
+                'count': itl.get('count', 0),
+                'p50': (itl.get('p50') or 0.0) * 1e3,
+                'p99': (itl.get('p99') or 0.0) * 1e3,
+            } if itl else None,
+            'decode_steps': dstep.get('count', 0) if dstep else 0,
+            'tokens_per_s': (tokens / decode_wall
+                             if tokens and decode_wall > 0 else None),
+            'active_slots': gauge.get('value'),
+        }
+    return out
 
 
 def build_report(outdir):
@@ -404,6 +441,21 @@ def render_text(report, max_steps=24):
                if lat.get('p50') is not None else '')
             + ('; pad waste %.1f%%' % (serve['pad_waste_mean'] * 100)
                if serve.get('pad_waste_mean') is not None else ''))
+        gen = serve.get('generate')
+        if gen:
+            ttft = gen.get('ttft_ms') or {}
+            itl = gen.get('intertoken_ms') or {}
+            lines.append(
+                'generation: %.0f tokens / %.0f decode steps'
+                % (gen['tokens'], gen['decode_steps'])
+                + ('  %.0f tok/s' % gen['tokens_per_s']
+                   if gen.get('tokens_per_s') else '')
+                + ('; TTFT p50 %.3f ms p99 %.3f ms'
+                   % (ttft['p50'], ttft['p99'])
+                   if ttft.get('p50') is not None else '')
+                + ('; inter-token p50 %.3f ms p99 %.3f ms'
+                   % (itl['p50'], itl['p99'])
+                   if itl.get('p50') is not None else ''))
     if report['chaos_events']:
         lines.append('chaos events in timeline: %d (%s)'
                      % (len(report['chaos_events']),

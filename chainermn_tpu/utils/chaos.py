@@ -84,6 +84,13 @@ Sites (the action is part of the site name):
                     request -- a traffic spike the bounded queue must
                     absorb or SHED with a typed ``OverloadError``,
                     never wedge on (``chainermn_tpu/serving``)
+``serve_cancel``    expire ARG (default 1) in-flight generation
+                    requests' deadlines at a decode step -- the
+                    mid-generation cancellation path: the request is
+                    answered with a typed ``OverloadError``
+                    (reason=deadline) and its cache slot is freed for
+                    refill at the NEXT decode step, never leaked
+                    (``chainermn_tpu/serving/generate.py``)
 ==================  ====================================================
 
 Example -- drop the first publish, delay half the rest, stall the
@@ -105,7 +112,7 @@ ENV_VAR = 'CHAINERMN_TPU_CHAOS'
 SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
          'nan_batch', 'sigterm_step', 'kill_step', 'hang_step',
          'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip',
-         'serve_burst')
+         'serve_burst', 'serve_cancel')
 
 
 class InjectedFault(RuntimeError):
@@ -435,6 +442,23 @@ def on_serve_submit():
     if r is None:
         return 0
     return max(1, int(r.arg) if r.arg is not None else 4)
+
+
+def on_serve_cancel():
+    """``serve_cancel``: the number of in-flight generation requests
+    whose deadlines the generation engine should force-expire at this
+    decode step (0 = none).  The engine routes the cancellation
+    through its NORMAL deadline-expiry path -- typed
+    ``OverloadError(reason='deadline')`` to the client, slot freed for
+    refill at the next step -- so the chaos site exercises the real
+    cancellation machinery, not a special case."""
+    inj = _active
+    if inj is None:
+        return 0
+    r = inj.fires('serve_cancel')
+    if r is None:
+        return 0
+    return max(1, int(r.arg) if r.arg is not None else 1)
 
 
 def corrupt_batch(arrays):

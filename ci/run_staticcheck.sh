@@ -123,6 +123,39 @@ print('serve OK: serve_forward swept, no errors, SL010 family '
 " "$1"
 }
 
+# decode-forward gate (docs/serving.md "Autoregressive generation"):
+# the GenerationEngine's KV-cache decode step over the MeshPlan must
+# be IN the sweep and clean under every ERROR-severity rule and the
+# SL010 multi-axis family -- the decode regime's per-token psums get
+# the same machine checks as the batch request path.  Its make_args
+# is iteration-independent, so SL007 here is the static twin of the
+# continuous-batching no-recompile pin (slot refills never retrace).
+# SL008 is tolerated the way check_serve tolerates the lm-head f32
+# contraction; anything else fails the gate.
+check_decode() {
+  python -c "
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert 'step:decode_forward' in report['targets'], report['targets']
+fs = [f for f in report['findings']
+      if f['target'] == 'step:decode_forward']
+errors = [f for f in fs if f['severity'] == 'error']
+assert not errors, (
+    'decode_forward must carry no error findings: %r' % errors)
+multi = [f for f in fs if f['rule'] in ('SL010', 'SL011', 'SL012')]
+assert not multi, (
+    'decode_forward must lint clean under the SL010 family: %r'
+    % multi)
+unexpected = [f for f in fs if f['rule'] != 'SL008']
+assert not unexpected, (
+    'decode_forward grew findings beyond the tolerated SL008 '
+    'set: %r' % unexpected)
+print('decode OK: decode_forward swept, no errors, SL010 family '
+      'clean (%d SL008 warning(s))'
+      % len([f for f in fs if f['rule'] == 'SL008']))
+" "$1"
+}
+
 out_f32=$(mktemp)
 out_bf16=$(mktemp)
 trap 'rm -f "$out_f32" "$out_bf16"' EXIT
@@ -132,8 +165,10 @@ check_memtraffic "$out_f32"
 check_sl009 "$out_f32"
 check_sl010 "$out_f32"
 check_serve "$out_f32"
+check_decode "$out_f32"
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16 | tee "$out_bf16"
 check_memtraffic "$out_bf16"
 check_sl009 "$out_bf16"
 check_sl010 "$out_bf16"
 check_serve "$out_bf16"
+check_decode "$out_bf16"

@@ -309,6 +309,18 @@ run bench_transformer_tp $QT python bench.py --model transformer --quick --tp 2
 run bench_serve_mlp $QT python bench.py --serve --model mlp --quick
 run bench_serve_resnet50 $QT python bench.py --serve --quick
 run bench_serve_resnet50_int8 $QT python bench.py --serve --quick --int8
+# autoregressive arm (docs/serving.md "Autoregressive generation"):
+# tokens/s/chip + TTFT + inter-token p50/p99 through continuous
+# batching over the prefill/decode AOT split, anchored against the
+# PERF.md ~290k tok/s/chip perfect-MXU number; the --int8-kv arm
+# pairs with it as the KV-cache-bandwidth A/B (decode is HBM-bound,
+# so halving cache bytes is the knob that should move tokens/s).
+# Queued here -- after the training headline and the re-queued
+# b128/b256/best MFU rungs -- for the same reason as the serve arms
+# above: a new metric family with no banked baseline must not starve
+# the round's primary unbanked claim.
+run bench_serve_generate $QT python bench.py --serve --generate --quick
+run bench_serve_generate_int8kv $QT python bench.py --serve --generate --quick --int8-kv
 
 # --- tier 4: the remaining BASELINE workloads ------------------------
 # seq2seq FIRST: it is the variable-shape allreduce configuration

@@ -85,6 +85,131 @@ class TestFlashAttention:
             ops.flash_attention(q, k, k, causal=True)
 
 
+class TestDecodeAttention:
+    """ISSUE 11: the single-query decode variant -- oracle parity in
+    fallback AND interpret modes, per-slot dynamic lengths, int8-KV
+    dequant, dtype pins, and the one-cache-read jaxpr pin."""
+
+    def _qkv(self, b=3, s=64, h=2, d=16):
+        q = _rand((b, h, d), 0)
+        k = _rand((b, s, h, d), 1)
+        v = _rand((b, s, h, d), 2)
+        lengths = jnp.asarray([5, s, s // 2 + 1], jnp.int32)[:b]
+        return q, k, v, lengths
+
+    def test_matches_reference(self, mode):
+        q, k, v, lengths = self._qkv()
+        out = ops.flash_attention_decode(q, k, v, lengths, block_k=16)
+        ref = ops.decode_attention_reference(q, k, v, lengths)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_reference_matches_full_causal_row(self, mode):
+        """The oracle's own pin: decoding position t equals row t of
+        full causal attention."""
+        b, t, h, d = 2, 24, 2, 8
+        q = _rand((b, t, h, d), 3)
+        k = _rand((b, t, h, d), 4)
+        v = _rand((b, t, h, d), 5)
+        full = ops.mha_reference(q, k, v, causal=True)
+        pos = t - 1
+        out = ops.flash_attention_decode(
+            q[:, pos], k, v, jnp.full((b,), pos + 1, jnp.int32),
+            block_k=8)
+        np.testing.assert_allclose(out, full[:, pos], atol=2e-5,
+                                   rtol=2e-5)
+
+    def test_stale_rows_beyond_length_ignored(self, mode):
+        """Slot-reuse safety: garbage past ``lengths`` (a previous
+        occupant's K/V) must receive no probability mass."""
+        q, k, v, lengths = self._qkv()
+        k_dirty = k.at[:, 40:].set(100.0)
+        v_dirty = v.at[:, 40:].set(-100.0)
+        lengths = jnp.minimum(lengths, 40)
+        out = ops.flash_attention_decode(q, k_dirty, v_dirty, lengths,
+                                         block_k=16)
+        ref = ops.decode_attention_reference(q, k, v, lengths)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_int8_kv(self, mode):
+        from chainermn_tpu.precision import quantize_kv
+        q, k, v, lengths = self._qkv()
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        ref_f32 = ops.decode_attention_reference(q, k, v, lengths)
+        ref_i8 = ops.decode_attention_reference(
+            q, kq, vq, lengths, k_scale=ks, v_scale=vs)
+        out = ops.flash_attention_decode(
+            q, kq, vq, lengths, k_scale=ks, v_scale=vs, block_k=16)
+        # kernel matches its own int8 oracle tightly...
+        np.testing.assert_allclose(out, ref_i8, atol=2e-5, rtol=2e-5)
+        # ...and the f32 answer within the documented 5e-2
+        np.testing.assert_allclose(out, ref_f32, atol=5e-2, rtol=5e-2)
+
+    def test_scale_args_must_pair(self, mode):
+        from chainermn_tpu.precision import quantize_kv
+        q, k, v, lengths = self._qkv()
+        kq, ks = quantize_kv(k)
+        with pytest.raises(ValueError, match='BOTH'):
+            ops.flash_attention_decode(q, kq, v, lengths, k_scale=ks)
+
+    def test_dtype_pin_bf16(self, mode):
+        q, k, v, lengths = self._qkv()
+        out = ops.flash_attention_decode(
+            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16), lengths, block_k=16)
+        assert out.dtype == jnp.bfloat16
+        ref = ops.decode_attention_reference(q, k, v, lengths)
+        np.testing.assert_allclose(out.astype(jnp.float32), ref,
+                                   atol=5e-2, rtol=5e-2)
+
+    def test_unpadded_cache_length(self, mode):
+        # S not a block multiple: padded keys must get no mass
+        q = _rand((2, 2, 8), 6)
+        k = _rand((2, 40, 2, 8), 7)
+        v = _rand((2, 40, 2, 8), 8)
+        lengths = jnp.asarray([40, 17], jnp.int32)
+        out = ops.flash_attention_decode(q, k, v, lengths, block_k=16)
+        ref = ops.decode_attention_reference(q, k, v, lengths)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_jaxpr_one_cache_read_no_full_materialization(self):
+        """The acceptance pin: the decode step consumes each cache
+        operand ONCE (a single streamed HBM pass) and materializes no
+        full-sequence score/probability row in f32 -- every softmax
+        intermediate is a (block_k,)-tile."""
+        b, s, h, d = 2, 128, 2, 16
+        block_k = 32
+
+        def step(q, k, v, lengths):
+            return ops.flash_attention_decode(q, k, v, lengths,
+                                              block_k=block_k)
+
+        jaxpr = jax.make_jaxpr(step)(
+            jnp.zeros((b, h, d)), jnp.zeros((b, s, h, d)),
+            jnp.zeros((b, s, h, d)), jnp.zeros((b,), jnp.int32))
+        _, k_var, v_var, _ = jaxpr.jaxpr.invars
+        for var in (k_var, v_var):
+            readers = [e for e in jaxpr.jaxpr.eqns
+                       if var in e.invars]
+            assert len(readers) == 1, (
+                'cache operand consumed %d times' % len(readers))
+
+        def walk(jx):
+            for e in jx.eqns:
+                for ov in e.outvars:
+                    shape = getattr(ov.aval, 'shape', ())
+                    dtype = getattr(ov.aval, 'dtype', None)
+                    if (len(shape) >= 2 and shape[-1] == s
+                            and str(dtype) == 'float32'):
+                        raise AssertionError(
+                            'full-sequence f32 row materialized: '
+                            '%s %r' % (e.primitive, shape))
+                for sub in jax.core.jaxprs_in_params(e.params):
+                    walk(sub)
+
+        walk(jaxpr.jaxpr)
+
+
 class TestCrossEntropy:
     def test_matches_reference(self, mode):
         logits = _rand((20, 33), 0)

@@ -558,6 +558,342 @@ class TestDoctorServeRecognition:
 
 
 # ---------------------------------------------------------------------
+# autoregressive generation (ISSUE 11): continuous batching over the
+# prefill/decode AOT split
+
+def _tiny_lm(dtype=jnp.float32, n_layers=1, max_len=64):
+    from chainermn_tpu.models import TransformerLM
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=4,
+                          n_layers=n_layers, d_ff=32, max_len=max_len,
+                          dtype=dtype)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))['params']
+    return model, params
+
+
+class TestGenerationQueue:
+    def test_bounded_queue_sheds_typed(self):
+        q = serving.GenerationQueue(max_prompt_len=8, max_queue=2)
+        q.submit([1, 2], 4)
+        q.submit([3], 4)
+        with pytest.raises(OverloadError) as ei:
+            q.submit([4], 4)
+        assert ei.value.reason == 'queue_full'
+        assert q.shed_queue_full == 1
+
+    def test_over_length_prompt_client_error(self):
+        q = serving.GenerationQueue(max_prompt_len=4)
+        with pytest.raises(ValueError, match='exceeds'):
+            q.submit([1, 2, 3, 4, 5], 4)
+        assert q.depth() == 0
+
+    def test_close_sheds_shutdown(self):
+        q = serving.GenerationQueue(max_prompt_len=8)
+        req = q.submit([1], 4)
+        q.close()
+        with pytest.raises(OverloadError) as ei:
+            req.result(timeout=0)
+        assert ei.value.reason == 'shutdown'
+        with pytest.raises(OverloadError):
+            q.submit([1], 4)
+
+    def test_pop_sheds_expired_deadline_typed(self):
+        clock = [0.0]
+        q = serving.GenerationQueue(max_prompt_len=8,
+                                    clock=lambda: clock[0])
+        dead = q.submit([1], 4, deadline=0.5)
+        live = q.submit([2], 4)
+        clock[0] = 1.0
+        out = q.pop(2)
+        assert [r is live for r in out] == [True]
+        with pytest.raises(OverloadError) as ei:
+            dead.result(timeout=0)
+        assert ei.value.reason == 'deadline'
+        assert q.shed_deadline == 1
+
+    def test_serve_burst_amplifies_through_bounded_admission(self):
+        chaos.install(chaos.FaultInjector('serve_burst=@0:8'))
+        try:
+            q = serving.GenerationQueue(max_prompt_len=8, max_queue=4)
+            req = q.submit([1, 2], 4)
+            assert not req.done()
+            assert q.depth() == 4  # burst filled to capacity, rest shed
+        finally:
+            chaos.uninstall()
+
+
+class TestContinuousBatching:
+    def test_finished_slot_serves_new_request_next_decode_step(self):
+        """THE acceptance observable: sequence B finishes while A is
+        still generating; B's cache slot serves request C at the NEXT
+        decode step -- not at batch end -- and the decode executable
+        never retraces across the refill."""
+        model, params = _tiny_lm()
+        eng = serving.GenerationEngine(model, params, n_slots=2,
+                                       max_prompt_len=4)
+        eng.warmup()
+        traces0 = eng.stats()['decode_trace_count']
+        compiles0 = eng.stats()['compile_count']
+        q = serving.GenerationQueue(max_prompt_len=4)
+        a = q.submit([1, 2], 8)
+        b = q.submit([3], 2)
+        c = q.submit([4, 5], 3)
+        eng.step(q)           # A+B prefill (C waits), decode step 1
+        assert b.done()       # B: prefill token + 1 decoded = 2
+        assert not a.done()
+        assert len(eng._free) == 1
+        freed = eng._free[0]
+        eng.step(q)           # the refill step
+        assert not a.done()   # A is still mid-generation: token-level
+        assert eng._slots[freed].request is c   # admission, not batch
+        st = eng.stats()
+        assert st['decode_trace_count'] == traces0
+        assert st['compile_count'] == compiles0
+        # drain everything
+        for _ in range(20):
+            if a.done() and c.done():
+                break
+            eng.step(q)
+        assert len(a.result()) == 8 and len(c.result()) == 3
+
+    def test_deadline_expiry_mid_generation_frees_slot_typed(self):
+        model, params = _tiny_lm()
+        eng = serving.GenerationEngine(model, params, n_slots=1,
+                                       max_prompt_len=4)
+        eng.warmup()
+        clock = [0.0]
+        q = serving.GenerationQueue(max_prompt_len=4,
+                                    clock=lambda: clock[0])
+        doomed = q.submit([1], 100, deadline=5.0)
+        waiting = q.submit([2], 5)
+        eng.step(q, clock=lambda: clock[0])   # doomed occupies slot 0
+        assert not doomed.done()
+        clock[0] = 10.0                       # deadline passes
+        eng.step(q, clock=lambda: clock[0])   # expire -> refill
+        with pytest.raises(OverloadError) as ei:
+            doomed.result(timeout=0)
+        assert ei.value.reason == 'deadline'
+        assert eng._slots and eng._slots[0].request is waiting
+        assert eng.cancelled == 1
+
+    def test_serve_cancel_chaos_site(self):
+        chaos.install(chaos.FaultInjector('serve_cancel=@1'))
+        try:
+            model, params = _tiny_lm()
+            eng = serving.GenerationEngine(model, params, n_slots=2,
+                                           max_prompt_len=4)
+            eng.warmup()
+            q = serving.GenerationQueue(max_prompt_len=4)
+            victim = q.submit([1], 50)
+            eng.step(q)   # occurrence 0: no fire
+            eng.step(q)   # occurrence 1: forced mid-generation cancel
+            assert victim.done()
+            with pytest.raises(OverloadError) as ei:
+                victim.result(timeout=0)
+            assert ei.value.reason == 'deadline'
+            assert eng.stats()['cancelled'] == 1
+            assert len(eng._free) == 2   # slot freed, never leaked
+        finally:
+            chaos.uninstall()
+
+    def test_greedy_matches_reference_loop(self):
+        model, params = _tiny_lm(n_layers=2)
+        eng = serving.GenerationEngine(model, params, n_slots=2,
+                                       max_prompt_len=8)
+        eng.warmup()
+        prompt = np.asarray([3, 7, 11, 2], np.int32)
+        toks = list(prompt)
+        want = []
+        for _ in range(5):
+            logits = model.apply({'params': params},
+                                 jnp.asarray([toks], jnp.int32))
+            tok = int(jnp.argmax(logits[0, -1]))
+            want.append(tok)
+            toks.append(tok)
+        q = serving.GenerationQueue(max_prompt_len=8)
+        req = q.submit(prompt, 5)
+        for _ in range(10):
+            if req.done():
+                break
+            eng.step(q)
+        assert [int(t) for t in req.result()] == want
+
+    def test_eos_stops_early(self):
+        model, params = _tiny_lm(n_layers=2)
+        # find what the model emits first, then declare it EOS
+        probe = serving.GenerationEngine(model, params, n_slots=1,
+                                         max_prompt_len=4)
+        probe.warmup()
+        q = serving.GenerationQueue(max_prompt_len=4)
+        req = q.submit([5], 1)
+        while not req.done():
+            probe.step(q)
+        eos = int(req.result()[0])
+        eng = serving.GenerationEngine(model, params, n_slots=1,
+                                       max_prompt_len=4, eos_id=eos)
+        eng.warmup()
+        q2 = serving.GenerationQueue(max_prompt_len=4)
+        req2 = q2.submit([5], 50)
+        while not req2.done():
+            eng.step(q2)
+        out = [int(t) for t in req2.result()]
+        assert out[-1] == eos
+        assert len(out) < 50
+
+    def test_signature_guard_refuses_off_bucket(self):
+        model, params = _tiny_lm()
+        eng = serving.GenerationEngine(model, params, n_slots=2,
+                                       max_prompt_len=4)
+        eng.warmup()
+        bogus = (jax.ShapeDtypeStruct((3,), jnp.int32),)
+        with pytest.raises(RuntimeError, match='no-recompile guard'):
+            eng.guard_signature(bogus)
+
+    def test_int8_weights_under_tp_specs_typed_refusal(self):
+        from jax.sharding import PartitionSpec as P
+        from chainermn_tpu.parallel.meshplan import MeshPlan
+        plan = MeshPlan.create(tp=2)
+        model, params = _tiny_lm()
+        model = model.clone(tp_axis=plan.model_axis)
+        with pytest.raises(NotImplementedError):
+            serving.GenerationEngine(
+                model, params, n_slots=2, max_prompt_len=4,
+                policy=precision.Int8Policy(), plan=plan,
+                param_specs=jax.tree_util.tree_map(lambda _: P(),
+                                                   params))
+
+
+class TestOpenLoopGenerate:
+    def test_report_fields_and_accounting(self):
+        model, params = _tiny_lm()
+        eng = serving.GenerationEngine(model, params, n_slots=2,
+                                       max_prompt_len=4)
+        eng.warmup()
+        traces0 = eng.stats()['decode_trace_count']
+        q = serving.GenerationQueue(max_prompt_len=4, max_queue=8)
+        rep = serving.open_loop_generate(
+            eng, q, rate=300.0, n_requests=10, seed=3,
+            prompt_len_range=(1, 4), max_new_tokens=4)
+        assert rep['served'] + rep['shed_submit'] \
+            + rep['shed_deadline'] + rep['errored'] == 10
+        assert rep['served'] > 0
+        assert rep['tokens_served'] == 4 * rep['served']
+        assert rep['tokens_per_s'] > 0
+        assert rep['ttft_p50_ms'] is not None
+        assert rep['ttft_p99_ms'] >= rep['ttft_p50_ms']
+        assert rep['intertoken_p50_ms'] is not None
+        assert rep['decode_trace_count'] == traces0  # no retrace
+        assert rep['n_slots'] == 2
+
+    def test_int8_kv_arm_serves(self):
+        model, params = _tiny_lm()
+        eng = serving.GenerationEngine(model, params, n_slots=2,
+                                       max_prompt_len=4,
+                                       int8_kv=True)
+        eng.warmup()
+        q = serving.GenerationQueue(max_prompt_len=4)
+        rep = serving.open_loop_generate(
+            eng, q, rate=300.0, n_requests=6, seed=4,
+            prompt_len_range=(1, 4), max_new_tokens=3)
+        assert rep['served'] == 6
+        assert rep['int8_kv'] is True
+
+
+class TestGenerateTelemetry:
+    def _generate_capture(self, tmp_path):
+        model, params = _tiny_lm()
+        eng = serving.GenerationEngine(model, params, n_slots=2,
+                                       max_prompt_len=4)
+        eng.warmup()
+        q = serving.GenerationQueue(max_prompt_len=4)
+        cap = str(tmp_path / 'cap')
+        serving.open_loop_generate(
+            eng, q, rate=400.0, n_requests=6, seed=5,
+            prompt_len_range=(1, 4), max_new_tokens=3,
+            capture_dir=cap)
+        return cap
+
+    def test_serve_summary_generate_block(self, tmp_path):
+        from chainermn_tpu.telemetry import diagnosis
+        cap = self._generate_capture(tmp_path)
+        diag = diagnosis.quick_verdict(cap)
+        assert diag is not None
+        gen = diag['serve']['generate']
+        assert gen['tokens'] == 18           # 6 requests x 3 tokens
+        assert gen['ttft_ms']['p50'] is not None
+        assert gen['intertoken_ms']['p50'] is not None
+        assert gen['tokens_per_s'] is not None
+        assert gen['decode_steps'] > 0
+        assert gen['active_slots'] is not None  # the per-step gauge
+        assert any('decode capture' in s
+                   for s in diag['verdict']['summary'])
+
+    def test_metrics_only_decode_window_not_empty(self, tmp_path):
+        """The regression pin: a decode capture holding ONLY metrics
+        still parses as a serving capture with a generate block."""
+        from chainermn_tpu.telemetry import diagnosis
+        cap = self._generate_capture(tmp_path)
+        only = tmp_path / 'metrics_only'
+        only.mkdir()
+        data = json.load(open(os.path.join(cap, 'metrics-rank0.json')))
+        with open(only / 'metrics-rank0.json', 'w') as f:
+            json.dump(data, f)
+        diag = diagnosis.quick_verdict(str(only))
+        assert diag is not None
+        assert diag['serve']['generate']['tokens'] == 18
+
+    def test_serve_decode_spans_feed_anomaly_scan(self):
+        from chainermn_tpu.telemetry import diagnosis
+        spans = [
+            {'type': 'span', 'name': 'serve_decode', 'kind': 'serve',
+             't0': i * 0.01, 't1': i * 0.01 + (0.5 if i == 7
+                                               else 0.002),
+             'iteration': i, 'rank': 0}
+            for i in range(12)]
+        rows = diagnosis.step_anomalies(spans)
+        assert rows and rows[0]['phase'] == 'serve_decode'
+        assert rows[0]['iteration'] == 7
+
+    def test_serve_phases_vocabulary_extended(self):
+        from chainermn_tpu.telemetry.report import SERVE_PHASES
+        assert 'serve_prefill' in SERVE_PHASES
+        assert 'serve_decode' in SERVE_PHASES
+
+
+# ---------------------------------------------------------------------
+# shardlint decode_forward target (ISSUE 11 satellite)
+
+class TestDecodeForwardLintTarget:
+    @pytest.mark.slow
+    def test_decode_forward_swept_and_clean(self):
+        from chainermn_tpu.analysis import runner, targets
+        t = targets.decode_forward_target()
+        assert t.name == 'step:decode_forward'
+        assert t.plan_axes == ('model',)
+        # iteration-independent signature: the SL007 static twin of
+        # the flat-trace-count pin
+        assert targets.LintTarget  # imported symbol sanity
+        import chainermn_tpu.analysis.walker as walker
+        s1 = walker.abstract_signature(t.make_args(1))
+        s2 = walker.abstract_signature(t.make_args(7))
+        assert s1 == s2
+        findings = runner.lint_target(t)
+        errors = [f for f in findings if f.severity == 'error']
+        assert not errors, errors
+        multi = [f for f in findings
+                 if f.rule_id in ('SL010', 'SL011', 'SL012')]
+        assert not multi, multi
+        assert {f.rule_id for f in findings} <= {'SL008'}
+
+    @pytest.mark.slow
+    def test_decode_forward_in_default_step_sweep(self):
+        from chainermn_tpu.analysis import targets
+        names = [t.name for t in targets.step_targets(
+            include_resnet50=False)]
+        assert 'step:decode_forward' in names
+
+
+# ---------------------------------------------------------------------
 # shardlint serve_forward target (ISSUE 10 satellite)
 
 class TestServeForwardLintTarget:

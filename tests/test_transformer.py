@@ -299,6 +299,204 @@ class TestTensorParallel:
         assert tp_oracle(tp_model).d_model == tp_model.d_model
 
 
+class TestIncrementalDecode:
+    """ISSUE 11 parity pin: the slot-addressed KV-cache decode path
+    (prefill + decode_step) reproduces the full-sequence causal
+    forward's logits -- f32 rtol 1e-5, bf16 / int8-KV 5e-2 --
+    including across a slot-REFILL boundary (a second prompt through
+    a used slot must not see the previous occupant's rows)."""
+
+    def _model(self, dtype, max_len=64):
+        return TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=64, max_len=max_len,
+                             dtype=dtype)
+
+    def _stepwise_logits(self, model, params, cache, toks, t_pre,
+                         slot):
+        """Prefill ``toks[:t_pre]`` into ``slot`` then teacher-force
+        the remainder through decode_step; returns (logits at each
+        position >= t_pre - 1, cache)."""
+        from chainermn_tpu.models import decode_step, prefill
+        pad = np.zeros((1, t_pre), np.int32)
+        pad[0] = toks[:t_pre]
+        out = {}
+        lg, cache = prefill(model, params, cache, jnp.asarray(pad),
+                            jnp.asarray(t_pre), jnp.asarray(slot))
+        out[t_pre - 1] = np.asarray(lg)
+        for p in range(t_pre, len(toks)):
+            lg, cache = decode_step(
+                model, params, cache,
+                jnp.asarray([toks[p]], jnp.int32),
+                jnp.asarray([p], jnp.int32),
+                slots=jnp.asarray([slot], jnp.int32))
+            out[p] = np.asarray(lg[0])
+        return out, cache
+
+    @pytest.mark.parametrize('dtype,rtol', [('float32', 1e-5),
+                                            ('bfloat16', 5e-2)])
+    def test_matches_full_forward(self, dtype, rtol):
+        from chainermn_tpu.models import init_kv_cache
+        model = self._model(jnp.dtype(dtype))
+        rng = np.random.RandomState(0)
+        toks = rng.randint(0, 64, size=12).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.asarray([toks]))['params']
+        full = np.asarray(model.apply({'params': params},
+                                      jnp.asarray([toks])))[0]
+        cache = init_kv_cache(model, n_slots=2)
+        got, _ = self._stepwise_logits(model, params, cache, toks,
+                                       t_pre=4, slot=1)
+        for p, lg in got.items():
+            np.testing.assert_allclose(lg, full[p], rtol=rtol,
+                                       atol=rtol)
+
+    def test_int8_kv_cache_parity(self):
+        from chainermn_tpu.models import init_kv_cache
+        model = self._model(jnp.float32)
+        rng = np.random.RandomState(2)
+        toks = rng.randint(0, 64, size=10).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.asarray([toks]))['params']
+        full = np.asarray(model.apply({'params': params},
+                                      jnp.asarray([toks])))[0]
+        cache = init_kv_cache(model, n_slots=1, int8_kv=True)
+        assert cache['k'].dtype == jnp.int8
+        got, _ = self._stepwise_logits(model, params, cache, toks,
+                                       t_pre=3, slot=0)
+        for p, lg in got.items():
+            np.testing.assert_allclose(lg, full[p], rtol=5e-2,
+                                       atol=5e-2)
+
+    def test_parity_across_slot_refill_boundary(self):
+        """The continuous-batching numerics pin: after sequence A
+        used slot 0, prefilling sequence B into the SAME slot (no
+        zeroing) must reproduce B's fresh-cache logits exactly --
+        stale rows beyond B's length are masked, not read."""
+        from chainermn_tpu.models import init_kv_cache
+        model = self._model(jnp.float32)
+        rng = np.random.RandomState(3)
+        tok_a = rng.randint(0, 64, size=12).astype(np.int32)
+        tok_b = rng.randint(0, 64, size=7).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.asarray([tok_a]))['params']
+        cache = init_kv_cache(model, n_slots=1, max_len=32)
+        _, cache = self._stepwise_logits(model, params, cache, tok_a,
+                                         t_pre=5, slot=0)
+        # refill: B through the USED slot vs B through a fresh cache
+        got_b, _ = self._stepwise_logits(model, params, cache, tok_b,
+                                         t_pre=3, slot=0)
+        fresh = init_kv_cache(model, n_slots=1, max_len=32)
+        want_b, _ = self._stepwise_logits(model, params, fresh, tok_b,
+                                          t_pre=3, slot=0)
+        for p in got_b:
+            np.testing.assert_allclose(got_b[p], want_b[p],
+                                       rtol=1e-6, atol=1e-6)
+        full = np.asarray(model.apply({'params': params},
+                                      jnp.asarray([tok_b])))[0]
+        for p in got_b:
+            np.testing.assert_allclose(got_b[p], full[p], rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_full_bucket_decode_reads_cache_in_place(self):
+        """The one-cache-read jaxpr pin at the model layer: a
+        full-slot decode step (slots=None) consumes each cache leaf
+        exactly once per layer -- no gather copy."""
+        from chainermn_tpu.models import decode_step, init_kv_cache
+        model = self._model(jnp.float32)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, 8), jnp.int32))['params']
+        cache = init_kv_cache(model, n_slots=4)
+
+        def step(cache, tokens, positions):
+            return decode_step(model, params, cache, tokens,
+                               positions)
+
+        jaxpr = jax.make_jaxpr(step)(
+            cache, jnp.zeros((4,), jnp.int32),
+            jnp.zeros((4,), jnp.int32))
+        # cache leaves are the first invars (dict order k, v)
+        n_leaves = len(jax.tree_util.tree_leaves(cache))
+        for var in jaxpr.jaxpr.invars[:n_leaves]:
+            readers = [e for e in jaxpr.jaxpr.eqns
+                       if var in e.invars]
+            # one scatter (the token write) consumes the original
+            # leaf; every read flows from its output -- no second
+            # consumer means no gather copy of the cache
+            assert len(readers) == 1, (
+                'cache leaf consumed %d times' % len(readers))
+
+    def test_compacted_vs_full_bucket_same_logits(self):
+        from chainermn_tpu.models import (decode_step, init_kv_cache,
+                                          prefill)
+        model = self._model(jnp.float32)
+        rng = np.random.RandomState(4)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, 8), jnp.int32))['params']
+        cache = init_kv_cache(model, n_slots=4)
+        toks = rng.randint(0, 64, size=(4, 6)).astype(np.int32)
+        for s in range(2):
+            _, cache = prefill(model, params, cache,
+                               jnp.asarray(toks[s:s + 1]),
+                               jnp.asarray(6), jnp.asarray(s))
+        nxt = jnp.asarray([1, 2], jnp.int32)
+        pos = jnp.asarray([6, 6], jnp.int32)
+        lg_c, _ = decode_step(model, params, cache, nxt, pos,
+                              slots=jnp.asarray([0, 1], jnp.int32))
+        # full bucket: same tokens at rows 0/1, padding rows 2/3
+        lg_f, _ = decode_step(
+            model, params, cache,
+            jnp.asarray([1, 2, 0, 0], jnp.int32),
+            jnp.asarray([6, 6, 0, 0], jnp.int32))
+        np.testing.assert_allclose(np.asarray(lg_c),
+                                   np.asarray(lg_f)[:2], rtol=1e-6,
+                                   atol=1e-6)
+
+    @pytest.mark.slow
+    def test_tp_decode_matches_oracle(self):
+        """Decode under shard_map tp=2: same psum structure as the
+        tp forward, logits match the unsharded full forward."""
+        from chainermn_tpu.models import (decode_step, init_kv_cache,
+                                          kv_cache_specs, prefill,
+                                          tp_param_specs)
+        from chainermn_tpu.parallel.meshplan import MeshPlan
+        if jax.device_count() < 2:
+            pytest.skip('needs 2 devices')
+        plan = MeshPlan.create(tp=2)
+        model = self._model(jnp.float32).clone(
+            tp_axis=plan.model_axis)
+        oracle = self._model(jnp.float32)
+        rng = np.random.RandomState(5)
+        toks = rng.randint(0, 64, size=(2, 9)).astype(np.int32)
+        params = oracle.init(jax.random.PRNGKey(1),
+                             jnp.asarray(toks))['params']
+        full = np.asarray(oracle.apply({'params': params},
+                                       jnp.asarray(toks)))
+        specs = tp_param_specs(params, plan.model_axis)
+        cache = init_kv_cache(model, n_slots=2)
+        cspecs = kv_cache_specs(cache, plan.model_axis)
+        pp = jax.device_put(params, plan.param_shardings(specs))
+        cd = jax.device_put(cache, plan.param_shardings(cspecs))
+        pre = jax.shard_map(
+            lambda p, c, t, n, s: prefill(model, p, c, t, n, s),
+            mesh=plan.mesh,
+            in_specs=(specs, cspecs, P(), P(), P()),
+            out_specs=(P(), cspecs), check_vma=False)
+        dec = jax.shard_map(
+            lambda p, c, t, pos: decode_step(model, p, c, t, pos),
+            mesh=plan.mesh, in_specs=(specs, cspecs, P(), P()),
+            out_specs=(P(), cspecs), check_vma=False)
+        for s in range(2):
+            lg, cd = pre(pp, cd, jnp.asarray(toks[s:s + 1, :6]),
+                         jnp.asarray(6), jnp.asarray(s))
+            np.testing.assert_allclose(np.asarray(lg), full[s, 5],
+                                       rtol=1e-5, atol=1e-5)
+        for p in range(6, 9):
+            lg, cd = dec(pp, cd, jnp.asarray(toks[:, p]),
+                         jnp.full((2,), p, jnp.int32))
+            np.testing.assert_allclose(np.asarray(lg), full[:, p],
+                                       rtol=1e-5, atol=1e-5)
+
+
 def test_ulysses_matches_single_device():
     """sp_scheme='ulysses' (all_to_all head resharding) must also
     reproduce the unsharded model: 2 heads over 2 devices."""
