@@ -642,11 +642,18 @@ class GenerationEngine:
         active = sorted(self._slots)
         k = len(active)
         bucket = bucket_of(k, self.decode_edges)
-        # pad with FREE slots (guaranteed available: bucket <= n_slots
-        # and only k are active) -- their writes land at position 0 of
-        # an unoccupied slot and are overwritten by the next prefill
-        pad = [s for s in self._free if s not in active]
-        rows = active + pad[:bucket - k]
+        if bucket == self.n_slots:
+            # the full-slot executable reads the cache IN PLACE (no
+            # slots operand): row i IS slot i, so rows must be every
+            # slot in id order even when k < n_slots -- an inactive
+            # row writes a garbage token at position 0 of its FREE
+            # slot, overwritten by that slot's next prefill
+            rows = list(range(self.n_slots))
+        else:
+            # compacted bucket: pad with FREE slots (guaranteed
+            # available: bucket < n_slots and only k are active) --
+            # same garbage-write-to-a-free-slot contract as above
+            rows = active + self._free[:bucket - k]
         tokens = np.asarray(
             [self._slots[s].generated[-1] if s in self._slots else 0
              for s in rows], np.int32)
@@ -685,8 +692,10 @@ class GenerationEngine:
                              help='per-sequence gap between '
                                   'consecutive tokens (s)')
                if reg is not None else None)
-        for i, sid in enumerate(active):
-            slot = self._slots[sid]
+        for i, sid in enumerate(rows):
+            slot = self._slots.get(sid)
+            if slot is None:
+                continue   # free pad row (or inactive full-bucket row)
             tok = int(toks[i])
             slot.generated.append(tok)
             slot.position += 1

@@ -718,6 +718,45 @@ class TestContinuousBatching:
             eng.step(q)
         assert [int(t) for t in req.result()] == want
 
+    def test_full_bucket_decode_with_free_mid_slot_keeps_parity(self):
+        """Regression: 3 of 4 active slots bucket UP to the full-slot
+        executable (decode edges [1, 2, 4]), whose cache read is in
+        place -- row i IS slot i.  A middle slot freed mid-flight must
+        not shift the survivors onto each other's KV rows: every
+        remaining request still matches the full-forward greedy
+        reference across the non-identity full-bucket steps."""
+        model, params = _tiny_lm(n_layers=2)
+
+        def reference(prompt, n_new):
+            toks = [int(t) for t in prompt]
+            out = []
+            for _ in range(n_new):
+                logits = model.apply({'params': params},
+                                     jnp.asarray([toks], jnp.int32))
+                tok = int(jnp.argmax(logits[0, -1]))
+                out.append(tok)
+                toks.append(tok)
+            return out
+
+        eng = serving.GenerationEngine(model, params, n_slots=4,
+                                       max_prompt_len=8)
+        eng.warmup()
+        traces0 = eng.stats()['decode_trace_count']
+        q = serving.GenerationQueue(max_prompt_len=8)
+        prompts = ([3, 7, 11], [2, 9], [13, 1, 4, 6], [8, 8, 5])
+        n_new = (6, 2, 6, 6)   # slot 1 finishes after one decode step
+        reqs = [q.submit(p, n) for p, n in zip(prompts, n_new)]
+        eng.step(q)            # four prefills + identity decode step
+        assert reqs[1].done()
+        assert eng._free == [1]   # a MIDDLE slot freed, 0/2/3 live
+        for _ in range(10):
+            if all(r.done() for r in reqs):
+                break
+            eng.step(q)        # k=3 -> bucket=4: the in-place path
+        for req, p, n in zip(reqs, prompts, n_new):
+            assert [int(t) for t in req.result()] == reference(p, n)
+        assert eng.stats()['decode_trace_count'] == traces0
+
     def test_eos_stops_early(self):
         model, params = _tiny_lm(n_layers=2)
         # find what the model emits first, then declare it EOS
