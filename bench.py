@@ -1895,11 +1895,24 @@ SERVE_SIDECAR_KEYS = (
     'bucket_hit_rate', 'shed_fraction', 'capacity_req_per_s')
 
 #: generate-row sidecars (--serve --generate): the decode regime's
-#: own vocabulary -- tokens/s, TTFT and inter-token latency
+#: own vocabulary -- tokens/s, TTFT and inter-token latency, plus
+#: the live SLO monitor's ok/warn/breach verdict (ISSUE 12)
 GENERATE_SIDECAR_KEYS = (
     'tokens_per_s', 'ttft_p50_ms', 'ttft_p99_ms',
     'intertoken_p50_ms', 'intertoken_p99_ms', 'shed_fraction',
-    'capacity_tok_per_s')
+    'capacity_tok_per_s', 'slo_verdict')
+
+
+def _serve_capture_dir(argv):
+    """``--capture DIR``: record the serve window as a full telemetry
+    capture (per-request trace spans + serve metrics flushed into
+    DIR) so ``telemetry report``/``slo``/``doctor`` can replay it --
+    the CI slo smoke leg drives exactly this path."""
+    capture = _flag_value(argv, '--capture', None, str)
+    if capture:
+        from chainermn_tpu import telemetry
+        telemetry.enable(capture)
+    return capture
 
 
 def _flag_value(argv, flag, default, cast=float):
@@ -2017,11 +2030,13 @@ def measure_serve(argv):
     _log('serve: capacity ~%.0f req/s; offering %.0f req/s x %d '
          'requests' % (capacity, rate, n_requests))
 
+    capture = _serve_capture_dir(argv)
     queue = serving.RequestQueue(
         max_batch=max_batch, max_wait=0.005,
         max_queue=max(4 * max_batch, 64), edges=engine.edges)
     rep = serving.open_loop(engine, queue, rate=rate,
-                            n_requests=n_requests, seed=0)
+                            n_requests=n_requests, seed=0,
+                            capture_dir=capture)
 
     row = dict(
         stub,
@@ -2048,6 +2063,7 @@ def measure_serve(argv):
         shed_fraction=round(rep['shed_fraction'], 4),
         served=rep['served'],
         offered=rep['offered'],
+        worst_request=rep.get('worst_request'),
         buckets=list(engine.edges),
         max_batch=max_batch,
         aot=all(aot_map.values()),
@@ -2166,11 +2182,19 @@ def measure_generate(argv):
          '%.1f req/s x %d requests'
          % (capacity_tok, capacity_req, rate, n_requests))
 
+    # the live SLO monitor rides the measured window (ISSUE 12): its
+    # multi-window burn-rate verdict lands in the row (and, with
+    # --capture, a slo_snapshot.json next to the flushed capture
+    # that `telemetry slo DIR` then reproduces offline)
+    capture = _serve_capture_dir(argv)
+    from chainermn_tpu.telemetry import slo as slo_mod
+    monitor = slo_mod.SLOMonitor(n_slots=n_slots, outdir=capture)
     queue = serving.GenerationQueue(max_prompt_len=max_prompt,
                                     max_queue=max(2 * n_slots, 16))
     rep = serving.open_loop_generate(
         engine, queue, rate=rate, n_requests=n_requests, seed=0,
-        prompt_len_range=(4, max_prompt), max_new_tokens=max_new)
+        prompt_len_range=(4, max_prompt), max_new_tokens=max_new,
+        capture_dir=capture, slo_monitor=monitor)
 
     mxu_anchor = 290000.0
     value = rep['tokens_per_s'] / n_dev
@@ -2205,6 +2229,12 @@ def measure_generate(argv):
         intertoken_p50_ms=rep['intertoken_p50_ms'],
         intertoken_p99_ms=rep['intertoken_p99_ms'],
         decode_step_p50_ms=rep['decode_step_p50_ms'],
+        slo_verdict=(rep['slo'] or {}).get(
+            'verdict', {}).get('overall'),
+        slo_verdicts={name: row_['verdict'] for name, row_ in
+                      sorted(((rep['slo'] or {}).get('slos')
+                              or {}).items())},
+        worst_request=rep.get('worst_request'),
         n_slots=n_slots,
         max_new_tokens=max_new,
         prefill_buckets=list(engine.prefill_edges),

@@ -32,7 +32,7 @@ Three cooperating layers (``docs/serving.md``):
 
 from chainermn_tpu.serving.batcher import (  # noqa: F401
     PackedBatch, Request, RequestQueue, bucket_edges, bucket_of,
-    pack_sizes)
+    next_request_id, pack_sizes, record_shed)
 from chainermn_tpu.serving.engine import (  # noqa: F401
     InferenceEngine, load_params)
 from chainermn_tpu.serving.generate import (  # noqa: F401

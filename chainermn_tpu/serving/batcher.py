@@ -38,6 +38,7 @@ Host-side collation reuses the precision layer's
 compute dtype BEFORE the device copy).
 """
 
+import itertools
 import threading
 import time
 
@@ -52,6 +53,43 @@ from chainermn_tpu.utils.failure import OverloadError
 DEFAULT_MAX_BATCH = 32
 DEFAULT_MAX_WAIT = 0.005
 DEFAULT_MAX_QUEUE = 256
+
+#: process-wide request-id source shared by every serving queue
+#: (batch and generation): the numeric part is the MONOTONIC
+#: admission stamp, so ids order by admission across queues
+_request_counter = itertools.count(1)
+
+
+def next_request_id():
+    """Process-unique request id (``r<N>``); the counter is shared by
+    the batch and generation queues, so the numeric suffix is a
+    monotonic admission stamp across the whole serving process --
+    what lets a merged capture order requests without a clock."""
+    return 'r%d' % next(_request_counter)
+
+
+def record_shed(reason, request_id=None, queue_depth=None,
+                count_total=True, **attrs):
+    """Shed forensics, one call per turned-away request: bump the
+    aggregate ``serve_shed_total`` (``count_total=False`` for
+    shutdown drains, which the aggregate never counted) plus the
+    per-reason ``serve_shed_<reason>_total`` counter, and emit a
+    lightweight ``kind='request'`` ``shed`` event carrying the
+    request id, the reason, and the queue depth at shed time -- so
+    ``report.serve_summary`` shows a shed-reason breakdown and a
+    single shed request's trace ends in a named verdict.  Zero-cost
+    when telemetry is off; deliberately NO flight dump (sheds fire at
+    request rate)."""
+    reg = _telemetry.registry()
+    if reg is not None:
+        if count_total:
+            reg.counter('serve_shed_total',
+                        help='requests shed by the admission layer '
+                             '(queue_full + deadline)').inc()
+        reg.counter('serve_shed_%s_total' % reason,
+                    help='requests shed with reason=%s' % reason).inc()
+    _telemetry.request_event(request_id, 'shed', reason=reason,
+                             queue_depth=queue_depth, **attrs)
 
 
 def bucket_edges(max_batch, base=2):
@@ -115,19 +153,27 @@ class Request:
     """One in-flight request: payload ``x`` (leading dim = item
     count), optional absolute ``deadline`` (``clock()`` units), and a
     one-shot completion cell the engine fills with the result slice
-    or a typed error."""
+    or a typed error.  ``request_id`` is the process-unique trace id
+    (:func:`next_request_id`); ``t_trace0`` is the admission instant
+    on the telemetry recorder's clock (None when telemetry was off at
+    admission) -- the t0 of the request's ``queue_wait`` stage span.
+    """
 
     __slots__ = ('x', 'n', 'deadline', 'seq', 't_submit', 'synthetic',
-                 '_done', '_result', '_error')
+                 'request_id', 't_trace0', '_done', '_result',
+                 '_error')
 
     def __init__(self, x, deadline=None, seq=0, t_submit=0.0,
-                 synthetic=False):
+                 synthetic=False, request_id=None):
         self.x = x
         self.n = int(x.shape[0])
         self.deadline = deadline
         self.seq = seq
         self.t_submit = t_submit
         self.synthetic = synthetic
+        self.request_id = request_id or next_request_id()
+        rec = _telemetry.active()
+        self.t_trace0 = rec.now() if rec is not None else None
         self._done = threading.Event()
         self._result = None
         self._error = None
@@ -252,11 +298,10 @@ class RequestQueue:
                                 queue_depth=len(self._waiting))
         if len(self._waiting) >= self.max_queue:
             self.shed_queue_full += 1
-            reg = _telemetry.registry()
-            if reg is not None:
-                reg.counter('serve_shed_total',
-                            help='requests shed by the admission '
-                                 'layer (queue_full + deadline)').inc()
+            # the request never existed as an object; a fresh id still
+            # names this rejection in the shed forensics
+            record_shed('queue_full', request_id=next_request_id(),
+                        queue_depth=len(self._waiting))
             raise OverloadError(
                 'serving queue full (%d waiting requests); retry '
                 'with backoff' % len(self._waiting),
@@ -310,9 +355,10 @@ class RequestQueue:
         for req in snapshot:
             if req.deadline is not None and now > req.deadline:
                 self.shed_deadline += 1
-                reg = _telemetry.registry()
-                if reg is not None:
-                    reg.counter('serve_shed_total').inc()
+                record_shed('deadline', request_id=req.request_id,
+                            queue_depth=len(snapshot),
+                            waited_ms=round(
+                                (now - req.t_submit) * 1e3, 3))
                 req.set_error(OverloadError(
                     'deadline expired after %.1f ms in queue'
                     % ((now - req.t_submit) * 1e3), reason='deadline'))
@@ -327,12 +373,15 @@ class RequestQueue:
 
     def close(self):
         """Refuse new work and shed everything still waiting
-        (``reason='shutdown'``)."""
+        (``reason='shutdown'``; counted per-reason but NOT in
+        ``serve_shed_total``, which stays the overload aggregate)."""
         with self._cond:
             self._closed = True
             pending, self._waiting = self._waiting, []
             self._cond.notify_all()
         for req in pending:
+            record_shed('shutdown', request_id=req.request_id,
+                        queue_depth=len(pending), count_total=False)
             req.set_error(OverloadError('serving queue shut down',
                                         reason='shutdown'))
 

@@ -314,8 +314,12 @@ class InferenceEngine:
         PackedBatch`: collate+pad host-side (policy compute dtype),
         run the bucket executable, split the output rows back to the
         member requests, and record the serve telemetry (phase
-        histograms + per-request latency)."""
+        histograms + per-request latency + per-request trace stages
+        ``queue_wait`` -> ``bucket_pack`` -> ``execute`` ->
+        ``complete``, tiled so the stage budgets sum to the
+        end-to-end latency)."""
         clock = clock or time.monotonic
+        rec = _telemetry.active()
         reg = _telemetry.registry()
         t_exec0 = clock()
         queue_wait = t_exec0 - min(r.t_submit for r in pb.requests)
@@ -324,11 +328,30 @@ class InferenceEngine:
         _telemetry.event('serve_queue_wait', kind='serve',
                          seconds=queue_wait, bucket=pb.bucket,
                          iteration=self._batch_index)
+        t_pack0 = rec.now() if rec is not None else None
+        if rec is not None:
+            pad = pb.pad_waste()
+            for req in pb.requests:
+                # stage 1: the wait that already elapsed, from the
+                # admission stamp (or reconstructed when telemetry
+                # came up mid-flight) to this drain
+                t0 = req.t_trace0
+                if t0 is None:
+                    t0 = t_pack0 - (clock() - req.t_submit)
+                rec.child_span(req.request_id, 'queue_wait', t0,
+                               t_pack0, seq=req.seq)
         try:
             x, _mask = pb.collate(
                 dtype=self.policy.compute_dtype
                 if self.policy is not None else None)
             t_h2d0 = clock()
+            t_exe0 = rec.now() if rec is not None else None
+            if rec is not None:
+                for req in pb.requests:
+                    rec.child_span(req.request_id, 'bucket_pack',
+                                   t_pack0, t_exe0, bucket=pb.bucket,
+                                   pad_fraction=round(pad, 4),
+                                   items=req.n)
             y = self.infer(x)
             t_done = clock()
             y_host = np.asarray(
@@ -338,10 +361,22 @@ class InferenceEngine:
             for req in pb.requests:
                 req.set_result(y_host[off:off + req.n])
                 off += req.n
+            if rec is not None:
+                t_done_tele = rec.now()
+                for req in pb.requests:
+                    rec.child_span(req.request_id, 'execute', t_exe0,
+                                   t_done_tele, bucket=pb.bucket)
+                    rec.event('complete', kind='request',
+                              request_id=req.request_id,
+                              bucket=pb.bucket)
         except Exception as e:
             for req in pb.requests:
                 if not req.done():
                     req.set_error(e)
+                    if rec is not None:
+                        rec.event('error', kind='request',
+                                  request_id=req.request_id,
+                                  error=type(e).__name__)
             raise
         if reg is not None:
             reg.histogram(

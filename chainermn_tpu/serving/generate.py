@@ -61,7 +61,9 @@ import jax.numpy as jnp
 
 from chainermn_tpu import telemetry as _telemetry
 from chainermn_tpu.analysis.walker import abstract_signature
-from chainermn_tpu.serving.batcher import bucket_edges, bucket_of
+from chainermn_tpu.serving.batcher import (bucket_edges, bucket_of,
+                                           next_request_id,
+                                           record_shed)
 from chainermn_tpu.utils import chaos as _chaos
 from chainermn_tpu.utils import jax_compat
 from chainermn_tpu.utils.failure import OverloadError
@@ -75,13 +77,17 @@ class GenRequest:
     ids), ``max_new_tokens``, optional absolute ``deadline``
     (``clock()`` units, enforced at admission AND between decode
     steps), and a one-shot completion cell filled with the generated
-    token ids or a typed error."""
+    token ids or a typed error.  ``request_id`` is the process-unique
+    trace id (monotonic admission stamp in the suffix); ``t_trace0``
+    is the admission instant on the telemetry recorder's clock (None
+    when telemetry was off) -- the t0 of the ``queue_wait`` stage."""
 
     __slots__ = ('prompt', 'max_new_tokens', 'deadline', 'seq',
-                 't_submit', 'synthetic', '_done', '_result', '_error')
+                 't_submit', 'synthetic', 'request_id', 't_trace0',
+                 '_done', '_result', '_error')
 
     def __init__(self, prompt, max_new_tokens, deadline=None, seq=0,
-                 t_submit=0.0, synthetic=False):
+                 t_submit=0.0, synthetic=False, request_id=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError('empty prompt')
@@ -93,6 +99,9 @@ class GenRequest:
         self.seq = seq
         self.t_submit = t_submit
         self.synthetic = synthetic
+        self.request_id = request_id or next_request_id()
+        rec = _telemetry.active()
+        self.t_trace0 = rec.now() if rec is not None else None
         self._done = threading.Event()
         self._result = None
         self._error = None
@@ -172,11 +181,8 @@ class GenerationQueue:
                                 queue_depth=len(self._waiting))
         if len(self._waiting) >= self.max_queue:
             self.shed_queue_full += 1
-            reg = _telemetry.registry()
-            if reg is not None:
-                reg.counter('serve_shed_total',
-                            help='requests shed by the admission '
-                                 'layer (queue_full + deadline)').inc()
+            record_shed('queue_full', request_id=next_request_id(),
+                        queue_depth=len(self._waiting))
             raise OverloadError(
                 'generation queue full (%d waiting); retry with '
                 'backoff' % len(self._waiting),
@@ -200,9 +206,11 @@ class GenerationQueue:
                 req = self._waiting.pop(0)
                 if req.deadline is not None and now > req.deadline:
                     self.shed_deadline += 1
-                    reg = _telemetry.registry()
-                    if reg is not None:
-                        reg.counter('serve_shed_total').inc()
+                    record_shed('deadline',
+                                request_id=req.request_id,
+                                queue_depth=len(self._waiting),
+                                waited_ms=round(
+                                    (now - req.t_submit) * 1e3, 3))
                     req.set_error(OverloadError(
                         'deadline expired after %.1f ms in queue'
                         % ((now - req.t_submit) * 1e3),
@@ -220,6 +228,8 @@ class GenerationQueue:
             self._closed = True
             pending, self._waiting = self._waiting, []
         for req in pending:
+            record_shed('shutdown', request_id=req.request_id,
+                        queue_depth=len(pending), count_total=False)
             req.set_error(OverloadError('generation queue shut down',
                                         reason='shutdown'))
 
@@ -234,15 +244,19 @@ class _Slot:
     """Host-side state of one cache slot."""
 
     __slots__ = ('request', 'position', 'remaining', 'generated',
-                 't_last_token')
+                 't_last_token', 't_stage_end')
 
     def __init__(self, request, position, remaining, first_token,
-                 t_now):
+                 t_now, t_stage_end=None):
         self.request = request
         self.position = position          # next token's position
         self.remaining = remaining        # tokens still to generate
         self.generated = [first_token]
         self.t_last_token = t_now
+        # telemetry-clock end of this request's newest recorded trace
+        # stage: each decode stage span starts here, so the stages
+        # tile the request's lifetime gap-free (None: telemetry off)
+        self.t_stage_end = t_stage_end
 
 
 class GenerationEngine:
@@ -358,6 +372,7 @@ class GenerationEngine:
         self.tokens_generated = 0
         self.cancelled = 0
         self._step_index = 0
+        self._last_queue_depth = 0
 
     # -- sharding ------------------------------------------------------
     def _param_sharding(self):
@@ -577,7 +592,6 @@ class GenerationEngine:
                     key=lambda s: self._slots[s].request.t_submit
             )[:force]:
                 doomed.append(sid)
-        reg = _telemetry.registry()
         for sid in doomed:
             slot = self._slots.pop(sid)
             self._free.append(sid)
@@ -587,20 +601,32 @@ class GenerationEngine:
                 % len(slot.generated), reason='deadline'))
             _telemetry.event('serve_cancel', kind='serve', slot=sid,
                              tokens=len(slot.generated))
-            if reg is not None:
-                reg.counter('serve_shed_total',
-                            help='requests shed by the admission '
-                                 'layer (queue_full + deadline)').inc()
+            record_shed('deadline',
+                        request_id=slot.request.request_id,
+                        queue_depth=self._last_queue_depth,
+                        slot=sid, tokens=len(slot.generated))
         return len(doomed)
 
     def _admit(self, queue, now, clock):
         """Refill free slots from the queue: one PREFILL per request
         (bucketed by prompt length), TTFT recorded when its first
-        token lands."""
+        token lands.  With telemetry on, each admitted request gets
+        its trace stages recorded: ``queue_wait`` (admission stamp ->
+        pop), ``bucket_pack`` (pop -> prefill dispatch, carrying the
+        prompt bucket + pad fraction) and ``prefill`` (-> first
+        token), each starting where the previous ended."""
+        rec = _telemetry.active()
         reg = _telemetry.registry()
         for req in queue.pop(len(self._free)):
             sid = self._free.pop(0)
             prompt = req.prompt
+            t_pop = rec.now() if rec is not None else None
+            if rec is not None:
+                t0 = req.t_trace0
+                if t0 is None:   # telemetry enabled mid-flight
+                    t0 = t_pop - (clock() - req.t_submit)
+                rec.child_span(req.request_id, 'queue_wait', t0,
+                               t_pop, seq=req.seq)
             bucket = bucket_of(prompt.size, self.prefill_edges)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :prompt.size] = prompt
@@ -610,6 +636,12 @@ class GenerationEngine:
                     jnp.asarray(sid, jnp.int32))
             self.guard_signature((self._cache_struct(),) + tuple(
                 jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args))
+            t_pf0 = rec.now() if rec is not None else None
+            if rec is not None:
+                rec.child_span(
+                    req.request_id, 'bucket_pack', t_pop, t_pf0,
+                    bucket=bucket, pad_fraction=round(
+                        (bucket - prompt.size) / float(bucket), 4))
             with _telemetry.span('serve_prefill', kind='serve',
                                  bucket=bucket, slot=sid,
                                  iteration=self._step_index):
@@ -619,6 +651,12 @@ class GenerationEngine:
             self.prefills += 1
             self.tokens_generated += 1
             t_first = clock()
+            t_first_tele = None
+            if rec is not None:
+                t_first_tele = rec.now()
+                rec.child_span(req.request_id, 'prefill', t_pf0,
+                               t_first_tele, bucket=bucket, slot=sid,
+                               prompt_tokens=int(prompt.size))
             if reg is not None:
                 reg.histogram(
                     'serve_ttft_seconds',
@@ -630,10 +668,15 @@ class GenerationEngine:
                     or req.max_new_tokens == 1:
                 req.set_result([tok])
                 self._free.append(sid)
+                if rec is not None:
+                    rec.event('complete', kind='request',
+                              request_id=req.request_id, tokens=1,
+                              slot=sid)
                 continue
             self._slots[sid] = _Slot(req, prompt.size,
                                      req.max_new_tokens - 1, tok,
-                                     t_first)
+                                     t_first,
+                                     t_stage_end=t_first_tele)
 
     def _decode_once(self, clock):
         """One decode step over every active slot, compacted to the
@@ -669,6 +712,7 @@ class GenerationEngine:
                     jnp.asarray(positions))
         self.guard_signature((self._cache_struct(),) + tuple(
             jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args))
+        rec = _telemetry.active()
         reg = _telemetry.registry()
         if reg is not None:
             reg.gauge('active_slots',
@@ -677,11 +721,14 @@ class GenerationEngine:
         t0 = clock()
         with _telemetry.span('serve_decode', kind='serve',
                              iteration=self._step_index,
-                             active_slots=k, bucket=bucket):
+                             active_slots=k, bucket=bucket,
+                             n_slots=self.n_slots,
+                             queue_depth=self._last_queue_depth):
             toks, cache = exe(self.params, self._cache, *args)
             toks = np.asarray(jax.block_until_ready(toks))
         self._cache = cache
         now = clock()
+        now_tele = rec.now() if rec is not None else None
         if reg is not None:
             reg.histogram('serve_decode_seconds',
                           help='per-decode-step wall time (s)'
@@ -703,17 +750,84 @@ class GenerationEngine:
             if itl is not None:
                 itl.observe(now - slot.t_last_token)
             slot.t_last_token = now
+            if rec is not None:
+                # one decode stage per live slot per tick, starting at
+                # the request's previous stage end: the span absorbs
+                # any scheduler wait between ticks (a neighbor's slow
+                # prefill IS latency this request paid), which is
+                # exactly what makes the stage budgets sum to the
+                # end-to-end latency
+                t_prev = slot.t_stage_end
+                if t_prev is None:
+                    t_prev = now_tele - (now - t0)
+                rec.child_span(slot.request.request_id, 'decode',
+                               t_prev, now_tele, slot=sid,
+                               step=self._step_index,
+                               token_index=len(slot.generated) - 1)
+                slot.t_stage_end = now_tele
             if slot.remaining == 0 or (self.eos_id is not None
                                        and tok == self.eos_id):
                 slot.request.set_result(slot.generated)
+                if rec is not None:
+                    rec.event('complete', kind='request',
+                              request_id=slot.request.request_id,
+                              tokens=len(slot.generated), slot=sid)
                 del self._slots[sid]
                 self._free.append(sid)
         self.decode_steps += 1
         self.tokens_generated += k
 
+    def _flight_table(self):
+        """The in-flight request table embedded in every flight dump
+        (:attr:`Recorder.flight_sources`): which requests were alive,
+        in which slot, at which stage, with how many tokens emitted --
+        so a crash mid-generation names which requests died where."""
+        active = []
+        for sid in sorted(self._slots):
+            try:
+                slot = self._slots[sid]
+            except KeyError:
+                continue   # racing refill on the dying process
+            active.append({'slot': sid,
+                           'request_id': slot.request.request_id,
+                           'stage': 'decode',
+                           'tokens': len(slot.generated),
+                           'position': slot.position,
+                           'remaining': slot.remaining})
+        return {'active': active,
+                'free_slots': list(self._free),
+                'step_index': self._step_index,
+                'queue_depth': self._last_queue_depth}
+
     def step(self, queue, clock=time.monotonic):
         """One scheduler tick: expire -> admit (slot refill) -> one
-        decode step.  Returns True when any work happened."""
+        decode step.  Returns True when any work happened.
+
+        With telemetry on, queue pressure is sampled EVERY tick --
+        ``serve_queue_depth`` (waiting requests, all still needing
+        prefill) and the backlog split ``serve_prefill_backlog`` /
+        ``serve_decode_backlog`` (live slots still generating) -- so
+        pressure ONSET is visible in captures, not just its latency
+        consequences; the engine's in-flight request table is also
+        registered as a flight-dump source."""
+        rec = _telemetry.active()
+        depth = queue.depth()
+        self._last_queue_depth = depth
+        if rec is not None:
+            if rec.flight_sources.get('serve_requests') \
+                    != self._flight_table:
+                rec.flight_sources['serve_requests'] = \
+                    self._flight_table
+            reg = rec.registry
+            reg.gauge('serve_queue_depth',
+                      help='requests waiting in the generation '
+                           'queue at the scheduler tick').set(depth)
+            reg.gauge('serve_prefill_backlog',
+                      help='queued requests still needing their '
+                           'prefill pass').set(depth)
+            reg.gauge('serve_decode_backlog',
+                      help='live slots still generating at the '
+                           'scheduler tick').set(len(self._slots))
         now = clock()
         force = (_chaos.on_serve_cancel()
                  if _chaos._active is not None else 0)

@@ -36,11 +36,33 @@ def _hist_summary(reg, name):
     return (snap or {}).get('summary') or {}
 
 
+def _worst_request(recorder):
+    """The worst traced request's stage decomposition from the live
+    recorder's in-memory records (``report.request_summary`` over the
+    same ``kind='request'`` stream the offline report reads) -- what
+    the bench rows carry so a bad p99 names its stage even when no
+    capture directory was kept.  None when nothing was traced."""
+    if recorder is None:
+        return None
+    try:
+        from chainermn_tpu.telemetry.report import request_summary
+        summary = request_summary(list(recorder.events))
+    except Exception:
+        return None
+    if not summary:
+        return None
+    return {'e2e_ms': summary.get('e2e_ms'),
+            'stage_p99_ms': summary.get('stage_p99_ms'),
+            'worst': summary.get('worst'),
+            'completed': summary.get('completed'),
+            'shed': summary.get('shed')}
+
+
 def open_loop_generate(engine, queue, rate, n_requests, seed=0,
                        prompt_len_range=None, max_new_tokens=16,
                        vocab_size=None, deadline_s=None,
                        result_timeout=60.0, clock=time.monotonic,
-                       capture_dir=None):
+                       capture_dir=None, slo_monitor=None):
     """Open-loop driver for the autoregressive
     :class:`~chainermn_tpu.serving.GenerationEngine` -- same
     clock-scheduled arrival contract as :func:`open_loop` (shedding
@@ -60,6 +82,11 @@ def open_loop_generate(engine, queue, rate, n_requests, seed=0,
         the engine model's).
       deadline_s: per-request deadline -- expiry mid-generation sheds
         typed through the serve_cancel path.
+      slo_monitor: optional
+        :class:`~chainermn_tpu.telemetry.slo.SLOMonitor` attached to
+        the recorder for the serve window; its live verdict rides in
+        the report's ``slo`` field (and its ``slo_snapshot.json`` is
+        written periodically when the monitor has an outdir).
     """
     lo, hi = prompt_len_range or (1, engine.max_prompt_len)
     vocab = vocab_size or engine.model.vocab_size
@@ -71,6 +98,9 @@ def open_loop_generate(engine, queue, rate, n_requests, seed=0,
     _installed = None
     if _telemetry.active() is None:
         _installed = _telemetry.enable()
+    recorder = _telemetry.active()
+    if slo_monitor is not None:
+        slo_monitor.attach(recorder)
 
     st0 = engine.stats()
     stop = threading.Event()
@@ -110,11 +140,15 @@ def open_loop_generate(engine, queue, rate, n_requests, seed=0,
         stop.set()
         worker.join(timeout=result_timeout)
         queue.close()
+        if slo_monitor is not None:
+            slo_monitor.detach()
+            slo_monitor.write_snapshot()   # final live verdict
         if capture_dir is not None and _telemetry.active() is not None:
             try:
                 _telemetry.active().flush(capture_dir)
             except Exception:
                 pass  # the report below is the primary artifact
+        worst = _worst_request(recorder)
         if _installed is not None:
             _telemetry.disable()
     ttft = _hist_summary(reg, 'serve_ttft_seconds')
@@ -159,6 +193,9 @@ def open_loop_generate(engine, queue, rate, n_requests, seed=0,
         'int8_kv': st['int8_kv'],
         'quantized': st['quantized'],
         'n_slots': st['n_slots'],
+        'worst_request': worst,
+        'slo': (slo_monitor.evaluate() if slo_monitor is not None
+                else None),
     }
 
 
@@ -203,6 +240,7 @@ def open_loop(engine, queue, rate, n_requests, seed=0,
     _installed = None
     if _telemetry.active() is None:
         _installed = _telemetry.enable()
+    recorder = _telemetry.active()
 
     compiles_before = engine.compile_count
     stop = threading.Event()
@@ -247,6 +285,7 @@ def open_loop(engine, queue, rate, n_requests, seed=0,
                 _telemetry.active().flush(capture_dir)
             except Exception:
                 pass  # the report below is the primary artifact
+        worst = _worst_request(recorder)
         if _installed is not None:
             _telemetry.disable()
     lat = _hist_summary(reg, 'serve_latency_seconds')
@@ -292,4 +331,5 @@ def open_loop(engine, queue, rate, n_requests, seed=0,
         'executions': st['executions'],
         'aot': st['aot'],
         'quantized': st['quantized'],
+        'worst_request': worst,
     }

@@ -129,6 +129,28 @@ def event(name, kind='event', **attrs):
         rec.event(name, kind=kind, **attrs)
 
 
+def request_stage(request_id, name, t0, t1=None, **attrs):
+    """Record one completed stage of a per-request trace
+    (``kind='request'`` span via :meth:`Recorder.child_span`); no-op
+    when disabled.  The serving path threads a request's lifecycle
+    through these -- ``queue_wait`` -> ``bucket_pack`` -> ``prefill``
+    -> per-tick ``decode`` (or ``execute`` on the batch path) -- with
+    each stage's ``t0`` equal to the previous stage's ``t1``, so
+    ``telemetry report`` reconstructs a gap-free timeline whose stage
+    budgets sum to the end-to-end latency."""
+    rec = _active
+    if rec is not None:
+        rec.child_span(request_id, name, t0, t1, **attrs)
+
+
+def request_event(request_id, name, **attrs):
+    """Record a terminal request event (``complete`` / ``shed`` /
+    ``error``) as a ``kind='request'`` event; no-op when disabled."""
+    rec = _active
+    if rec is not None:
+        rec.event(name, kind='request', request_id=request_id, **attrs)
+
+
 def registry():
     """The active recorder's metrics registry, or None."""
     rec = _active

@@ -1,11 +1,14 @@
-"""``python -m chainermn_tpu.telemetry``: merge, report and diagnose
-a telemetry capture.
+"""``python -m chainermn_tpu.telemetry``: merge, report, diagnose
+and SLO-judge a telemetry capture.
 
 ``report DIR`` merges every rank's ``events-rank*.jsonl`` +
 ``metrics-rank*.json`` under ``DIR`` into one step timeline, prints
-it with the overlap fraction, and writes the merged artifacts
+it with the overlap fraction (plus the per-request trace summary and
+the worst request's stage decomposition when the capture holds
+``kind='request'`` records), and writes the merged artifacts
 (``merged_report.json``, aggregated ``metrics.json``,
-``metrics.prom``) back into ``DIR``.
+``metrics.prom``) back into ``DIR``.  ``--request ID`` prints ONE
+request's reconstructed stage-by-stage timeline instead.
 
 ``doctor DIR`` runs the cross-rank diagnosis
 (:mod:`chainermn_tpu.telemetry.diagnosis`): collective skew
@@ -14,12 +17,21 @@ flags, and the flight-record + heartbeat crash post-mortem (dead
 rank, its last completed collective seq, where survivors were
 blocked).  Writes ``doctor_report.json`` into ``DIR``.
 
-Exit codes (both subcommands): 0 on a non-empty capture, 2 when the
+``slo DIR`` replays the capture's request traces through the
+sliding-window SLO monitor (:mod:`chainermn_tpu.telemetry.slo`) and
+prints the multi-window burn-rate verdict (``ok``/``warn``/
+``breach`` per SLO + overall) as of the capture's last instant --
+deterministic, and byte-identical to what the live monitor's
+``slo_snapshot.json`` would have said then.  Writes
+``slo_report.json`` into ``DIR``; targets are overridable per
+invocation (``--ttft-ms`` etc.).
+
+Exit codes (all subcommands): 0 on a non-empty capture, 2 when the
 directory holds no telemetry at all (CI smoke legs fail loudly on an
 accidentally-disabled capture); ``report`` additionally exits 1 on a
-malformed Prometheus export (never expected; guards the exporter).
-A missing or unknown subcommand prints usage and exits 2 -- CI
-misuse must never look like success.
+malformed Prometheus export (never expected; guards the exporter)
+and on an unknown ``--request`` id.  A missing or unknown subcommand
+prints usage and exits 2 -- CI misuse must never look like success.
 """
 
 import argparse
@@ -49,6 +61,11 @@ def _build_parser():
                      help='print only; do not write merged_report/'
                           'metrics.json/metrics.prom into the '
                           'session dir')
+    rep.add_argument('--request', metavar='ID', default=None,
+                     help='print ONE request\'s reconstructed '
+                          'stage-by-stage timeline (a request_id '
+                          'from the capture, e.g. r42) instead of '
+                          'the merged report')
     doc = sub.add_parser('doctor', help='cross-rank diagnosis: '
                                         'collective skew, stragglers, '
                                         'crash post-mortem')
@@ -64,12 +81,65 @@ def _build_parser():
     doc.add_argument('--no-export', action='store_true',
                      help='print only; do not write '
                           'doctor_report.json into the session dir')
+    slo = sub.add_parser('slo', help='sliding-window SLO verdict '
+                                     '(ok/warn/breach) over the '
+                                     'capture\'s request traces')
+    slo.add_argument('outdir', help='telemetry session directory')
+    slo.add_argument('--json', action='store_true',
+                     help='print the verdict as JSON instead of text')
+    slo.add_argument('--no-export', action='store_true',
+                     help='print only; do not write slo_report.json '
+                          'into the session dir')
+    slo.add_argument('--ttft-ms', type=float, default=1000.0,
+                     metavar='MS',
+                     help='TTFT latency target (default: '
+                          '%(default)s ms)')
+    slo.add_argument('--intertoken-ms', type=float, default=250.0,
+                     metavar='MS',
+                     help='inter-token latency target (default: '
+                          '%(default)s ms)')
+    slo.add_argument('--objective', type=float, default=0.99,
+                     help='good-event fraction for the latency SLOs '
+                          '(default: %(default)s)')
+    slo.add_argument('--shed-fraction', type=float, default=0.05,
+                     help='shed-fraction budget (default: '
+                          '%(default)s)')
+    slo.add_argument('--occupancy', type=float, default=0.98,
+                     help='slot-occupancy warn ceiling (default: '
+                          '%(default)s)')
+    slo.add_argument('--tokens-per-s', type=float, default=None,
+                     help='optional minimum generated tokens/s '
+                          '(omitted: no throughput SLO)')
+    slo.add_argument('--fast-window', type=float, default=None,
+                     metavar='S', help='fast burn window, seconds '
+                                       '(default: 30)')
+    slo.add_argument('--slow-window', type=float, default=None,
+                     metavar='S', help='slow burn window, seconds '
+                                       '(default: 150)')
     return parser
 
 
 def _cmd_report(args):
     from chainermn_tpu.telemetry import report as report_mod
     from chainermn_tpu.telemetry.recorder import snapshot_to_prometheus
+
+    if getattr(args, 'request', None):
+        _metas, spans, events, _bad = report_mod.load_rank_logs(
+            args.outdir)
+        traces = report_mod.request_traces(spans + events)
+        trace = traces.get(str(args.request))
+        if trace is None:
+            print('telemetry: no request %r in %s (known: %s)'
+                  % (args.request, args.outdir,
+                     ', '.join(sorted(traces)[:12]) or 'none'),
+                  file=sys.stderr)
+            return 1
+        if args.json:
+            import json
+            print(json.dumps(trace, indent=1, default=repr))
+        else:
+            print(report_mod.render_request_text(trace))
+        return 0
 
     report = report_mod.build_report(args.outdir)
     if not args.no_export:
@@ -121,6 +191,38 @@ def _cmd_doctor(args):
     return 0
 
 
+def _cmd_slo(args):
+    from chainermn_tpu.telemetry import slo as slo_mod
+
+    windows = {}
+    if args.fast_window is not None:
+        windows['fast_window_s'] = args.fast_window
+    if args.slow_window is not None:
+        windows['slow_window_s'] = args.slow_window
+    slos = slo_mod.default_slos(
+        ttft_s=args.ttft_ms / 1e3,
+        intertoken_s=args.intertoken_ms / 1e3,
+        objective=args.objective,
+        max_shed_fraction=args.shed_fraction,
+        max_occupancy=args.occupancy,
+        min_tokens_per_s=args.tokens_per_s, **windows)
+    result = slo_mod.evaluate_capture(args.outdir, slos=slos)
+    if not args.no_export:
+        slo_mod.export(args.outdir, result)
+    if args.json:
+        import json
+        print(json.dumps(result, indent=1, default=repr))
+    else:
+        print(slo_mod.render_slo_text(result))
+    if result['n_request_records'] == 0:
+        print('telemetry slo: no request traces or serve spans under '
+              '%s (was CHAINERMN_TPU_TELEMETRY set during the serve '
+              'window, and did the run flush?)' % args.outdir,
+              file=sys.stderr)
+        return 2
+    return 0
+
+
 def main(argv=None):
     parser = _build_parser()
     try:
@@ -132,11 +234,22 @@ def main(argv=None):
         return e.code if e.code else 0
     if args.cmd is None:
         parser.print_usage(sys.stderr)
-        print('%s: error: a subcommand is required (report | doctor)'
-              % parser.prog, file=sys.stderr)
+        print('%s: error: a subcommand is required (report | doctor '
+              '| slo)' % parser.prog, file=sys.stderr)
+        return 2
+    import os
+    if not os.path.isdir(args.outdir):
+        # a missing capture directory is the empty-capture case, not
+        # a traceback: every subcommand would otherwise crash trying
+        # to write its export next to logs that do not exist
+        print('telemetry %s: no session directory at %s (was '
+              'CHAINERMN_TPU_TELEMETRY set, and did the run flush?)'
+              % (args.cmd, args.outdir), file=sys.stderr)
         return 2
     if args.cmd == 'report':
         return _cmd_report(args)
+    if args.cmd == 'slo':
+        return _cmd_slo(args)
     return _cmd_doctor(args)
 
 

@@ -57,7 +57,7 @@ import os
 from chainermn_tpu.telemetry.report import (
     SERVE_PHASES, STEP_PHASES, exposed_time, load_rank_logs,
     load_rank_metrics, aggregate_metrics, merge_intervals,
-    serve_summary, _percentile)
+    request_summary, serve_summary, _percentile)
 
 #: phases the within-run anomaly scan pools samples for: the training
 #: step phases plus the serve-batch phases (``serve_execute`` spans
@@ -641,6 +641,7 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
     # event log) -- the serve summary is computed from the metrics
     # files so such a capture is diagnosable, not "empty"
     serve = serve_summary(aggregate_metrics(load_rank_metrics(outdir)))
+    requests = request_summary(spans + events)
     skew = collective_skew(spans)
     stragglers = find_stragglers(spans, skew)
     anomalies = step_anomalies(spans, z=z)
@@ -726,6 +727,17 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
                 line += ('; inter-token p50 %.3f ms p99 %.3f ms'
                          % (itl['p50'], itl['p99']))
             summary.append(line)
+        if serve.get('shed_reasons'):
+            summary.append('shed reasons: ' + ', '.join(
+                '%s=%.0f' % (k, v) for k, v
+                in sorted(serve['shed_reasons'].items())))
+    if requests and requests.get('worst'):
+        worst = requests['worst']
+        summary.append(
+            'worst traced request %s: e2e %.3f ms (%s)'
+            % (worst['request_id'], worst['e2e_ms'],
+               ', '.join('%s %.3f' % (k, v) for k, v
+                         in worst['stage_ms'].items())))
     if healthy:
         summary.append('no cross-rank skew, stragglers, anomalies or '
                        'deaths detected')
@@ -736,6 +748,7 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
         'n_events': len(events),
         'n_flight_records': len(flights),
         'serve': serve,
+        'requests': requests,
         'n_unparseable_lines': bad,
         'collective_skew': skew,
         'stragglers': stragglers,

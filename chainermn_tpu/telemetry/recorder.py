@@ -357,6 +357,18 @@ class Recorder:
         #: find the heartbeat files that pair with this capture
         self.liveness_dir = None
         self.flight_dumps = 0
+        #: streaming record consumers (the live SLO monitor,
+        #: :class:`chainermn_tpu.telemetry.slo.SLOMonitor`): called
+        #: with every appended record OUTSIDE the recorder lock.  The
+        #: empty-list check is the only hot-path cost when nothing is
+        #: attached -- and none of this runs at all when telemetry is
+        #: off (the zero-cost-off contract lives at the call sites).
+        self._listeners = []
+        #: named zero-arg callables whose return value is embedded in
+        #: every flight dump -- components register LIVE state tables
+        #: here (the generation engine's in-flight request table), so
+        #: a crash mid-generation names which requests died where
+        self.flight_sources = {}
 
     # -- clock ---------------------------------------------------------
     def now(self):
@@ -379,6 +391,26 @@ class Recorder:
                 drop = len(self.events) - MAX_EVENTS
                 del self.events[:drop]
                 self._flushed_upto = max(0, self._flushed_upto - drop)
+        if self._listeners:
+            # outside the lock: a listener that re-enters the recorder
+            # (or blocks) must not deadlock or stall span close paths
+            for fn in list(self._listeners):
+                try:
+                    fn(rec)
+                except Exception:
+                    pass  # a broken consumer never breaks recording
+
+    def add_listener(self, fn):
+        """Register a streaming record consumer (called with every
+        appended span/event record, after it is recorded)."""
+        if fn not in self._listeners:
+            self._listeners.append(fn)
+
+    def remove_listener(self, fn):
+        try:
+            self._listeners.remove(fn)
+        except ValueError:
+            pass
 
     @contextlib.contextmanager
     def span(self, name, kind='generic', **attrs):
@@ -407,6 +439,26 @@ class Recorder:
     def event(self, name, kind='event', **attrs):
         rec = {'type': 'event', 'name': name, 'kind': kind,
                't': self.now()}
+        if attrs:
+            rec.update(attrs)
+        self._append(rec)
+
+    def child_span(self, request_id, name, t0, t1=None, kind='request',
+                   **attrs):
+        """Record one already-timed child span of a request trace --
+        the per-request tracing primitive the serving path uses.
+
+        Cheaper than :meth:`span` on purpose (one dict + append, no
+        context manager, no open-span registry entry): the decode
+        scheduler records one of these per live slot per tick.  The
+        caller supplies ``t0`` (and optionally ``t1``) on THIS
+        recorder's clock (:meth:`now`), which is what lets stage spans
+        tile a request's timeline exactly -- each stage starts where
+        the previous one ended, so the per-stage budgets telescope to
+        the end-to-end latency with no gaps to fabricate."""
+        rec = {'type': 'span', 'name': name, 'kind': kind,
+               'request_id': request_id, 't0': t0,
+               't1': self.now() if t1 is None else t1}
         if attrs:
             rec.update(attrs)
         self._append(rec)
@@ -536,6 +588,16 @@ class Recorder:
             }
             if attrs:
                 record['attrs'] = attrs
+            # live state tables registered by components (the
+            # generation engine's in-flight request table): a crash
+            # mid-generation then names which requests died where.
+            # Each source is best-effort -- a racing mutation on the
+            # dying process must not void the black box
+            for name, fn in list(self.flight_sources.items()):
+                try:
+                    record[name] = fn()
+                except Exception:
+                    continue
             if not locked:
                 record['degraded'] = True  # lock-free snapshot
             record['complete'] = True  # write-complete sentinel

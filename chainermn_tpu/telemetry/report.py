@@ -52,6 +52,20 @@ COMPUTE_KINDS = ('compute',)
 #: span kinds audited for exposure
 COLLECTIVE_KINDS = ('collective',)
 
+#: per-request trace stage vocabulary (``kind='request'`` spans the
+#: serving path records, issue order): the generation path emits
+#: ``queue_wait`` -> ``bucket_pack`` -> ``prefill`` -> one ``decode``
+#: per tick; the batch path emits ``queue_wait`` -> ``bucket_pack``
+#: -> ``execute``.  Stages TILE the request's lifetime (each stage's
+#: t0 is the previous stage's t1), so per-stage budgets telescope to
+#: the end-to-end latency -- the property the p99 decomposition pin
+#: asserts to +-1 ms
+REQUEST_STAGES = ('queue_wait', 'bucket_pack', 'prefill', 'decode',
+                  'execute')
+
+#: terminal ``kind='request'`` event vocabulary
+REQUEST_OUTCOMES = ('complete', 'shed', 'error')
+
 
 # ---------------------------------------------------------------------
 # interval arithmetic (shared with benchmarks/trace_report.py)
@@ -288,10 +302,19 @@ def serve_summary(metrics):
     lat, wait, pad = (summ('serve_latency_seconds'),
                       summ('serve_queue_wait'),
                       summ('serve_pad_waste'))
+    # shed forensics: the admission layers bump a per-reason counter
+    # next to the aggregate, so an overload capture says WHY requests
+    # were turned away (queue_full vs deadline vs shutdown) -- only
+    # reasons that actually fired appear
+    shed_reasons = {
+        reason: total('serve_shed_%s_total' % reason)
+        for reason in ('queue_full', 'deadline', 'shutdown')
+        if ('serve_shed_%s_total' % reason) in serve}
     out = {
         'requests': total('serve_requests_total'),
         'batches': total('serve_batches_total'),
         'shed': total('serve_shed_total'),
+        'shed_reasons': shed_reasons or None,
         'latency_ms': {
             'count': lat.get('count', 0),
             'p50': (lat.get('p50') or 0.0) * 1e3 if lat else None,
@@ -338,6 +361,134 @@ def serve_summary(metrics):
     return out
 
 
+# ---------------------------------------------------------------------
+# per-request trace reconstruction (kind='request' records)
+
+def request_traces(records):
+    """Reconstruct per-request span trees from ``kind='request'``
+    records (stage spans + terminal events), keyed by ``request_id``.
+
+    Accepts any iterable of record dicts -- merged span/event lists
+    from :func:`load_rank_logs`, or a live recorder's raw ``events``
+    list -- and ignores everything that is not a request record.
+
+    Each trace carries the ordered ``stages`` (name/t0/t1/duration +
+    the recorded attrs: slot, bucket, pad_fraction, step), per-stage
+    total budgets ``stage_ms``, the decode tick count, the terminal
+    ``outcome`` (``complete`` / ``shed`` / ``error`` /
+    ``in_flight``), and ``e2e_ms`` -- last stage end minus first
+    stage start, which the tiled stage contract makes equal to the
+    stage-budget sum."""
+    traces = {}
+    for rec in records:
+        if rec.get('kind') != 'request':
+            continue
+        rid = rec.get('request_id')
+        if rid is None:
+            continue
+        tr = traces.setdefault(str(rid), {
+            'request_id': str(rid), 'stages': [], 'outcome':
+            'in_flight', 'outcome_attrs': None})
+        if 't0' in rec and 't1' in rec:
+            tr['stages'].append(rec)
+        elif rec.get('name') in REQUEST_OUTCOMES:
+            tr['outcome'] = rec['name']
+            tr['outcome_attrs'] = {
+                k: v for k, v in rec.items()
+                if k not in ('type', 'name', 'kind', 'request_id')}
+    for tr in traces.values():
+        tr['stages'].sort(key=lambda s: (s['t0'], s['t1']))
+        stage_ms = {}
+        n_decode = 0
+        for s in tr['stages']:
+            dur = max(s['t1'] - s['t0'], 0.0) * 1e3
+            stage_ms[s['name']] = stage_ms.get(s['name'], 0.0) + dur
+            if s['name'] == 'decode':
+                n_decode += 1
+        tr['stage_ms'] = {k: round(v, 3)
+                          for k, v in sorted(stage_ms.items())}
+        tr['n_decode'] = n_decode
+        if tr['stages']:
+            tr['t0'] = min(s['t0'] for s in tr['stages'])
+            tr['t1'] = max(s['t1'] for s in tr['stages'])
+            tr['e2e_ms'] = round((tr['t1'] - tr['t0']) * 1e3, 3)
+        else:
+            tr['t0'] = tr['t1'] = None
+            tr['e2e_ms'] = None
+    return traces
+
+
+def request_summary(records):
+    """The request-centric view of a capture: how many requests were
+    traced, their end-to-end latency distribution, per-stage p99
+    budgets, and the WORST completed request's full decomposition --
+    what ``telemetry report`` prints so a bad p99 names its stage.
+    ``None`` when the capture holds no request records."""
+    traces = request_traces(records)
+    if not traces:
+        return None
+    timed = [t for t in traces.values() if t['e2e_ms'] is not None]
+    done = [t for t in timed if t['outcome'] == 'complete']
+    shed = [t for t in traces.values() if t['outcome'] == 'shed']
+    e2e = sorted(t['e2e_ms'] for t in done)
+    stage_samples = {}
+    for t in done:
+        for name, ms in t['stage_ms'].items():
+            stage_samples.setdefault(name, []).append(ms)
+    worst = max(done, key=lambda t: t['e2e_ms']) if done else None
+    out = {
+        'count': len(traces),
+        'completed': len(done),
+        'shed': len(shed),
+        'in_flight': sum(1 for t in traces.values()
+                         if t['outcome'] == 'in_flight'),
+        'e2e_ms': ({} if not e2e else {
+            'count': len(e2e),
+            'p50': round(_percentile(e2e, 0.50), 3),
+            'p99': round(_percentile(e2e, 0.99), 3),
+            'max': round(e2e[-1], 3)}),
+        'stage_p99_ms': {
+            name: round(_percentile(sorted(vals), 0.99), 3)
+            for name, vals in sorted(stage_samples.items())},
+    }
+    if worst is not None:
+        out['worst'] = {
+            'request_id': worst['request_id'],
+            'e2e_ms': worst['e2e_ms'],
+            'stage_ms': worst['stage_ms'],
+            'stage_sum_ms': round(sum(worst['stage_ms'].values()), 3),
+            'n_decode': worst['n_decode'],
+            'outcome': worst['outcome'],
+        }
+    return out
+
+
+def render_request_text(trace):
+    """One request's reconstructed timeline, stage by stage (what
+    ``telemetry report --request ID`` prints)."""
+    lines = ['request %s: e2e %s ms over %d stage(s), outcome %s'
+             % (trace['request_id'],
+                '-' if trace['e2e_ms'] is None else
+                '%.3f' % trace['e2e_ms'],
+                len(trace['stages']), trace['outcome'])]
+    t_base = trace.get('t0')
+    for s in trace['stages']:
+        attrs = ', '.join(
+            '%s=%s' % (k, v) for k, v in sorted(s.items())
+            if k not in ('type', 'name', 'kind', 'request_id',
+                         't0', 't1', 'rank'))
+        lines.append(
+            '  t+%9.3f ms  %-12s %9.3f ms%s'
+            % ((s['t0'] - t_base) * 1e3, s['name'],
+               (s['t1'] - s['t0']) * 1e3,
+               ('  (%s)' % attrs) if attrs else ''))
+    if trace.get('outcome_attrs'):
+        lines.append('  outcome attrs: %s' % ', '.join(
+            '%s=%s' % (k, v)
+            for k, v in sorted(trace['outcome_attrs'].items())))
+    return '\n'.join(lines)
+
+
 def build_report(outdir):
     """The merged session report: timeline summary, per-step phase
     table, overlap statistics, aggregated metrics, chaos events."""
@@ -378,6 +529,7 @@ def build_report(outdir):
         'metrics': aggregate_metrics(rank_metrics),
     }
     report['serve'] = serve_summary(report['metrics'])
+    report['requests'] = request_summary(spans + events)
     return report
 
 
@@ -441,6 +593,10 @@ def render_text(report, max_steps=24):
                if lat.get('p50') is not None else '')
             + ('; pad waste %.1f%%' % (serve['pad_waste_mean'] * 100)
                if serve.get('pad_waste_mean') is not None else ''))
+        if serve.get('shed_reasons'):
+            lines.append('  shed reasons: ' + ', '.join(
+                '%s=%.0f' % (k, v) for k, v
+                in sorted(serve['shed_reasons'].items())))
         gen = serve.get('generate')
         if gen:
             ttft = gen.get('ttft_ms') or {}
@@ -456,6 +612,30 @@ def render_text(report, max_steps=24):
                 + ('; inter-token p50 %.3f ms p99 %.3f ms'
                    % (itl['p50'], itl['p99'])
                    if itl.get('p50') is not None else ''))
+    reqs = report.get('requests')
+    if reqs:
+        e2e = reqs.get('e2e_ms') or {}
+        lines.append(
+            'request traces: %d (%d completed, %d shed, %d in flight)'
+            % (reqs['count'], reqs['completed'], reqs['shed'],
+               reqs['in_flight'])
+            + ('; e2e p50 %.3f ms p99 %.3f ms'
+               % (e2e['p50'], e2e['p99'])
+               if e2e.get('p50') is not None else ''))
+        worst = reqs.get('worst')
+        if worst:
+            lines.append(
+                '  worst request %s: e2e %.3f ms = %s  '
+                '(%d decode ticks; stage sum %.3f ms)'
+                % (worst['request_id'], worst['e2e_ms'],
+                   ' + '.join(
+                       '%s %.3f' % (k, worst['stage_ms'][k])
+                       for k in (tuple(REQUEST_STAGES)
+                                 + tuple(sorted(
+                                     set(worst['stage_ms'])
+                                     - set(REQUEST_STAGES))))
+                       if k in worst['stage_ms']),
+                   worst['n_decode'], worst['stage_sum_ms']))
     if report['chaos_events']:
         lines.append('chaos events in timeline: %d (%s)'
                      % (len(report['chaos_events']),

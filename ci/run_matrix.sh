@@ -118,6 +118,49 @@ print('telemetry smoke OK: %d spans, %d step rows, overlap=%r, '
 PY
 rm -rf "${TELEMETRY_DIR}"
 
+# SERVING SLO SMOKE LEG (ISSUE 12): a short autoregressive serve
+# window recorded as a full telemetry capture (per-request trace
+# spans + serve metrics + the live monitor's slo_snapshot.json),
+# then replayed offline: `telemetry slo` must return a parseable
+# ok/warn/breach verdict (exit 0), and `telemetry report` must
+# reconstruct at least one request timeline with every stage present
+# (queue_wait -> bucket_pack -> prefill -> decode) and stage budgets
+# summing to the end-to-end latency (+-1 ms) -- the ISSUE 12
+# acceptance observable, end to end over real executables.
+echo "=== serving slo smoke: generate capture -> slo verdict + request timeline ==="
+SLO_DIR=$(mktemp -d /tmp/slo_smoke.XXXXXX)
+python bench.py --serve --generate --quick --cpu \
+  --serve-requests 24 --capture "${SLO_DIR}" \
+  > "${SLO_DIR}/bench_row.json"
+python -m chainermn_tpu.telemetry slo "${SLO_DIR}"
+python -m chainermn_tpu.telemetry report "${SLO_DIR}" > /dev/null
+python - "${SLO_DIR}" <<'PY'
+import json, sys
+d = sys.argv[1]
+slo = json.load(open(d + '/slo_report.json'))
+v = slo['verdict']['overall']
+assert v in ('ok', 'warn', 'breach'), slo['verdict']
+assert slo['n_request_records'] > 0, 'slo replay saw no records'
+snap = json.load(open(d + '/slo_snapshot.json'))
+assert snap['verdict']['overall'] in ('ok', 'warn', 'breach'), snap
+rep = json.load(open(d + '/merged_report.json'))
+reqs = rep['requests']
+assert reqs and reqs['completed'] > 0, reqs
+worst = reqs['worst']
+stages = set(worst['stage_ms'])
+assert {'queue_wait', 'bucket_pack', 'prefill', 'decode'} <= stages, \
+    stages
+assert abs(worst['stage_sum_ms'] - worst['e2e_ms']) <= 1.0, worst
+row = json.load(open(d + '/bench_row.json'))
+assert row.get('slo_verdict') in ('ok', 'warn', 'breach'), \
+    row.get('slo_verdict')
+print('slo smoke OK: verdict=%s (row %s), %d requests traced, worst '
+      '%s e2e %.3f ms (stage sum %.3f ms)'
+      % (v, row['slo_verdict'], reqs['count'], worst['request_id'],
+         worst['e2e_ms'], worst['stage_sum_ms']))
+PY
+rm -rf "${SLO_DIR}"
+
 # REAL-DATA convergence gate (VERDICT r4 next #8): the same positive
 # gate, fed genuine handwritten digits (sklearn's vendored UCI scans,
 # no egress) through the CHAINERMN_TPU_MNIST hook -- the reference's

@@ -900,6 +900,234 @@ class TestGenerateTelemetry:
 
 
 # ---------------------------------------------------------------------
+# per-request distributed tracing (ISSUE 12 tentpole)
+
+class TestRequestTracing:
+    def test_generate_stage_budgets_sum_to_e2e(self, tmp_path):
+        """THE ISSUE 12 acceptance pin: from a recorded generate
+        capture, the report decomposes the worst request's latency
+        into queue/pack/prefill/decode stage budgets that sum to its
+        end-to-end latency (+-1 ms), with every stage present."""
+        from chainermn_tpu import telemetry
+        from chainermn_tpu.telemetry import report as trep
+        cap = str(tmp_path / 'cap')
+        rec = telemetry.enable(cap)
+        try:
+            model, params = _tiny_lm()
+            eng = serving.GenerationEngine(model, params, n_slots=2,
+                                           max_prompt_len=4)
+            eng.warmup()
+            q = serving.GenerationQueue(max_prompt_len=4)
+            a = q.submit([1, 2], 6)
+            b = q.submit([3], 3)
+            for _ in range(24):
+                if a.done() and b.done():
+                    break
+                eng.step(q)
+            assert len(a.result()) == 6 and len(b.result()) == 3
+            rec.flush()
+        finally:
+            telemetry.disable()
+        rep = trep.build_report(cap)
+        reqs = rep['requests']
+        assert reqs['count'] == 2 and reqs['completed'] == 2
+        worst = reqs['worst']
+        assert {'queue_wait', 'bucket_pack', 'prefill',
+                'decode'} <= set(worst['stage_ms'])
+        assert abs(worst['stage_sum_ms'] - worst['e2e_ms']) <= 1.0
+        # every traced request tiles, not just the worst
+        traces = trep.request_traces(
+            trep.load_rank_logs(cap)[1] + trep.load_rank_logs(cap)[2])
+        for tr in traces.values():
+            assert abs(sum(tr['stage_ms'].values())
+                       - tr['e2e_ms']) <= 1.0
+            assert tr['outcome'] == 'complete'
+        # the CLI reconstructs a single request's timeline
+        from chainermn_tpu.telemetry.__main__ import main
+        assert main(['report', '--request', worst['request_id'],
+                     cap]) == 0
+        assert main(['report', '--request', 'rNOPE', cap]) == 1
+
+    def test_request_ids_unique_and_monotonic(self):
+        q = serving.GenerationQueue(max_prompt_len=4)
+        ids = [q.submit([1], 2).request_id for _ in range(4)]
+        nums = [int(i[1:]) for i in ids]
+        assert len(set(ids)) == 4
+        assert nums == sorted(nums)
+        # the batch queue draws from the same process-wide counter
+        rq = serving.RequestQueue(max_batch=4)
+        r = rq.submit(np.zeros((1, 3), np.float32))
+        assert int(r.request_id[1:]) > nums[-1]
+
+    def test_shed_events_carry_forensics(self):
+        """Satellite pin: queue_full, queued-deadline and
+        mid-generation sheds each emit a `shed` event with
+        request_id, reason and queue depth, and bump the per-reason
+        counter serve_summary breaks down."""
+        from chainermn_tpu import telemetry
+        from chainermn_tpu.telemetry.report import serve_summary
+        rec = telemetry.enable()
+        try:
+            clock = [0.0]
+            q = serving.GenerationQueue(max_prompt_len=4, max_queue=1,
+                                        clock=lambda: clock[0])
+            q.submit([1], 2, deadline=0.5)
+            with pytest.raises(OverloadError):
+                q.submit([2], 2)          # queue_full
+            clock[0] = 1.0
+            assert q.pop(4) == []         # deadline shed at pop
+            sheds = [e for e in rec.events
+                     if e.get('kind') == 'request'
+                     and e.get('name') == 'shed']
+            assert len(sheds) == 2
+            by_reason = {e['reason']: e for e in sheds}
+            assert by_reason['queue_full']['queue_depth'] == 1
+            assert by_reason['queue_full']['request_id']
+            assert by_reason['deadline']['waited_ms'] >= 500.0
+            snap = {'rank': 0, 'metrics': rec.registry.snapshot()}
+            serve = serve_summary(snap['metrics'])
+            assert serve['shed_reasons'] == {'queue_full': 1.0,
+                                             'deadline': 1.0}
+            assert serve['shed'] == 2.0
+        finally:
+            telemetry.disable()
+
+    def test_mid_generation_shed_names_request(self):
+        from chainermn_tpu import telemetry
+        rec = telemetry.enable()
+        try:
+            model, params = _tiny_lm()
+            eng = serving.GenerationEngine(model, params, n_slots=1,
+                                           max_prompt_len=4)
+            eng.warmup()
+            clock = [0.0]
+            q = serving.GenerationQueue(max_prompt_len=4,
+                                        clock=lambda: clock[0])
+            doomed = q.submit([1], 100, deadline=5.0)
+            eng.step(q, clock=lambda: clock[0])
+            clock[0] = 10.0
+            eng.step(q, clock=lambda: clock[0])
+            assert doomed.done()
+            sheds = [e for e in rec.events
+                     if e.get('kind') == 'request'
+                     and e.get('name') == 'shed']
+            assert sheds and sheds[-1]['request_id'] \
+                == doomed.request_id
+            assert sheds[-1]['reason'] == 'deadline'
+            assert sheds[-1]['tokens'] >= 1
+        finally:
+            telemetry.disable()
+
+    def test_flight_dump_includes_request_table(self, tmp_path):
+        """Satellite pin: a flight dump mid-generation names the
+        in-flight requests (id, slot, stage, tokens emitted)."""
+        from chainermn_tpu import telemetry
+        cap = str(tmp_path / 'flight')
+        rec = telemetry.enable(cap)
+        try:
+            model, params = _tiny_lm()
+            eng = serving.GenerationEngine(model, params, n_slots=2,
+                                           max_prompt_len=4)
+            eng.warmup()
+            q = serving.GenerationQueue(max_prompt_len=4)
+            req = q.submit([1, 2], 50)
+            eng.step(q)               # mid-generation
+            assert not req.done()
+            path = rec.dump_flight('test_crash')
+            record = json.load(open(path))
+            table = record['serve_requests']
+            assert table['active'][0]['request_id'] == req.request_id
+            assert table['active'][0]['stage'] == 'decode'
+            assert table['active'][0]['tokens'] >= 1
+            assert table['step_index'] >= 1
+        finally:
+            telemetry.disable()
+
+    def test_queue_depth_sampled_each_tick(self):
+        """Satellite pin: serve_queue_depth + the prefill/decode
+        backlog split are gauged at every scheduler tick, and the
+        serve_decode span carries queue_depth/n_slots attrs."""
+        from chainermn_tpu import telemetry
+        rec = telemetry.enable()
+        try:
+            model, params = _tiny_lm()
+            eng = serving.GenerationEngine(model, params, n_slots=1,
+                                           max_prompt_len=4)
+            eng.warmup()
+            q = serving.GenerationQueue(max_prompt_len=4)
+            q.submit([1], 3)
+            q.submit([2], 3)          # waits: only one slot
+            eng.step(q)
+            snap = rec.registry.snapshot()
+            # sampled at tick START (pressure onset): both requests
+            # were waiting when the first tick began
+            assert snap['serve_queue_depth']['value'] == 2.0
+            eng.step(q)
+            snap = rec.registry.snapshot()
+            assert snap['serve_queue_depth']['value'] == 1.0
+            assert snap['serve_prefill_backlog']['value'] == 1.0
+            assert snap['serve_decode_backlog']['value'] is not None
+            decode_spans = [e for e in rec.events
+                            if e.get('name') == 'serve_decode']
+            assert decode_spans
+            assert decode_spans[-1]['n_slots'] == 1
+            assert 'queue_depth' in decode_spans[-1]
+        finally:
+            telemetry.disable()
+
+    def test_batch_path_stages_tile_e2e(self):
+        """The forward-only engine's requests trace too:
+        queue_wait -> bucket_pack -> execute -> complete."""
+        from chainermn_tpu import telemetry
+        from chainermn_tpu.telemetry.report import request_traces
+        rec = telemetry.enable()
+        try:
+            model, params, apply_fn, example = _mlp_setup()
+            eng = InferenceEngine(apply_fn, params, example,
+                                  max_batch=4)
+            eng.warmup()
+            q = RequestQueue(max_batch=4, max_wait=0.001)
+            r1 = q.submit(np.zeros((2, 48), np.float32))
+            r2 = q.submit(np.zeros((1, 48), np.float32))
+            for pb in q.take(timeout=1.0):
+                eng.serve_packed(pb)
+            assert r1.done() and r2.done()
+            traces = request_traces(list(rec.events))
+            assert len(traces) == 2
+            for tr in traces.values():
+                assert {'queue_wait', 'bucket_pack',
+                        'execute'} <= set(tr['stage_ms'])
+                assert tr['outcome'] == 'complete'
+                assert abs(sum(tr['stage_ms'].values())
+                           - tr['e2e_ms']) <= 1.0
+        finally:
+            telemetry.disable()
+
+    def test_open_loop_reports_worst_request_and_slo(self):
+        from chainermn_tpu.telemetry.slo import SLOMonitor, \
+            default_slos
+        model, params = _tiny_lm()
+        eng = serving.GenerationEngine(model, params, n_slots=2,
+                                       max_prompt_len=4)
+        eng.warmup()
+        q = serving.GenerationQueue(max_prompt_len=4)
+        mon = SLOMonitor(slos=default_slos(ttft_s=30.0,
+                                           intertoken_s=30.0))
+        rep = serving.open_loop_generate(
+            eng, q, rate=300.0, n_requests=6, seed=6,
+            prompt_len_range=(1, 4), max_new_tokens=3,
+            slo_monitor=mon)
+        assert rep['served'] == 6
+        worst = rep['worst_request']
+        assert worst['completed'] == 6
+        assert abs(worst['worst']['stage_sum_ms']
+                   - worst['worst']['e2e_ms']) <= 1.0
+        assert rep['slo']['verdict']['overall'] in ('ok', 'warn',
+                                                    'breach')
+        assert mon.n_ingested > 0
+
+
+# ---------------------------------------------------------------------
 # shardlint decode_forward target (ISSUE 11 satellite)
 
 class TestDecodeForwardLintTarget:

@@ -1,0 +1,416 @@
+"""SLO monitor tests (ISSUE 12): windowed-percentile edge cases
+(empty window, single sample, rollover, cross-rank merge of
+time-bucketed histograms), multi-window burn-rate verdict logic, the
+live monitor's streaming ingest + periodic snapshot, and THE
+acceptance pin -- a synthetic slow-decode window flips the capture
+verdict to breach while the unperturbed capture stays ok,
+deterministically.
+"""
+
+import json
+import os
+
+import pytest
+
+from chainermn_tpu import telemetry
+from chainermn_tpu.telemetry.__main__ import main as telemetry_main
+from chainermn_tpu.telemetry.slo import (SLO, SLOMonitor,
+                                         WindowedCounter,
+                                         WindowedHistogram,
+                                         default_slos,
+                                         evaluate_capture)
+
+
+# ---------------------------------------------------------------------
+# windowed histogram edge cases (ISSUE 12 satellite)
+
+class TestWindowedHistogram:
+    def test_empty_window(self):
+        h = WindowedHistogram(bucket_s=1.0)
+        assert h.window_samples(10.0, 100.0) == []
+        assert h.summary(10.0, 100.0) == {'count': 0}
+
+    def test_single_sample_p50_equals_p99(self):
+        h = WindowedHistogram(bucket_s=1.0)
+        h.observe(0.042, 100.0)
+        s = h.summary(10.0, 100.5)
+        assert s['count'] == 1
+        assert s['p50'] == s['p99'] == 0.042
+
+    def test_window_excludes_older_samples(self):
+        h = WindowedHistogram(bucket_s=1.0)
+        h.observe(1.0, 100.0)
+        h.observe(2.0, 150.0)
+        # a 10 s window at t=155 sees only the newer sample
+        assert h.window_samples(10.0, 155.0) == [2.0]
+        # a wide window sees both, sorted
+        assert h.window_samples(100.0, 155.0) == [1.0, 2.0]
+
+    def test_rollover_drops_oldest_bucket(self):
+        h = WindowedHistogram(bucket_s=1.0, max_buckets=4)
+        for i in range(8):
+            h.observe(float(i), 100.0 + i)
+        # ring keeps only the newest 4 buckets ...
+        assert len(h._buckets) == 4
+        # ... so even an infinite window cannot resurrect the dropped
+        # samples (memory-bounded by construction)
+        assert h.window_samples(1e9, 107.5) == [4.0, 5.0, 6.0, 7.0]
+
+    def test_exact_percentiles_from_merged_buckets(self):
+        h = WindowedHistogram(bucket_s=1.0)
+        for i, v in enumerate([5.0, 1.0, 3.0, 2.0, 4.0]):
+            h.observe(v, 100.0 + i)
+        s = h.summary(10.0, 104.5)
+        assert s['count'] == 5
+        assert s['min'] == 1.0 and s['max'] == 5.0
+        assert s['p50'] == 3.0
+
+    def test_merge_across_ranks_bucketwise(self):
+        """Satellite pin: two ranks' time-bucketed histograms merge
+        by ABSOLUTE bucket index -- windowed percentiles over the
+        merged histogram equal percentiles over the union of
+        samples."""
+        a = WindowedHistogram(bucket_s=1.0)
+        b = WindowedHistogram(bucket_s=1.0)
+        a.observe(1.0, 100.2)
+        a.observe(3.0, 101.2)
+        b.observe(2.0, 100.7)   # same wall-clock second as a's first
+        b.observe(9.0, 50.0)    # outside the window below
+        a.merge(b)
+        assert a.window_samples(5.0, 102.0) == [1.0, 2.0, 3.0]
+        assert a.summary(5.0, 102.0)['p50'] == 2.0
+        # the out-of-window sample still merged into its own bucket
+        assert a.total_count() == 4
+
+    def test_merge_mismatched_resolution_refused(self):
+        a = WindowedHistogram(bucket_s=1.0)
+        b = WindowedHistogram(bucket_s=0.5)
+        with pytest.raises(ValueError, match='bucket_s'):
+            a.merge(b)
+
+    def test_counter_windowed_totals_and_merge(self):
+        c = WindowedCounter(bucket_s=1.0)
+        c.inc(100.0, 2.0)
+        c.inc(101.0)
+        c.inc(200.0, 5.0)
+        assert c.total(10.0, 101.5) == 3.0
+        assert c.total(1e9, 201.0) == 8.0
+        d = WindowedCounter(bucket_s=1.0)
+        d.inc(100.5, 4.0)
+        c.merge(d)
+        assert c.total(10.0, 101.5) == 7.0
+
+
+# ---------------------------------------------------------------------
+# SLO judging
+
+class TestSLOJudging:
+    def test_latency_burn_tiers(self):
+        s = SLO('ttft', 'ttft_seconds', 'latency', 0.1,
+                objective=0.99, page_burn=8.0, warn_burn=2.0,
+                min_events=4)
+        # budget = 0.01: burn = bad_frac / 0.01
+        ok = s.judge_burn(0.0, 0.0, 100)
+        assert ok['verdict'] == 'ok' and ok['data']
+        warn = s.judge_burn(0.05, 0.05, 100)   # 5x budget both
+        assert warn['verdict'] == 'warn'
+        breach = s.judge_burn(0.5, 0.25, 100)  # 50x / 25x
+        assert breach['verdict'] == 'breach'
+
+    def test_breach_requires_both_windows(self):
+        """The multi-window property: a spike that has aged out of
+        the fast window must stop paging even while the slow window
+        still remembers it."""
+        s = SLO('x', 'ttft_seconds', 'latency', 0.1, min_events=4)
+        recovered = s.judge_burn(0.0, 0.5, 100)
+        assert recovered['verdict'] == 'ok'
+        spiking = s.judge_burn(0.5, 0.001, 100)   # slow not yet hot
+        assert spiking['verdict'] == 'ok'
+
+    def test_insufficient_data_is_ok_not_fabricated(self):
+        s = SLO('x', 'ttft_seconds', 'latency', 0.1, min_events=10)
+        out = s.judge_burn(1.0, 1.0, 3)
+        assert out['verdict'] == 'ok'
+        assert out['data'] is False
+
+    def test_fraction_target_is_budget(self):
+        s = SLO('shed', 'shed_fraction', 'fraction', 0.05,
+                min_events=4)
+        assert s.judge_burn(0.01, 0.01, 100)['verdict'] == 'ok'
+        assert s.judge_burn(0.5, 0.5, 100)['verdict'] == 'breach'
+
+    def test_rate_min_and_level_max(self):
+        r = SLO('toks', 'tokens_per_s', 'rate_min', 100.0,
+                breach_ratio=0.5)
+        assert r.judge_level(150.0, 120.0)['verdict'] == 'ok'
+        assert r.judge_level(80.0, 90.0)['verdict'] == 'warn'
+        assert r.judge_level(40.0, 30.0)['verdict'] == 'breach'
+        m = SLO('occ', 'slot_occupancy', 'level_max', 0.9)
+        assert m.judge_level(0.5, 0.5)['verdict'] == 'ok'
+        assert m.judge_level(0.95, 0.95)['verdict'] == 'warn'
+        # no breach_level configured: saturation warns, never pages
+        assert m.judge_level(1.0, 1.0)['verdict'] == 'warn'
+        mb = SLO('occ', 'slot_occupancy', 'level_max', 0.9,
+                 breach_level=0.99)
+        assert mb.judge_level(1.0, 1.0)['verdict'] == 'breach'
+
+    def test_bad_window_config_refused(self):
+        with pytest.raises(ValueError, match='fast window'):
+            SLO('x', 'ttft_seconds', 'latency', 0.1,
+                fast_window_s=100.0, slow_window_s=10.0)
+        with pytest.raises(ValueError, match='kind'):
+            SLO('x', 'ttft_seconds', 'nope', 0.1)
+
+
+# ---------------------------------------------------------------------
+# synthetic captures: the deterministic replay substrate
+
+def _request_records(rid, t, queue_wait_s=0.001, pack_s=0.001,
+                     prefill_s=0.005, n_decode=8, gap_s=0.005,
+                     rank=0):
+    """One traced request's records, stage-tiled like the engine
+    records them."""
+    recs = []
+    t0 = t
+    t1 = t0 + queue_wait_s
+    recs.append({'type': 'span', 'kind': 'request', 'name':
+                 'queue_wait', 'request_id': rid, 't0': t0, 't1': t1,
+                 'rank': rank})
+    t2 = t1 + pack_s
+    recs.append({'type': 'span', 'kind': 'request', 'name':
+                 'bucket_pack', 'request_id': rid, 't0': t1, 't1': t2,
+                 'bucket': 8, 'pad_fraction': 0.25, 'rank': rank})
+    t3 = t2 + prefill_s
+    recs.append({'type': 'span', 'kind': 'request', 'name': 'prefill',
+                 'request_id': rid, 't0': t2, 't1': t3, 'slot': 0,
+                 'rank': rank})
+    cur = t3
+    for i in range(n_decode):
+        recs.append({'type': 'span', 'kind': 'request', 'name':
+                     'decode', 'request_id': rid, 't0': cur,
+                     't1': cur + gap_s, 'slot': 0, 'step': i,
+                     'rank': rank})
+        cur += gap_s
+    recs.append({'type': 'event', 'kind': 'request', 'name':
+                 'complete', 'request_id': rid, 't': cur,
+                 'tokens': n_decode + 1, 'rank': rank})
+    return recs
+
+
+def _write_capture(outdir, records, rank=0):
+    os.makedirs(outdir, exist_ok=True)
+    path = os.path.join(outdir, 'events-rank%d.jsonl' % rank)
+    with open(path, 'a') as f:
+        f.write(json.dumps({'type': 'meta', 'rank': rank, 'pid': 1,
+                            'wall0': 0.0}) + '\n')
+        for rec in records:
+            f.write(json.dumps(dict(rec, rank=rank)) + '\n')
+    return outdir
+
+
+def _synthetic_capture(outdir, slow_tail=False, t0=1000.0):
+    """40 requests over 20 s (one every 0.5 s), 8 decode ticks each.
+    ``slow_tail=True`` perturbs the final 5 seconds' requests with
+    40x inter-token gaps -- the synthetic slow-decode window."""
+    recs = []
+    for i in range(40):
+        t = t0 + 0.5 * i
+        gap = 0.2 if (slow_tail and t >= t0 + 15.0) else 0.005
+        recs.extend(_request_records('r%d' % (i + 1), t, gap_s=gap))
+        recs.append({'type': 'span', 'kind': 'serve',
+                     'name': 'serve_decode', 't0': t, 't1': t + 0.01,
+                     'iteration': i, 'active_slots': 4, 'n_slots': 8,
+                     'queue_depth': 0})
+    return _write_capture(outdir, recs)
+
+
+_TEST_SLOS = dict(ttft_s=0.1, intertoken_s=0.05,
+                  fast_window_s=10.0, slow_window_s=30.0)
+
+
+class TestEvaluateCapture:
+    def test_unperturbed_capture_is_ok(self, tmp_path):
+        d = _synthetic_capture(str(tmp_path / 'ok'))
+        res = evaluate_capture(d, slos=default_slos(**_TEST_SLOS))
+        assert res['verdict']['overall'] == 'ok'
+        assert res['verdict']['healthy'] is True
+        assert res['n_request_records'] > 0
+
+    def test_slow_decode_window_flips_to_breach(self, tmp_path):
+        """THE ISSUE 12 acceptance pin: the same capture with a
+        synthetic slow-decode tail breaches -- and names the
+        inter-token SLO -- while the unperturbed capture stays ok."""
+        d = _synthetic_capture(str(tmp_path / 'bad'), slow_tail=True)
+        res = evaluate_capture(d, slos=default_slos(**_TEST_SLOS))
+        assert res['verdict']['overall'] == 'breach'
+        assert 'intertoken_p99' in res['verdict']['breaches']
+        row = res['slos']['intertoken_p99']
+        assert row['burn_fast'] >= row['burn_slow'] >= 8.0
+
+    def test_deterministic_replay(self, tmp_path):
+        d = _synthetic_capture(str(tmp_path / 'det'), slow_tail=True)
+        slos = default_slos(**_TEST_SLOS)
+        a = evaluate_capture(d, slos=slos)
+        b = evaluate_capture(d, slos=default_slos(**_TEST_SLOS))
+        assert a == b
+
+    def test_aged_out_spike_stops_paging(self, tmp_path):
+        """Burn-rate semantics end to end: a slow window EARLY in the
+        capture has aged out of the fast window by capture end, so
+        the verdict is not breach (the slow window may still warn)."""
+        recs = []
+        t0 = 1000.0
+        for i in range(40):
+            t = t0 + 0.5 * i
+            gap = 0.2 if t < t0 + 5.0 else 0.005
+            recs.extend(_request_records('r%d' % (i + 1), t,
+                                         gap_s=gap))
+        d = _write_capture(str(tmp_path / 'aged'), recs)
+        res = evaluate_capture(d, slos=default_slos(**_TEST_SLOS))
+        assert res['slos']['intertoken_p99']['verdict'] != 'breach'
+
+    def test_occupancy_and_shed_series_fed(self, tmp_path):
+        d = _synthetic_capture(str(tmp_path / 'occ'))
+        res = evaluate_capture(d, slos=default_slos(**_TEST_SLOS))
+        occ = res['slos']['slot_occupancy']
+        assert occ['fast']['value'] == pytest.approx(0.5)
+        shed = res['slos']['shed_fraction']
+        assert shed['fast']['value'] == 0.0
+        assert shed['fast']['completed'] > 0
+
+    def test_shed_storm_breaches_shed_slo(self, tmp_path):
+        recs = []
+        t0 = 1000.0
+        for i in range(40):
+            t = t0 + 0.5 * i
+            if i % 2:
+                recs.append({'type': 'event', 'kind': 'request',
+                             'name': 'shed',
+                             'request_id': 's%d' % i, 't': t,
+                             'reason': 'queue_full',
+                             'queue_depth': 64})
+            else:
+                recs.extend(_request_records('r%d' % i, t))
+        d = _write_capture(str(tmp_path / 'shed'), recs)
+        res = evaluate_capture(d, slos=default_slos(**_TEST_SLOS))
+        # half of all outcomes shed vs a 5% budget: 10x burn
+        assert res['slos']['shed_fraction']['verdict'] == 'breach'
+
+    def test_cli_exit_codes_and_export(self, tmp_path, capsys):
+        d = _synthetic_capture(str(tmp_path / 'cli'))
+        rc = telemetry_main(['slo', d, '--ttft-ms', '100',
+                             '--intertoken-ms', '50',
+                             '--fast-window', '10',
+                             '--slow-window', '30'])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert 'verdict: OK' in out
+        exported = json.load(open(os.path.join(d, 'slo_report.json')))
+        assert exported['verdict']['overall'] == 'ok'
+        # --json prints the dict
+        rc = telemetry_main(['slo', d, '--json'])
+        assert rc == 0
+        parsed = json.loads(capsys.readouterr().out)
+        assert parsed['verdict']['overall'] in ('ok', 'warn',
+                                                'breach')
+
+    def test_cli_empty_capture_exit_2(self, tmp_path):
+        empty = tmp_path / 'empty'
+        empty.mkdir()
+        assert telemetry_main(['slo', str(empty)]) == 2
+        # a MISSING directory is the same empty-capture case for all
+        # three subcommands, never a traceback (regression: export
+        # used to crash writing next to logs that do not exist)
+        missing = str(tmp_path / 'nope')
+        assert telemetry_main(['slo', missing]) == 2
+        assert telemetry_main(['report', missing]) == 2
+        assert telemetry_main(['doctor', missing]) == 2
+        # a training-only capture (no request/serve records) is also
+        # "nothing to judge"
+        d = _write_capture(str(tmp_path / 'train'), [
+            {'type': 'span', 'kind': 'compute', 'name': 'jitted_step',
+             't0': 1.0, 't1': 2.0, 'iteration': 0}])
+        assert telemetry_main(['slo', d]) == 2
+
+    def test_cli_tokens_per_s_floor(self, tmp_path):
+        d = _synthetic_capture(str(tmp_path / 'rate'))
+        rc = telemetry_main(['slo', d, '--tokens-per-s', '1000000',
+                             '--fast-window', '10',
+                             '--slow-window', '30'])
+        assert rc == 0
+        rep = json.load(open(os.path.join(d, 'slo_report.json')))
+        assert rep['slos']['tokens_per_s']['verdict'] == 'breach'
+
+
+# ---------------------------------------------------------------------
+# live monitor: streaming ingest + snapshots
+
+class TestSLOMonitorLive:
+    def test_listener_attach_sees_request_stages(self):
+        rec = telemetry.enable()   # in-memory
+        try:
+            mon = SLOMonitor(slos=default_slos(**_TEST_SLOS))
+            mon.attach(rec)
+            t = rec.now()
+            telemetry.request_stage('rX', 'queue_wait', t, t + 0.001)
+            telemetry.request_stage('rX', 'prefill', t + 0.001,
+                                    t + 0.01)
+            telemetry.request_stage('rX', 'decode', t + 0.01,
+                                    t + 0.02)
+            telemetry.request_event('rX', 'complete', tokens=2)
+            mon.detach()
+            telemetry.request_stage('rY', 'decode', t, t + 1.0)
+            assert mon.n_ingested == 4   # detached: rY unseen
+            res = mon.evaluate()
+            assert res['slos']['ttft_p99']['slow']['count'] == 1
+        finally:
+            telemetry.disable()
+
+    def test_broken_listener_never_breaks_recording(self):
+        rec = telemetry.enable()
+        try:
+            calls = []
+
+            def bad(record):
+                calls.append(record)
+                raise RuntimeError('boom')
+
+            rec.add_listener(bad)
+            telemetry.event('fine', kind='event')
+            assert calls and rec.events[-1]['name'] == 'fine'
+            rec.remove_listener(bad)
+            rec.remove_listener(bad)   # idempotent
+        finally:
+            telemetry.disable()
+
+    def test_periodic_snapshot_by_record_time(self, tmp_path):
+        mon = SLOMonitor(slos=default_slos(**_TEST_SLOS),
+                         outdir=str(tmp_path), snapshot_every_s=5.0)
+        for rec in _request_records('r1', 1000.0):
+            mon.ingest(rec)
+        path = tmp_path / 'slo_snapshot.json'
+        assert path.exists()   # first ingest writes the first snap
+        first = json.load(open(path))
+        for rec in _request_records('r2', 1030.0, gap_s=0.2):
+            mon.ingest(rec)
+        second = json.load(open(path))
+        assert second['n_ingested'] > first['n_ingested']
+        assert second['verdict']['overall'] in ('ok', 'warn',
+                                                'breach')
+
+    def test_rate_denominator_clamps_to_observed_span(self):
+        """A 2-second capture judged over a 30-second window must
+        report tokens/s over the observed 2 seconds, not a 15x
+        dilution."""
+        mon = SLOMonitor(slos=[SLO('toks', 'tokens_per_s',
+                                   'rate_min', 3.0,
+                                   fast_window_s=10.0,
+                                   slow_window_s=30.0)])
+        for rec in _request_records('r1', 1000.0, n_decode=7,
+                                    gap_s=0.25):
+            mon.ingest(rec)
+        res = mon.evaluate()
+        # 8 tokens (prefill + 7 decode) over ~1.76 s observed
+        value = res['slos']['toks']['fast']['value']
+        assert value == pytest.approx(8 / 1.76, rel=0.3)
+        assert res['slos']['toks']['verdict'] == 'ok'

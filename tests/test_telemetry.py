@@ -672,3 +672,93 @@ def test_overlap_stats_splits_per_axis():
     assert abs(per['model']['exposed_collective_s'] - 0.4) < 1e-9
     assert 'untagged' in per  # pre-tagging spans stay visible
     assert 0.0 < st['overlap_fraction'] < 1.0
+
+
+# ---------------------------------------------------------------------
+# per-request tracing primitives (ISSUE 12)
+
+class TestRequestTracePrimitives:
+    def test_child_span_records_kind_request(self):
+        rec = telemetry.enable()
+        t0 = rec.now()
+        rec.child_span('r1', 'queue_wait', t0, t0 + 0.01, seq=3)
+        telemetry.request_stage('r1', 'prefill', t0 + 0.01,
+                                t0 + 0.02, slot=0)
+        telemetry.request_event('r1', 'complete', tokens=5)
+        spans = [e for e in rec.events if e['type'] == 'span']
+        events = [e for e in rec.events if e['type'] == 'event']
+        assert all(s['kind'] == 'request' for s in spans)
+        assert spans[0]['request_id'] == 'r1'
+        assert spans[0]['seq'] == 3
+        assert events[-1]['name'] == 'complete'
+        assert events[-1]['tokens'] == 5
+
+    def test_request_api_noop_when_disabled(self):
+        # zero-cost-off contract: no recorder, no records, no error
+        telemetry.request_stage('r1', 'decode', 0.0, 1.0)
+        telemetry.request_event('r1', 'complete')
+        assert telemetry.active() is None
+
+    def test_request_traces_and_summary(self):
+        records = [
+            {'type': 'span', 'kind': 'request', 'name': 'queue_wait',
+             'request_id': 'a', 't0': 0.0, 't1': 0.010},
+            {'type': 'span', 'kind': 'request', 'name': 'bucket_pack',
+             'request_id': 'a', 't0': 0.010, 't1': 0.011,
+             'bucket': 4, 'pad_fraction': 0.5},
+            {'type': 'span', 'kind': 'request', 'name': 'prefill',
+             'request_id': 'a', 't0': 0.011, 't1': 0.020},
+            {'type': 'span', 'kind': 'request', 'name': 'decode',
+             'request_id': 'a', 't0': 0.020, 't1': 0.030, 'step': 0},
+            {'type': 'span', 'kind': 'request', 'name': 'decode',
+             'request_id': 'a', 't0': 0.030, 't1': 0.045, 'step': 1},
+            {'type': 'event', 'kind': 'request', 'name': 'complete',
+             'request_id': 'a', 't': 0.045, 'tokens': 3},
+            {'type': 'span', 'kind': 'request', 'name': 'queue_wait',
+             'request_id': 'b', 't0': 0.0, 't1': 0.005},
+            {'type': 'event', 'kind': 'request', 'name': 'shed',
+             'request_id': 'b', 't': 0.005, 'reason': 'deadline',
+             'queue_depth': 7},
+            {'type': 'span', 'kind': 'compute', 'name': 'jitted_step',
+             't0': 0.0, 't1': 1.0, 'iteration': 0},   # ignored
+        ]
+        traces = rep_mod.request_traces(records)
+        assert set(traces) == {'a', 'b'}
+        a = traces['a']
+        assert a['stage_ms'] == {'bucket_pack': 1.0, 'decode': 25.0,
+                                 'prefill': 9.0, 'queue_wait': 10.0}
+        assert a['e2e_ms'] == 45.0
+        assert a['n_decode'] == 2
+        assert a['outcome'] == 'complete'
+        assert traces['b']['outcome'] == 'shed'
+        assert traces['b']['outcome_attrs']['reason'] == 'deadline'
+        summary = rep_mod.request_summary(records)
+        assert summary['count'] == 2
+        assert summary['completed'] == 1 and summary['shed'] == 1
+        worst = summary['worst']
+        assert worst['request_id'] == 'a'
+        assert worst['stage_sum_ms'] == worst['e2e_ms'] == 45.0
+        # stage tiling property: budgets telescope exactly
+        assert sum(a['stage_ms'].values()) == a['e2e_ms']
+        text = rep_mod.render_request_text(a)
+        assert 'queue_wait' in text and 'decode' in text
+        assert 'outcome complete' in text
+
+    def test_request_summary_none_without_request_records(self):
+        assert rep_mod.request_summary(
+            [{'type': 'span', 'kind': 'compute', 't0': 0, 't1': 1,
+              'name': 'jitted_step'}]) is None
+
+    def test_report_renders_worst_request_line(self, tmp_path):
+        rec = telemetry.enable(str(tmp_path))
+        t0 = rec.now()
+        rec.child_span('r9', 'queue_wait', t0, t0 + 0.001)
+        rec.child_span('r9', 'prefill', t0 + 0.001, t0 + 0.004)
+        rec.event('complete', kind='request', request_id='r9')
+        rec.flush()
+        telemetry.disable()
+        report = rep_mod.build_report(str(tmp_path))
+        assert report['requests']['count'] == 1
+        text = rep_mod.render_text(report)
+        assert 'request traces: 1' in text
+        assert 'worst request r9' in text
