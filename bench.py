@@ -165,6 +165,13 @@ _log.t0 = time.monotonic()
 
 
 def metric_stub(model):
+    if model == 'serve_fleet':
+        # the continuous-deployment arm (--serve --fleet): the
+        # product number is how fast weights can roll through a
+        # serving fleet with zero dropped requests (docs/serving.md
+        # "Continuous deployment")
+        return {'metric': 'serve_fleet_rolls_per_minute',
+                'unit': 'rolls/min'}
     if model.startswith('serve_generate'):
         # the autoregressive arm (--serve --generate): generated
         # tokens, not requests -- decode throughput is the product
@@ -1902,6 +1909,14 @@ GENERATE_SIDECAR_KEYS = (
     'intertoken_p50_ms', 'intertoken_p99_ms', 'shed_fraction',
     'capacity_tok_per_s', 'slo_verdict')
 
+#: fleet-row sidecars (--serve --fleet): the deployment regime's
+#: vocabulary -- swap downtime, swap-attributable drops (the zero
+#: the whole subsystem exists for), and the roll ledger's outcomes
+FLEET_SIDECAR_KEYS = (
+    'swap_downtime_p50_ms', 'swap_downtime_p99_ms',
+    'dropped_during_swap', 'promotes', 'rollbacks',
+    'served', 'shed_fraction')
+
 
 def _serve_capture_dir(argv):
     """``--capture DIR``: record the serve window as a full telemetry
@@ -2082,6 +2097,143 @@ def measure_serve(argv):
     if rep['served'] == 0:
         row['error'] = 'serve_no_completions'
     emit(row, rc=0 if rep['served'] else 1)
+
+
+def measure_fleet(argv):
+    """``--serve --fleet``: the continuous-deployment row
+    (ISSUE 13).
+
+    Boots the demo-LM fleet (``serving.fleet.build_local_fleet``, 2
+    in-process replicas), trains real sgd steps between rolls, and
+    rolls each manifest-tagged snapshot through the fleet UNDER
+    open-loop traffic -- canary, judge, promote -- timing the whole
+    deployment machine.  Row value = sustained rolls/minute; the
+    sidecars are the contract numbers: ``dropped_during_swap`` (must
+    be 0 -- a roll that sheds is a failed roll, rc 1),
+    per-replica out-of-rotation downtime p50/p99, and the ledger's
+    promote/rollback outcomes."""
+    quick = '--quick' in argv
+    stub = metric_stub('serve_fleet')
+
+    import tempfile
+
+    import jax
+
+    from chainermn_tpu.utils.platform import enable_host_cpu_backend
+    enable_host_cpu_backend()
+    if '--cpu' in argv:
+        from chainermn_tpu.utils import force_host_devices
+        force_host_devices(8)
+    n_dev = jax.device_count()
+    _log('fleet: backend=%s n_dev=%d'
+         % (jax.default_backend(), n_dev))
+
+    from chainermn_tpu import telemetry
+    from chainermn_tpu.serving import fleet as fleet_mod
+    from chainermn_tpu.utils.ledger import Ledger, events
+
+    telemetry.enable()   # the canary judge reads the record stream
+    n_replicas = int(_flag_value(argv, '--fleet-replicas', 2, int))
+    rolls = int(_flag_value(argv, '--fleet-rolls',
+                            1 if quick else 3, int))
+    rate = _flag_value(argv, '--serve-rate', 30.0)
+    canary_s = _flag_value(argv, '--canary-seconds', 2.0)
+    work = tempfile.mkdtemp(prefix='bench_fleet_')
+    ck, out = (os.path.join(work, 'ckpt'), os.path.join(work, 'out'))
+    fleet_mod.demo_train(ck, steps=2, snapshot_every=2)
+    controller = fleet_mod.build_local_fleet(
+        ck, out, n_replicas=n_replicas, canary_seconds=canary_s,
+        judge_interval=0.25, drain_timeout=60.0)
+    controller.watcher.debounce_s = 0.15
+    controller.start()
+    _log('fleet: %d replicas booted at version %d; offering %.0f '
+         'req/s, rolling %d snapshot(s)'
+         % (n_replicas, controller.current_version, rate, rolls))
+
+    import threading
+    traffic = fleet_mod._TrafficGen(controller.front, rate=rate,
+                                    max_new_tokens=4).start()
+    stop = threading.Event()
+    ctl_thread = threading.Thread(target=controller.run,
+                                  args=(stop,), daemon=True)
+    ctl_thread.start()
+    t_roll0 = time.perf_counter()
+    timed_out = False
+    try:
+        for k in range(rolls):
+            fleet_mod.demo_train(ck, steps=2, snapshot_every=2)
+            target = controller.current_version + 2 \
+                if controller.last_handled_version is None \
+                else controller.last_handled_version + 2
+            deadline = time.monotonic() + 240.0
+            while time.monotonic() < deadline:
+                if controller.last_handled_version == target:
+                    break
+                time.sleep(0.05)
+            else:
+                timed_out = True
+                break
+    finally:
+        roll_window_s = time.perf_counter() - t_roll0
+        traffic.stop()
+        stop.set()
+        ctl_thread.join(timeout=30.0)
+        controller.complete(traffic=traffic.stats())
+        controller.close()
+
+    ledger = Ledger.read(os.path.join(out, fleet_mod.LEDGER_NAME))
+    swaps = events(ledger, 'replica_swap')
+    downtimes = sorted(controller.swap_downtimes)
+
+    def pct(p):
+        if not downtimes:
+            return None
+        return round(
+            downtimes[min(len(downtimes) - 1,
+                          int(p * len(downtimes)))] * 1e3, 3)
+
+    tstats = traffic.stats()
+    rolls_done = controller.promotes + controller.rollbacks
+    value = 60.0 * rolls_done / max(roll_window_s, 1e-9)
+    shed = tstats['shed_submit'] + tstats['shed_result']
+    row = dict(
+        stub,
+        value=round(value, 3),
+        vs_baseline=0.0,
+        baseline_derivation='none: first continuous-deployment '
+                            'metric family round (reference has no '
+                            'serving path)',
+        n_devices=n_dev,
+        backend=jax.default_backend(),
+        device_kind=jax.devices()[0].device_kind,
+        quick=quick,
+        n_replicas=n_replicas,
+        rolls_requested=rolls,
+        rolls_done=rolls_done,
+        promotes=controller.promotes,
+        rollbacks=controller.rollbacks,
+        swap_failures=controller.swap_failures,
+        roll_window_s=round(roll_window_s, 3),
+        dropped_during_swap=controller.dropped_during_swap,
+        swap_downtime_p50_ms=pct(0.50),
+        swap_downtime_p99_ms=pct(0.99),
+        replica_swaps=len(swaps),
+        offered=tstats['offered'],
+        served=tstats['served'],
+        shed_fraction=round(shed / max(tstats['offered'], 1), 4),
+        tokens=tstats['tokens'],
+        canary_seconds=canary_s,
+        offered_req_per_s=round(rate, 2),
+        final_version=controller.current_version,
+    )
+    ok = (rolls_done >= rolls and not timed_out
+          and controller.dropped_during_swap == 0
+          and controller.swap_failures == 0)
+    if timed_out:
+        row['error'] = 'fleet_roll_timeout'
+    elif controller.dropped_during_swap:
+        row['error'] = 'fleet_dropped_requests_during_swap'
+    emit(row, rc=0 if ok else 1)
 
 
 def generate_family(argv):
@@ -2268,7 +2420,11 @@ def main():
         # (--generate: the autoregressive tokens/s family, with its
         # own sidecar vocabulary)
         generate = '--generate' in argv
-        if generate:
+        fleet = '--fleet' in argv
+        if fleet:
+            family = 'serve_fleet'
+            sidecars = FLEET_SIDECAR_KEYS
+        elif generate:
             family = generate_family(argv)
             sidecars = GENERATE_SIDECAR_KEYS
         else:
@@ -2276,7 +2432,9 @@ def main():
             sidecars = SERVE_SIDECAR_KEYS
         if '--child' in argv:
             child_argv = [a for a in argv if a != '--child']
-            if generate:
+            if fleet:
+                measure_fleet(child_argv)
+            elif generate:
                 measure_generate(child_argv)
             else:
                 measure_serve(child_argv)

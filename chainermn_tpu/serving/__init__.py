@@ -27,7 +27,17 @@ Three cooperating layers (``docs/serving.md``):
   batching (a finished or cancelled sequence's slot refills from the
   queue at the next decode step), a prefill/decode AOT split (prefill
   bucketed by prompt length, decode by active-slot count), int8
-  KV-cache mode, and the same no-recompile signature guard.
+  KV-cache mode, and the same no-recompile signature guard;
+- :mod:`~chainermn_tpu.serving.fleet` -- train-to-serve CONTINUOUS
+  DEPLOYMENT (ISSUE 13): a :class:`FleetController` running N engine
+  replicas behind a canary-routing :class:`FleetFront`, watching the
+  training checkpoint chain (:class:`CheckpointWatcher`) and rolling
+  new weights replica-by-replica without dropping requests -- live
+  hot-swap via the engines' double-buffered ``swap_params``, a
+  deterministic hash-slice canary judged by per-version SLO monitors
+  (:class:`CanaryJudge`), automatic rollback on breach, and an
+  append-only ``fleet_ledger.jsonl``.  CLI: ``python -m
+  chainermn_tpu.serving.fleet``.
 """
 
 from chainermn_tpu.serving.batcher import (  # noqa: F401
@@ -35,6 +45,9 @@ from chainermn_tpu.serving.batcher import (  # noqa: F401
     next_request_id, pack_sizes, record_shed)
 from chainermn_tpu.serving.engine import (  # noqa: F401
     InferenceEngine, load_params)
+from chainermn_tpu.serving.fleet import (  # noqa: F401
+    CanaryJudge, CheckpointWatcher, FleetController, FleetFront,
+    LocalReplica, SubprocessReplica, build_local_fleet, canary_slice)
 from chainermn_tpu.serving.generate import (  # noqa: F401
     GenerationEngine, GenerationQueue, GenRequest)
 from chainermn_tpu.serving.loadgen import (  # noqa: F401

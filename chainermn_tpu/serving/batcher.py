@@ -241,7 +241,10 @@ class RequestQueue:
     def __init__(self, max_batch=DEFAULT_MAX_BATCH,
                  max_wait=DEFAULT_MAX_WAIT,
                  max_queue=DEFAULT_MAX_QUEUE, edges=None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, label=None):
+        #: fleet replica name; when set, shed forensics carry it so a
+        #: per-replica SLO monitor can attribute sheds
+        self.label = label
         if max_queue < max_batch:
             raise ValueError('max_queue %d < max_batch %d: the queue '
                              'could never fill one full batch'
@@ -264,7 +267,7 @@ class RequestQueue:
         self.shed_deadline = 0
 
     # -- client edge ---------------------------------------------------
-    def submit(self, x, deadline=None, timeout=None):
+    def submit(self, x, deadline=None, timeout=None, request_id=None):
         """Enqueue one request (payload leading dim = item count >= 1)
         and return its :class:`Request` handle.
 
@@ -273,7 +276,9 @@ class RequestQueue:
         closed (``reason='shutdown'``); an over-bucket payload raises
         ``ValueError`` before touching queue state.  The chaos
         ``serve_burst`` site amplifies this submit with synthetic
-        copies through the SAME bounded admission."""
+        copies through the SAME bounded admission.  ``request_id``
+        lets an admission front (the fleet) pre-assign the trace id
+        it already routed on."""
         x = np.asarray(x)
         if x.ndim < 1:
             x = x[None]
@@ -281,7 +286,7 @@ class RequestQueue:
         burst = (_chaos.on_serve_submit()
                  if _chaos._active is not None else 0)
         with self._cond:
-            req = self._admit(x, deadline)
+            req = self._admit(x, deadline, request_id=request_id)
             for _ in range(burst):
                 try:
                     self._admit(x, deadline, synthetic=True)
@@ -291,17 +296,19 @@ class RequestQueue:
             self._cond.notify_all()
         return req
 
-    def _admit(self, x, deadline, synthetic=False):
+    def _admit(self, x, deadline, synthetic=False, request_id=None):
         if self._closed:
             raise OverloadError('serving queue is shut down',
                                 reason='shutdown',
                                 queue_depth=len(self._waiting))
         if len(self._waiting) >= self.max_queue:
             self.shed_queue_full += 1
-            # the request never existed as an object; a fresh id still
-            # names this rejection in the shed forensics
-            record_shed('queue_full', request_id=next_request_id(),
-                        queue_depth=len(self._waiting))
+            # the request never existed as an object; the routed id
+            # (or a fresh one) still names this rejection
+            record_shed('queue_full',
+                        request_id=request_id or next_request_id(),
+                        queue_depth=len(self._waiting),
+                        **self._shed_attrs())
             raise OverloadError(
                 'serving queue full (%d waiting requests); retry '
                 'with backoff' % len(self._waiting),
@@ -309,9 +316,13 @@ class RequestQueue:
         self._seq += 1
         self.submitted += 1
         req = Request(x, deadline=deadline, seq=self._seq,
-                      t_submit=self._clock(), synthetic=synthetic)
+                      t_submit=self._clock(), synthetic=synthetic,
+                      request_id=request_id)
         self._waiting.append(req)
         return req
+
+    def _shed_attrs(self):
+        return {'replica': self.label} if self.label else {}
 
     # -- engine edge ---------------------------------------------------
     def depth(self):
@@ -358,7 +369,8 @@ class RequestQueue:
                 record_shed('deadline', request_id=req.request_id,
                             queue_depth=len(snapshot),
                             waited_ms=round(
-                                (now - req.t_submit) * 1e3, 3))
+                                (now - req.t_submit) * 1e3, 3),
+                            **self._shed_attrs())
                 req.set_error(OverloadError(
                     'deadline expired after %.1f ms in queue'
                     % ((now - req.t_submit) * 1e3), reason='deadline'))
@@ -381,7 +393,8 @@ class RequestQueue:
             self._cond.notify_all()
         for req in pending:
             record_shed('shutdown', request_id=req.request_id,
-                        queue_depth=len(pending), count_total=False)
+                        queue_depth=len(pending), count_total=False,
+                        **self._shed_attrs())
             req.set_error(OverloadError('serving queue shut down',
                                         reason='shutdown'))
 

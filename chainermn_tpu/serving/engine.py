@@ -55,6 +55,7 @@ import jax.numpy as jnp
 from chainermn_tpu import telemetry as _telemetry
 from chainermn_tpu.analysis.walker import abstract_signature
 from chainermn_tpu.serving.batcher import bucket_edges
+from chainermn_tpu.utils import chaos as _chaos
 from chainermn_tpu.utils import jax_compat
 
 
@@ -94,14 +95,24 @@ class InferenceEngine:
         executables survive restarts).  ``aot=False`` forces the
         plain-jit fallback (what a runtime without the AOT surface
         degrades to anyway).
+      label / version: fleet identity.  ``label`` names this engine
+        as a replica; when set, every serve-path record (spans,
+        request stage spans, complete/shed events) carries
+        ``replica``/``version`` attributes so a per-replica,
+        per-version SLO monitor can filter one engine's traffic out
+        of a shared recorder stream.  ``version`` is the parameter
+        version served at boot (:meth:`swap_params` advances it).
     """
 
     def __init__(self, apply_fn, params, example, max_batch=32,
                  edges=None, policy=None, plan=None, param_specs=None,
-                 cache_dir=None, aot=True):
+                 cache_dir=None, aot=True, label=None, version=0):
         self.apply_fn = apply_fn
         self.policy = policy
         self.plan = plan
+        self.label = label
+        self.param_version = int(version)
+        self._boot_version = self.param_version
         self.max_batch = int(max_batch)
         edges = tuple(edges) if edges else bucket_edges(max_batch)
         if plan is not None:
@@ -137,22 +148,20 @@ class InferenceEngine:
         # cast to compute dtype (float policy; an inference engine
         # holds no f32 masters -- there is no optimizer to feed)
         quantize = getattr(policy, 'quantize', None)
-        if quantize is not None:
-            if param_specs is not None:
-                raise NotImplementedError(
-                    'int8 weights under tensor-parallel param_specs '
-                    'are not wired yet: quantize per shard after '
-                    'resharding, or serve the tp model in bf16')
-            self.params = jax.device_put(quantize(params),
-                                         self._param_sharding())
-            self.quantized = True
-        else:
-            host = params
-            if policy is not None:
-                from chainermn_tpu.precision import cast_floating
-                host = cast_floating(host, policy.compute_dtype)
-            self.params = jax.device_put(host, self._param_sharding())
-            self.quantized = False
+        if quantize is not None and param_specs is not None:
+            raise NotImplementedError(
+                'int8 weights under tensor-parallel param_specs '
+                'are not wired yet: quantize per shard after '
+                'resharding, or serve the tp model in bf16')
+        self.quantized = quantize is not None
+        # structure/shape template of the UNtransformed host tree --
+        # what checkpoint loads for later hot-swaps validate against
+        # (shapes only; no host copy is retained)
+        self._params_template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                np.shape(x), np.asarray(x).dtype
+                if not hasattr(x, 'dtype') else x.dtype), params)
+        self.params = self._place_params(params)
 
         self._compiled = {}   # bucket -> callable(params, x)
         self._aot = {}        # bucket -> True when AOT-compiled
@@ -171,6 +180,28 @@ class InferenceEngine:
         if self.param_specs is None:
             return self.plan.replicated()
         return self.plan.param_shardings(self.param_specs)
+
+    def _place_params(self, params):
+        """The load-time parameter transform (quantize under an int8
+        policy, cast under a float one) + device placement -- shared
+        by construction and every later hot-swap, so a swapped tree
+        goes through the identical pipeline the boot tree did."""
+        if self.quantized:
+            return jax.device_put(self.policy.quantize(params),
+                                  self._param_sharding())
+        host = params
+        if self.policy is not None:
+            from chainermn_tpu.precision import cast_floating
+            host = cast_floating(host, self.policy.compute_dtype)
+        return jax.device_put(host, self._param_sharding())
+
+    def _ident(self):
+        """Replica/version attrs stamped on serve-path records when
+        the engine has a fleet identity (empty otherwise, keeping
+        single-engine record schemas unchanged)."""
+        if self.label is None:
+            return {}
+        return {'replica': self.label, 'version': self.param_version}
 
     def _forward(self, params, x):
         # tracing-only counter: the body runs at trace time, so this
@@ -256,6 +287,62 @@ class InferenceEngine:
                     ).observe(time.perf_counter() - t0)
         return dict(self._aot)
 
+    # -- live weight hot-swap (fleet roll) -----------------------------
+    def swap_params(self, params, version=None, validate=True):
+        """Hot-swap the served parameter tree WITHOUT recompiling.
+
+        The bucket executables are keyed on shapes, not values, so a
+        same-shape tree slots straight in: the new tree is placed
+        through :meth:`_place_params` (double-buffered -- both
+        versions live on device from here), optionally validated by
+        running the largest compiled bucket on zeros and checking the
+        output finite, and only then CUT OVER by rebinding
+        ``self.params`` (in-flight executions keep the old reference
+        they already loaded; the old buffers are freed when the last
+        of them completes).  ``trace_count`` stays flat across a swap
+        -- the no-retrace property the fleet's roll depends on.
+
+        Raises :class:`~chainermn_tpu.utils.failure.WeightSwapError`
+        (engine unchanged, still serving the old version) when
+        validation fails."""
+        from chainermn_tpu.utils.failure import WeightSwapError
+        new = self._place_params(params)
+        if validate and self._compiled:
+            bucket = max(self._compiled)
+            x = jnp.zeros((bucket,) + self._item_shape, self._in_dtype)
+            try:
+                y = jax.block_until_ready(
+                    self._compiled[bucket](new, x))
+            except Exception as e:
+                raise WeightSwapError(
+                    'swap validation forward failed (%s: %s) -- '
+                    'keeping the incumbent parameters'
+                    % (type(e).__name__, e), version=version) from e
+            probe = y[0] if isinstance(y, (tuple, list)) else y
+            if not bool(np.isfinite(
+                    np.asarray(jax.device_get(probe))).all()):
+                raise WeightSwapError(
+                    'swap validation produced non-finite outputs -- '
+                    'refusing cutover to version %r' % (version,),
+                    version=version)
+        old = self.params
+        self.params = new
+        self.param_version = (int(version) if version is not None
+                              else self.param_version + 1)
+        _telemetry.event('weight_swap', kind='serve',
+                         **self._ident())
+        del old  # the double buffer: freed after cutover
+        return self.param_version
+
+    def swap_from_checkpoint(self, path, version=None, validate=True):
+        """:meth:`swap_params` fed from an elastic-resume checkpoint:
+        the crc-verified ``params`` subtree is loaded against the
+        boot tree's shape template (a changed architecture fails
+        typed, before any cutover) and hot-swapped in."""
+        return self.swap_params(
+            load_params(path, self._params_template), version=version,
+            validate=validate)
+
     def allowed_signatures(self):
         return set(self._signatures.values())
 
@@ -295,13 +382,17 @@ class InferenceEngine:
                 x.dtype, np.floating):
             x = x.astype(self._in_dtype)
         self.guard_signature(x)
+        if _chaos._active is not None:
+            _chaos.on_serve_slow(
+                self.param_version != self._boot_version)
         with _telemetry.span('serve_h2d', kind='h2d', bucket=bucket):
             xd = jax.device_put(
                 x, self.plan.batch_sharding() if self.plan is not None
                 else jax.devices()[0])
         with _telemetry.span('serve_execute', kind='serve',
                              bucket=bucket,
-                             iteration=self._batch_index) as sp:
+                             iteration=self._batch_index,
+                             **self._ident()) as sp:
             y = exe(self.params, xd)
             y = jax.block_until_ready(y)
             sp.set(aot=self._aot.get(bucket, False))
@@ -321,6 +412,7 @@ class InferenceEngine:
         clock = clock or time.monotonic
         rec = _telemetry.active()
         reg = _telemetry.registry()
+        ident = self._ident()
         t_exec0 = clock()
         queue_wait = t_exec0 - min(r.t_submit for r in pb.requests)
         # queue wait is PASSIVE time that already elapsed, so it is
@@ -339,7 +431,7 @@ class InferenceEngine:
                 if t0 is None:
                     t0 = t_pack0 - (clock() - req.t_submit)
                 rec.child_span(req.request_id, 'queue_wait', t0,
-                               t_pack0, seq=req.seq)
+                               t_pack0, seq=req.seq, **ident)
         try:
             x, _mask = pb.collate(
                 dtype=self.policy.compute_dtype
@@ -351,7 +443,7 @@ class InferenceEngine:
                     rec.child_span(req.request_id, 'bucket_pack',
                                    t_pack0, t_exe0, bucket=pb.bucket,
                                    pad_fraction=round(pad, 4),
-                                   items=req.n)
+                                   items=req.n, **ident)
             y = self.infer(x)
             t_done = clock()
             y_host = np.asarray(
@@ -365,10 +457,11 @@ class InferenceEngine:
                 t_done_tele = rec.now()
                 for req in pb.requests:
                     rec.child_span(req.request_id, 'execute', t_exe0,
-                                   t_done_tele, bucket=pb.bucket)
+                                   t_done_tele, bucket=pb.bucket,
+                                   **ident)
                     rec.event('complete', kind='request',
                               request_id=req.request_id,
-                              bucket=pb.bucket)
+                              bucket=pb.bucket, **ident)
         except Exception as e:
             for req in pb.requests:
                 if not req.done():
@@ -376,7 +469,7 @@ class InferenceEngine:
                     if rec is not None:
                         rec.event('error', kind='request',
                                   request_id=req.request_id,
-                                  error=type(e).__name__)
+                                  error=type(e).__name__, **ident)
             raise
         if reg is not None:
             reg.histogram(
@@ -433,6 +526,8 @@ class InferenceEngine:
         return {
             'buckets': sorted(self._compiled),
             'edges': list(self.edges),
+            'label': self.label,
+            'param_version': self.param_version,
             'aot': dict(self._aot),
             'aot_requested': self.aot_requested,
             'cache_dir': self.cache_dir,

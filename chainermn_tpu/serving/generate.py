@@ -139,7 +139,8 @@ class GenerationQueue:
     :class:`~chainermn_tpu.serving.RequestQueue`."""
 
     def __init__(self, max_prompt_len, max_queue=DEFAULT_MAX_QUEUE,
-                 clock=time.monotonic):
+                 clock=time.monotonic, label=None):
+        self.label = label  # fleet replica name (shed forensics)
         self.max_prompt_len = int(max_prompt_len)
         self.max_queue = int(max_queue)
         self._clock = clock
@@ -151,10 +152,13 @@ class GenerationQueue:
         self.shed_queue_full = 0
         self.shed_deadline = 0
 
-    def submit(self, prompt, max_new_tokens, deadline=None):
+    def submit(self, prompt, max_new_tokens, deadline=None,
+               request_id=None):
         """Enqueue one prompt; returns the :class:`GenRequest`.
         Over-length prompts raise ``ValueError`` before touching
-        queue state; a full or closed queue sheds typed."""
+        queue state; a full or closed queue sheds typed.
+        ``request_id`` lets an admission front (the fleet) pre-assign
+        the trace id it already routed on."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size > self.max_prompt_len:
             raise ValueError(
@@ -164,7 +168,8 @@ class GenerationQueue:
         burst = (_chaos.on_serve_submit()
                  if _chaos._active is not None else 0)
         with self._lock:
-            req = self._admit(prompt, max_new_tokens, deadline)
+            req = self._admit(prompt, max_new_tokens, deadline,
+                              request_id=request_id)
             for _ in range(burst):
                 try:
                     self._admit(prompt, max_new_tokens, deadline,
@@ -174,15 +179,17 @@ class GenerationQueue:
         return req
 
     def _admit(self, prompt, max_new_tokens, deadline,
-               synthetic=False):
+               synthetic=False, request_id=None):
         if self._closed:
             raise OverloadError('generation queue is shut down',
                                 reason='shutdown',
                                 queue_depth=len(self._waiting))
         if len(self._waiting) >= self.max_queue:
             self.shed_queue_full += 1
-            record_shed('queue_full', request_id=next_request_id(),
-                        queue_depth=len(self._waiting))
+            record_shed('queue_full',
+                        request_id=request_id or next_request_id(),
+                        queue_depth=len(self._waiting),
+                        **self._shed_attrs())
             raise OverloadError(
                 'generation queue full (%d waiting); retry with '
                 'backoff' % len(self._waiting),
@@ -191,9 +198,12 @@ class GenerationQueue:
         self.submitted += 1
         req = GenRequest(prompt, max_new_tokens, deadline=deadline,
                          seq=self._seq, t_submit=self._clock(),
-                         synthetic=synthetic)
+                         synthetic=synthetic, request_id=request_id)
         self._waiting.append(req)
         return req
+
+    def _shed_attrs(self):
+        return {'replica': self.label} if self.label else {}
 
     def pop(self, k):
         """Up to ``k`` live requests in arrival order; requests whose
@@ -210,7 +220,8 @@ class GenerationQueue:
                                 request_id=req.request_id,
                                 queue_depth=len(self._waiting),
                                 waited_ms=round(
-                                    (now - req.t_submit) * 1e3, 3))
+                                    (now - req.t_submit) * 1e3, 3),
+                                **self._shed_attrs())
                     req.set_error(OverloadError(
                         'deadline expired after %.1f ms in queue'
                         % ((now - req.t_submit) * 1e3),
@@ -229,7 +240,8 @@ class GenerationQueue:
             pending, self._waiting = self._waiting, []
         for req in pending:
             record_shed('shutdown', request_id=req.request_id,
-                        queue_depth=len(pending), count_total=False)
+                        queue_depth=len(pending), count_total=False,
+                        **self._shed_attrs())
             req.set_error(OverloadError('generation queue shut down',
                                         reason='shutdown'))
 
@@ -286,6 +298,11 @@ class GenerationEngine:
         shards its head dim over ``plan.model_axis``).
       cache_dir / aot: the engine's persistent-compilation-cache and
         AOT knobs, verbatim.
+      label / version: fleet identity (the engine.py contract): when
+        ``label`` is set, serve-path records carry
+        ``replica``/``version`` attrs for per-replica SLO filtering;
+        ``version`` is the boot parameter version and
+        :meth:`swap_params` advances it.
 
     Decoding is GREEDY (argmax in-graph -- the sampled token never
     round-trips a vocab-sized buffer to the host), which also makes
@@ -295,12 +312,15 @@ class GenerationEngine:
     def __init__(self, model, params, n_slots=8, max_prompt_len=64,
                  max_len=None, eos_id=None, policy=None,
                  int8_kv=False, plan=None, param_specs=None,
-                 cache_dir=None, aot=True):
+                 cache_dir=None, aot=True, label=None, version=0):
         import os
 
         from chainermn_tpu.models import init_kv_cache, kv_cache_specs
 
         self.model = model
+        self.label = label
+        self.param_version = int(version)
+        self._boot_version = self.param_version
         self.n_slots = int(n_slots)
         self.max_prompt_len = int(max_prompt_len)
         self.max_len = int(max_len or model.max_len)
@@ -331,23 +351,18 @@ class GenerationEngine:
 
         # load-time parameter transform, the engine.py idiom
         quantize = getattr(policy, 'quantize', None)
-        if quantize is not None:
-            if param_specs is not None:
-                raise NotImplementedError(
-                    'int8 weights under tensor-parallel param_specs '
-                    'are not wired yet (quantize per shard after '
-                    'resharding); int8_kv composes with tp, int8 '
-                    'WEIGHTS do not')
-            self.params = jax.device_put(quantize(params),
-                                         self._param_sharding())
-            self.quantized = True
-        else:
-            host = params
-            if policy is not None:
-                from chainermn_tpu.precision import cast_floating
-                host = cast_floating(host, policy.compute_dtype)
-            self.params = jax.device_put(host, self._param_sharding())
-            self.quantized = False
+        if quantize is not None and param_specs is not None:
+            raise NotImplementedError(
+                'int8 weights under tensor-parallel param_specs '
+                'are not wired yet (quantize per shard after '
+                'resharding); int8_kv composes with tp, int8 '
+                'WEIGHTS do not')
+        self.quantized = quantize is not None
+        self._params_template = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                jnp.shape(x), x.dtype if hasattr(x, 'dtype')
+                else np.asarray(x).dtype), params)
+        self.params = self._place_params(params)
 
         self.int8_kv = bool(int8_kv)
         tp = plan.model_size if plan is not None else 1
@@ -381,6 +396,80 @@ class GenerationEngine:
         if self.param_specs is None:
             return self.plan.replicated()
         return self.plan.param_shardings(self.param_specs)
+
+    def _place_params(self, params):
+        """Load-time transform + placement, shared by construction
+        and hot-swaps (the engine.py contract)."""
+        if self.quantized:
+            return jax.device_put(self.policy.quantize(params),
+                                  self._param_sharding())
+        host = params
+        if self.policy is not None:
+            from chainermn_tpu.precision import cast_floating
+            host = cast_floating(host, self.policy.compute_dtype)
+        return jax.device_put(host, self._param_sharding())
+
+    def _ident(self):
+        if self.label is None:
+            return {}
+        return {'replica': self.label, 'version': self.param_version}
+
+    # -- live weight hot-swap (fleet roll) -----------------------------
+    def swap_params(self, params, version=None, validate=True):
+        """Hot-swap the served parameter tree without recompiling
+        (executables are shape-keyed; ``decode_trace_count`` stays
+        flat across a swap).
+
+        REFUSED (typed :class:`~chainermn_tpu.utils.failure.
+        WeightSwapError`, engine unchanged) while sequences are in
+        flight: their KV caches were banked under the incumbent
+        weights, and decoding them under new weights would silently
+        corrupt the tail of every live generation -- the fleet drains
+        the replica first, which is exactly the per-replica
+        drain -> swap -> rejoin ladder.  Validation runs the
+        full-slot decode executable once with the new tree over the
+        (all-free) cache -- the warmup garbage-write contract -- and
+        checks the sampled tokens materialize; only then is
+        ``self.params`` cut over and the old buffer freed."""
+        from chainermn_tpu.utils.failure import WeightSwapError
+        if self._slots:
+            raise WeightSwapError(
+                'swap requires a drained replica: %d sequence(s) '
+                'still in flight hold KV state banked under the '
+                'incumbent weights' % len(self._slots),
+                version=version)
+        new = self._place_params(params)
+        if validate and self.n_slots in self._decode:
+            exe = self._decode[self.n_slots][0]
+            try:
+                tok, cache = exe(
+                    new, self._cache,
+                    jnp.zeros((self.n_slots,), jnp.int32),
+                    jnp.zeros((self.n_slots,), jnp.int32))
+                tok = jax.block_until_ready(tok)
+            except Exception as e:
+                raise WeightSwapError(
+                    'swap validation decode failed (%s: %s) -- '
+                    'keeping the incumbent parameters'
+                    % (type(e).__name__, e), version=version) from e
+            # the donated cache was consumed either way: rebind
+            self._cache = cache
+        old = self.params
+        self.params = new
+        self.param_version = (int(version) if version is not None
+                              else self.param_version + 1)
+        _telemetry.event('weight_swap', kind='serve',
+                         **self._ident())
+        del old  # double buffer freed after cutover
+        return self.param_version
+
+    def swap_from_checkpoint(self, path, version=None, validate=True):
+        """:meth:`swap_params` fed from an elastic-resume checkpoint
+        (crc-verified load against the boot tree's shape template)."""
+        from chainermn_tpu.serving.engine import load_params
+        return self.swap_params(
+            load_params(path, self._params_template), version=version,
+            validate=validate)
 
     def _cache_sharding(self):
         if self.plan is None:
@@ -604,7 +693,8 @@ class GenerationEngine:
             record_shed('deadline',
                         request_id=slot.request.request_id,
                         queue_depth=self._last_queue_depth,
-                        slot=sid, tokens=len(slot.generated))
+                        slot=sid, tokens=len(slot.generated),
+                        **self._ident())
         return len(doomed)
 
     def _admit(self, queue, now, clock):
@@ -617,6 +707,7 @@ class GenerationEngine:
         token), each starting where the previous ended."""
         rec = _telemetry.active()
         reg = _telemetry.registry()
+        ident = self._ident()
         for req in queue.pop(len(self._free)):
             sid = self._free.pop(0)
             prompt = req.prompt
@@ -626,7 +717,7 @@ class GenerationEngine:
                 if t0 is None:   # telemetry enabled mid-flight
                     t0 = t_pop - (clock() - req.t_submit)
                 rec.child_span(req.request_id, 'queue_wait', t0,
-                               t_pop, seq=req.seq)
+                               t_pop, seq=req.seq, **ident)
             bucket = bucket_of(prompt.size, self.prefill_edges)
             tokens = np.zeros((1, bucket), np.int32)
             tokens[0, :prompt.size] = prompt
@@ -641,10 +732,15 @@ class GenerationEngine:
                 rec.child_span(
                     req.request_id, 'bucket_pack', t_pop, t_pf0,
                     bucket=bucket, pad_fraction=round(
-                        (bucket - prompt.size) / float(bucket), 4))
+                        (bucket - prompt.size) / float(bucket), 4),
+                    **ident)
+            if _chaos._active is not None:
+                _chaos.on_serve_slow(
+                    self.param_version != self._boot_version)
             with _telemetry.span('serve_prefill', kind='serve',
                                  bucket=bucket, slot=sid,
-                                 iteration=self._step_index):
+                                 iteration=self._step_index,
+                                 **ident):
                 tok, cache = exe(self.params, self._cache, *args)
                 tok = int(jax.block_until_ready(tok))
             self._cache = cache
@@ -656,7 +752,8 @@ class GenerationEngine:
                 t_first_tele = rec.now()
                 rec.child_span(req.request_id, 'prefill', t_pf0,
                                t_first_tele, bucket=bucket, slot=sid,
-                               prompt_tokens=int(prompt.size))
+                               prompt_tokens=int(prompt.size),
+                               **ident)
             if reg is not None:
                 reg.histogram(
                     'serve_ttft_seconds',
@@ -671,7 +768,7 @@ class GenerationEngine:
                 if rec is not None:
                     rec.event('complete', kind='request',
                               request_id=req.request_id, tokens=1,
-                              slot=sid)
+                              slot=sid, **ident)
                 continue
             self._slots[sid] = _Slot(req, prompt.size,
                                      req.max_new_tokens - 1, tok,
@@ -714,16 +811,21 @@ class GenerationEngine:
             jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args))
         rec = _telemetry.active()
         reg = _telemetry.registry()
+        ident = self._ident()
         if reg is not None:
             reg.gauge('active_slots',
                       help='live sequences at this decode step'
                       ).set(k)
+        if _chaos._active is not None:
+            _chaos.on_serve_slow(
+                self.param_version != self._boot_version)
         t0 = clock()
         with _telemetry.span('serve_decode', kind='serve',
                              iteration=self._step_index,
                              active_slots=k, bucket=bucket,
                              n_slots=self.n_slots,
-                             queue_depth=self._last_queue_depth):
+                             queue_depth=self._last_queue_depth,
+                             **ident):
             toks, cache = exe(self.params, self._cache, *args)
             toks = np.asarray(jax.block_until_ready(toks))
         self._cache = cache
@@ -763,7 +865,8 @@ class GenerationEngine:
                 rec.child_span(slot.request.request_id, 'decode',
                                t_prev, now_tele, slot=sid,
                                step=self._step_index,
-                               token_index=len(slot.generated) - 1)
+                               token_index=len(slot.generated) - 1,
+                               **ident)
                 slot.t_stage_end = now_tele
             if slot.remaining == 0 or (self.eos_id is not None
                                        and tok == self.eos_id):
@@ -771,7 +874,8 @@ class GenerationEngine:
                 if rec is not None:
                     rec.event('complete', kind='request',
                               request_id=slot.request.request_id,
-                              tokens=len(slot.generated), slot=sid)
+                              tokens=len(slot.generated), slot=sid,
+                              **ident)
                 del self._slots[sid]
                 self._free.append(sid)
         self.decode_steps += 1
@@ -854,6 +958,8 @@ class GenerationEngine:
         return {
             'prefill_buckets': sorted(self._prefill),
             'decode_buckets': sorted(self._decode),
+            'label': self.label,
+            'param_version': self.param_version,
             'prefill_edges': list(self.prefill_edges),
             'decode_edges': list(self.decode_edges),
             'n_slots': self.n_slots,

@@ -180,8 +180,9 @@ class SLO:
     Args:
       name: verdict key (``ttft_p99``, ``shed_fraction``, ...).
       metric: the monitored series -- one of ``ttft_seconds``,
-        ``intertoken_seconds``, ``tokens_per_s``, ``shed_fraction``,
-        ``slot_occupancy``.
+        ``intertoken_seconds``, ``latency_seconds`` (the batch
+        path's submit-to-result e2e), ``tokens_per_s``,
+        ``shed_fraction``, ``slot_occupancy``.
       kind: how the series is judged:
 
         - ``'latency'``: good event = sample <= ``target`` seconds;
@@ -291,12 +292,15 @@ class SLO:
 
 def default_slos(ttft_s=1.0, intertoken_s=0.25, objective=0.99,
                  max_shed_fraction=0.05, max_occupancy=0.98,
-                 min_tokens_per_s=None,
+                 min_tokens_per_s=None, latency_s=None,
                  fast_window_s=DEFAULT_FAST_WINDOW_S,
                  slow_window_s=DEFAULT_SLOW_WINDOW_S):
     """The serving SLO set the bench and the CLI start from;
     every threshold is a keyword so a deployment (or a test pinning
-    determinism) declares its own numbers."""
+    determinism) declares its own numbers.  ``latency_s`` adds the
+    batch path's end-to-end request-latency objective (fed from
+    ``execute`` stage spans) -- the generation metrics stay silent on
+    a batch fleet, so this is what its canary gate judges."""
     slos = [
         SLO('ttft_p99', 'ttft_seconds', 'latency', ttft_s,
             objective=objective, fast_window_s=fast_window_s,
@@ -314,6 +318,11 @@ def default_slos(ttft_s=1.0, intertoken_s=0.25, objective=0.99,
     if min_tokens_per_s is not None:
         slos.append(SLO('tokens_per_s', 'tokens_per_s', 'rate_min',
                         min_tokens_per_s,
+                        fast_window_s=fast_window_s,
+                        slow_window_s=slow_window_s))
+    if latency_s is not None:
+        slos.append(SLO('latency_p99', 'latency_seconds', 'latency',
+                        latency_s, objective=objective,
                         fast_window_s=fast_window_s,
                         slow_window_s=slow_window_s))
     return slos
@@ -337,11 +346,19 @@ class SLOMonitor:
       outdir / snapshot_every_s: when ``outdir`` is set, a
         ``slo_snapshot.json`` verdict is (re)written there every
         ``snapshot_every_s`` seconds of RECORD time.
+      record_filter: optional predicate over raw records, applied
+        BEFORE the serving-vocabulary dispatch.  The fleet's canary
+        gate runs one monitor per (replica, parameter version) over a
+        SHARED recorder stream by filtering on the ``replica`` /
+        ``version`` attrs the engines stamp -- two monitors with
+        disjoint filters see disjoint traffic and judge independently.
     """
 
     def __init__(self, slos=None, bucket_s=DEFAULT_BUCKET_SECONDS,
                  max_buckets=DEFAULT_MAX_BUCKETS, n_slots=None,
-                 outdir=None, snapshot_every_s=5.0):
+                 outdir=None, snapshot_every_s=5.0,
+                 record_filter=None):
+        self.record_filter = record_filter
         self.slos = list(slos) if slos is not None else default_slos()
         self.n_slots = n_slots
         self.outdir = outdir
@@ -350,6 +367,7 @@ class SLOMonitor:
         mk_c = lambda: WindowedCounter(bucket_s, max_buckets)    # noqa: E731
         self.ttft = mk_h()
         self.intertoken = mk_h()
+        self.latency = mk_h()   # batch path: submit-to-result e2e
         self.occupancy = mk_h()
         self.tokens = mk_c()
         self.completed = mk_c()
@@ -364,7 +382,11 @@ class SLOMonitor:
     # -- the one ingestion path (live listener AND offline replay) ----
     def ingest(self, rec):
         """Consume one recorder record (span or event dict); records
-        that are not part of the serving vocabulary are ignored."""
+        that are not part of the serving vocabulary -- or that the
+        ``record_filter`` rejects -- are ignored."""
+        if self.record_filter is not None \
+                and not self.record_filter(rec):
+            return
         kind = rec.get('kind')
         if kind == 'request':
             self._ingest_request(rec)
@@ -406,9 +428,13 @@ class SLOMonitor:
                 self.intertoken.observe(t1 - t0, t1)
                 self.tokens.inc(t1, 1.0)
             elif name == 'execute':
-                # the batch path's terminal stage: a served request is
-                # an outcome even though it generates no tokens
-                pass
+                # the batch path's terminal stage: the request's
+                # end-to-end latency (admission stamp -> execute end)
+                # feeds the latency series the batch-fleet canary
+                # gate judges; a served request is an outcome even
+                # though it generates no tokens
+                start = self._t0_by_request.get(rid, t0)
+                self.latency.observe(t1 - start, t1)
         elif 't' in rec:                          # terminal event
             t = rec['t']
             self._seen(t)
@@ -450,12 +476,17 @@ class SLOMonitor:
         return max(min(window_s, seen),
                    min(window_s, DEFAULT_BUCKET_SECONDS))
 
+    def _hist_for(self, metric):
+        return {'ttft_seconds': self.ttft,
+                'intertoken_seconds': self.intertoken,
+                'latency_seconds': self.latency}[metric]
+
     def _window_view(self, metric, window_s, now):
         """``(bad_fraction_or_None, value, n_events, stats)`` for one
         metric over one window."""
-        if metric in ('ttft_seconds', 'intertoken_seconds'):
-            hist = (self.ttft if metric == 'ttft_seconds'
-                    else self.intertoken)
+        if metric in ('ttft_seconds', 'intertoken_seconds',
+                      'latency_seconds'):
+            hist = self._hist_for(metric)
             samples = hist.window_samples(window_s, now)
             stats = hist.summary(window_s, now)
             return None, stats.get('p99'), len(samples), stats
@@ -484,12 +515,10 @@ class SLOMonitor:
         bf_s, value_s, n_s, stats_s = self._window_view(
             slo.metric, slo.slow_window_s, now)
         if slo.kind == 'latency':
-            samples_f = (self.ttft if slo.metric == 'ttft_seconds'
-                         else self.intertoken).window_samples(
-                             slo.fast_window_s, now)
-            samples_s = (self.ttft if slo.metric == 'ttft_seconds'
-                         else self.intertoken).window_samples(
-                             slo.slow_window_s, now)
+            samples_f = self._hist_for(slo.metric).window_samples(
+                slo.fast_window_s, now)
+            samples_s = self._hist_for(slo.metric).window_samples(
+                slo.slow_window_s, now)
             bf_f = (sum(1 for v in samples_f if v > slo.target)
                     / len(samples_f)) if samples_f else 0.0
             bf_s = (sum(1 for v in samples_s if v > slo.target)

@@ -221,6 +221,29 @@ def snapshot_chain(out, extra_prefixes=('snapshot_iter_',)):
     return [(kind, path, it) for it, _, kind, path in cands]
 
 
+def chain_heads(out, extra_prefixes=('snapshot_iter_',)):
+    """The snapshot chain with the cheap completeness probe and the
+    file mtime attached: ``[(kind, path, iteration, mtime)]`` newest
+    first, sentinel-less/zero-byte candidates already dropped.
+
+    This is the POLLING view a watcher wants (the serving fleet's
+    :class:`~chainermn_tpu.serving.fleet.CheckpointWatcher` debounces
+    over the mtime): completeness is the write-COMMITTED probe, the
+    mtime is the settled-on-disk probe, and full crc verification is
+    left to the caller because it reads every byte."""
+    from chainermn_tpu import serializers
+    out_rows = []
+    for kind, path, it in snapshot_chain(out, extra_prefixes):
+        if not serializers.checkpoint_complete(path):
+            continue
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            continue  # raced a concurrent cleanup
+        out_rows.append((kind, path, it, mtime))
+    return out_rows
+
+
 def latest_snapshot(out, extra_prefixes=('snapshot_iter_',)):
     """Newest VALID resumable snapshot under ``out``:
     ``(kind, path, iteration)`` where kind is ``'npz'`` or

@@ -67,6 +67,7 @@ import sys
 import time
 
 from chainermn_tpu.utils import failure
+from chainermn_tpu.utils.ledger import Ledger  # noqa: F401  (re-export)
 
 #: environment handout to supervised workers (the CMN_SUP_* contract)
 ENV_RANK = 'CMN_SUP_RANK'
@@ -449,48 +450,10 @@ def classify_failure(first_death, rank_rcs, doctor=None,
 
 
 # ----------------------------------------------------------------------
-# the append-only ledger
+# the append-only ledger -- shared implementation in utils/ledger.py
+# (the fleet's fleet_ledger.jsonl writes through the same class);
+# ``Ledger`` stays importable from here for existing callers
 # ----------------------------------------------------------------------
-
-class Ledger:
-    """Append-only ``supervisor_ledger.jsonl``: one JSON object per
-    line, fsynced -- the machine-readable recovery record a dead
-    supervisor leaves behind (events: ``start`` / ``launch`` /
-    ``recovered`` / ``failure`` / ``decision`` / ``abort`` /
-    ``complete``)."""
-
-    def __init__(self, path):
-        self.path = path
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-
-    def append(self, event, **fields):
-        rec = dict(fields, event=event, t=round(time.time(), 3))
-        with open(self.path, 'a') as f:
-            f.write(json.dumps(rec, default=repr, sort_keys=True)
-                    + '\n')
-            f.flush()
-            os.fsync(f.fileno())
-        return rec
-
-    @staticmethod
-    def read(path):
-        """Every parseable entry (torn tails from a killed supervisor
-        are skipped, not fatal)."""
-        out = []
-        try:
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
-                    try:
-                        out.append(json.loads(line))
-                    except ValueError:
-                        continue
-        except OSError:
-            pass
-        return out
 
 
 # ----------------------------------------------------------------------

@@ -91,6 +91,26 @@ Sites (the action is part of the site name):
                     (reason=deadline) and its cache slot is freed for
                     refill at the NEXT decode step, never leaked
                     (``chainermn_tpu/serving/generate.py``)
+``swap_kill``       hard-kill (``os._exit(ARG or 44)``) the fleet
+                    controller at a weight-swap point of a rolling
+                    deployment -- occurrence 0 is the canary swap,
+                    occurrence k the k-th replica swap of the roll --
+                    leaving the fleet MID-ROLL with replicas on mixed
+                    parameter versions; a restarted fleet must
+                    converge every replica to one consistent version
+                    and record it in ``fleet_ledger.jsonl``
+                    (``chainermn_tpu/serving/fleet.py``)
+``serve_slow``      sleep ARG (default 0.05) seconds before each
+                    serve execution on an engine whose parameters
+                    were HOT-SWAPPED to a version other than the one
+                    it booted with -- models a latency regression
+                    shipped by a roll: in an A/B fleet only the
+                    canary replica slows down, the incumbents (still
+                    at their boot version) never consult the rule,
+                    and a rollback (swap back to the boot version)
+                    restores full speed.  The canary gate's
+                    breach-then-rollback scenario is driven by
+                    exactly this site
 ==================  ====================================================
 
 Example -- drop the first publish, delay half the rest, stall the
@@ -112,7 +132,7 @@ ENV_VAR = 'CHAINERMN_TPU_CHAOS'
 SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
          'nan_batch', 'sigterm_step', 'kill_step', 'hang_step',
          'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip',
-         'serve_burst', 'serve_cancel')
+         'serve_burst', 'serve_cancel', 'swap_kill', 'serve_slow')
 
 
 class InjectedFault(RuntimeError):
@@ -224,7 +244,7 @@ class FaultInjector:
                 telemetry.event('chaos:' + site, kind='chaos',
                                 occurrence=idx, arg=rule.arg)
                 if site in ('kill_step', 'kill_recv', 'ckpt_kill',
-                            'hang_step'):
+                            'hang_step', 'swap_kill'):
                     # os._exit skips atexit: flush the timeline AND
                     # drop the crash-safe flight record NOW, or the
                     # fatal injection is invisible post-mortem
@@ -442,6 +462,39 @@ def on_serve_submit():
     if r is None:
         return 0
     return max(1, int(r.arg) if r.arg is not None else 4)
+
+
+def on_swap(phase=None):
+    """``swap_kill``: hard-kill THIS process at a fleet weight-swap
+    point.  The fleet controller calls this immediately before each
+    replica swap of a roll (occurrence 0 = the canary swap), so a
+    fired site leaves the fleet mid-roll with replicas on MIXED
+    parameter versions -- the exact wreckage the restart-convergence
+    contract (one consistent version, recorded in the ledger) must
+    clean up.  ``phase`` is advisory (span labeling by the caller);
+    the occurrence counter, not the phase, decides firing."""
+    inj = _active
+    if inj is None:
+        return
+    r = inj.fires('swap_kill')
+    if r is not None:
+        os._exit(int(r.arg) if r.arg is not None else 44)
+    del phase
+
+
+def on_serve_slow(swapped):
+    """``serve_slow``: sleep before one serve execution, but ONLY on
+    an engine serving a hot-swapped parameter version (``swapped``
+    True: ``param_version != `` the version the engine booted with).
+    Engines at their boot version never consult the rule -- which is
+    what lets one process-wide spec slow exactly the canary replica
+    of an in-process A/B fleet, and lets a rollback restore speed."""
+    inj = _active
+    if inj is None or not swapped:
+        return
+    r = inj.fires('serve_slow')
+    if r is not None:
+        time.sleep(r.arg if r.arg is not None else 0.05)
 
 
 def on_serve_cancel():

@@ -156,6 +156,23 @@ class OverloadError(CommFailure):
         self.queue_depth = queue_depth
 
 
+class WeightSwapError(RuntimeError):
+    """A live weight hot-swap was REFUSED or failed validation before
+    cutover: the engine still holds (and keeps serving) its previous
+    parameter version.  Raised by ``swap_params`` when the new tree
+    produces non-finite outputs on the validation forward, or when a
+    generation engine is asked to swap with sequences still in flight
+    (mid-sequence weight changes would corrupt the KV cache the
+    in-flight sequences already banked).  The fleet records the
+    refusal in ``fleet_ledger.jsonl`` and keeps routing to the
+    incumbent -- a failed swap never takes a replica down."""
+
+    def __init__(self, message, version=None):
+        _flight_dump('weight_swap_failed', version=version)
+        super().__init__(message)
+        self.version = version
+
+
 class CheckpointSkippedWarning(UserWarning):
     """Emitted (via ``warnings.warn``) each time ``auto_resume`` skips
     a corrupt or incomplete snapshot while walking the chain

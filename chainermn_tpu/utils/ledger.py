@@ -1,0 +1,74 @@
+"""Append-only fsynced JSONL ledgers -- the machine-readable record a
+dead control loop leaves behind.
+
+Two control planes write one: the training supervisor
+(``supervisor_ledger.jsonl``, PR 9 -- spawn/watch/classify/decide/
+resume events) and the serving fleet (``fleet_ledger.jsonl``,
+ISSUE 13 -- version_seen/roll_start/replica_swap/canary_verdict/
+promote/rollback/converged events).  Both need the identical
+contract, so it lives here once:
+
+- **Append-only, fsynced.**  One JSON object per line; every append
+  flushes AND fsyncs before returning, so an entry that was written
+  survives the writer dying the next instant (``os._exit`` from a
+  chaos kill site included).  The entry order IS the event order.
+- **Tolerant read.**  :meth:`Ledger.read` returns every parseable
+  line and silently skips a torn tail -- the footprint of a writer
+  killed mid-append.  A reader never crashes on the artifact of the
+  exact failure the ledger exists to document.
+- **Self-describing entries.**  Every entry carries ``event`` (the
+  type) and ``t`` (wall-clock seconds, for humans and MTTR
+  arithmetic); everything else is the writer's schema.
+
+The schemas themselves are documented where they are written:
+``docs/fault_tolerance.md`` (supervisor) and ``docs/serving.md``
+(fleet, "Continuous deployment").
+"""
+
+import json
+import os
+import time
+
+
+class Ledger:
+    """Append-only JSONL event log: one JSON object per line,
+    fsynced per append (see module docstring)."""
+
+    def __init__(self, path):
+        self.path = path
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+
+    def append(self, event, **fields):
+        rec = dict(fields, event=event, t=round(time.time(), 3))
+        with open(self.path, 'a') as f:
+            f.write(json.dumps(rec, default=repr, sort_keys=True)
+                    + '\n')
+            f.flush()
+            os.fsync(f.fileno())
+        return rec
+
+    @staticmethod
+    def read(path):
+        """Every parseable entry (torn tails from a killed writer
+        are skipped, not fatal; a missing file reads as empty)."""
+        out = []
+        try:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue
+        except OSError:
+            pass
+        return out
+
+
+def events(entries, kind):
+    """The entries of one event type, in ledger order -- the shared
+    assertion helper the supervisor and fleet test suites both use."""
+    return [e for e in entries if e.get('event') == kind]

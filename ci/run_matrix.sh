@@ -161,6 +161,28 @@ print('slo smoke OK: verdict=%s (row %s), %d requests traced, worst '
 PY
 rm -rf "${SLO_DIR}"
 
+# FLEET LEG (ISSUE 13 acceptance): train-to-serve continuous
+# deployment proved end to end over REAL subprocess replicas -- one
+# `python -m chainermn_tpu.serving.fleet` invocation per scenario,
+# every verdict asserted from fleet_ledger.jsonl.  (1) promote: a
+# few real CPU sgd steps -> manifest-tagged snapshot -> a 2-replica
+# fleet picks it up and rolls it under open-loop traffic, canary ok,
+# promote -- with ZERO requests shed (per-swap shed counters AND the
+# traffic totals both zero: the roll is invisible to clients);
+# (2) canary breach -> rollback: the replica chaos handout ships a
+# serve_slow latency regression that bites only on a hot-swapped
+# version, the judge breaches on the inter-token delta vs the
+# incumbent's matched window, the canary swaps back, the fleet
+# converges on the incumbent; (3) swap_kill mid-roll: the controller
+# dies at a swap point with replicas on MIXED versions, and a
+# relaunch over the same --out converges every replica to one
+# consistent version, recording `converged` with the recovered roll
+# named.  Slow-marked; the fast in-process halves run in tier-1
+# (tests/test_fleet.py).  See docs/serving.md "Continuous
+# deployment".
+echo "=== fleet leg: roll->promote, canary breach->rollback, swap_kill convergence ==="
+python -m pytest tests/test_fleet_mp.py -q --runslow
+
 # REAL-DATA convergence gate (VERDICT r4 next #8): the same positive
 # gate, fed genuine handwritten digits (sklearn's vendored UCI scans,
 # no egress) through the CHAINERMN_TPU_MNIST hook -- the reference's

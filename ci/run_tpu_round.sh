@@ -322,6 +322,14 @@ run bench_serve_resnet50_int8 $QT python bench.py --serve --quick --int8
 run bench_serve_generate $QT python bench.py --serve --generate --quick
 run bench_serve_generate_int8kv $QT python bench.py --serve --generate --quick --int8-kv
 
+# continuous deployment (ISSUE 13): how fast weights roll through a
+# 2-replica serving fleet under live traffic -- rolls/minute with
+# the contract sidecars (dropped_during_swap MUST be 0, per-replica
+# out-of-rotation downtime p50/p99, promote/rollback outcomes from
+# fleet_ledger.jsonl).  Queued after the generate arms: same
+# new-family-never-starves-the-headline reasoning.
+run bench_serve_fleet $QT python bench.py --serve --fleet --quick
+
 # --- tier 4: the remaining BASELINE workloads ------------------------
 # seq2seq FIRST: it is the variable-shape allreduce configuration
 # (VERDICT #4) -- the datum no other workload stands in for -- and

@@ -759,3 +759,50 @@ def test_nan_guard_divergence_checkpoint_via_chaos(tmp_path):
         guard.divergence_checkpoint,
         serializers.updater_state(upd))
     assert int(state['iteration']) == 3
+
+
+# ----------------------------------------------------------------------
+# fleet sites (ISSUE 13): swap_kill + serve_slow
+
+
+def test_swap_kill_fires_at_its_occurrence(monkeypatch):
+    exits = []
+    monkeypatch.setattr(chaos.os, '_exit',
+                        lambda code: exits.append(code))
+    chaos.install(chaos.FaultInjector('swap_kill=@1:44'))
+    try:
+        chaos.on_swap()            # occurrence 0: survives
+        assert exits == []
+        chaos.on_swap(phase='roll')   # occurrence 1: dies rc 44
+        assert exits == [44]
+        chaos.on_swap()            # one-shot: never re-fires
+        assert exits == [44]
+    finally:
+        chaos.uninstall()
+
+
+def test_serve_slow_only_bites_swapped_versions(monkeypatch):
+    slept = []
+    monkeypatch.setattr(chaos.time, 'sleep',
+                        lambda s: slept.append(s))
+    chaos.install(chaos.FaultInjector('serve_slow=*:0.2'))
+    try:
+        chaos.on_serve_slow(False)   # boot version: never consulted
+        assert slept == []
+        chaos.on_serve_slow(True)    # hot-swapped version: slows
+        assert slept == [0.2]
+        chaos.on_serve_slow(False)
+        assert slept == [0.2]
+    finally:
+        chaos.uninstall()
+
+
+def test_new_sites_in_spec_grammar():
+    seed, rank, rules = chaos.parse_spec(
+        'swap_kill=@1:44;serve_slow=*:0.1')
+    assert rules['swap_kill'].at == frozenset({1})
+    assert rules['swap_kill'].arg == 44
+    assert rules['serve_slow'].always
+    # strip_sites (the supervisor/fleet consumed-fault accounting)
+    assert chaos.strip_sites('swap_kill=@1;serve_slow=*:0.1',
+                             ['swap_kill']) == 'serve_slow=*:0.1'

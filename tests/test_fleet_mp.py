@@ -1,0 +1,167 @@
+"""Fleet end-to-end over REAL subprocess replicas (ISSUE 13
+acceptance).  One ``python -m chainermn_tpu.serving.fleet``
+invocation per scenario -- the controller trains the demo LM for real
+CPU sgd steps, snapshots with the manifest discipline, boots N
+replica worker processes, serves open-loop traffic through the
+canary-routing front, and rolls each new snapshot -- with every
+verdict asserted from ``fleet_ledger.jsonl``:
+
+- **promote**: a healthy snapshot rolls canary -> promote with ZERO
+  requests shed (none attributable to the swaps, none at all), both
+  replica swaps ledgered ok;
+- **canary breach -> rollback**: the replica handout ships a
+  ``serve_slow`` latency regression that bites only on a hot-swapped
+  version; the judge breaches on the inter-token delta vs the
+  incumbent and the fleet rolls back, still serving everything;
+- **swap_kill mid-roll -> restart convergence**: the controller dies
+  at a swap point (occurrence 1 = first promote swap, canary already
+  on the new version); a relaunch over the same ``--out`` converges
+  every replica to ONE consistent version (the newest valid
+  snapshot) and records ``converged`` naming the recovered roll.
+
+Slow-marked: ``ci/run_matrix.sh`` runs this file in its fleet leg.
+The fast in-process halves are ``tests/test_fleet.py``.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from chainermn_tpu.serving.fleet import LEDGER_NAME
+from chainermn_tpu.utils.ledger import Ledger, events
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+FAST_FLAGS = ['--replicas', '2', '--rate', '25', '--debounce', '0.2',
+              '--duration', '1', '--boot-steps', '2',
+              '--steps-per-roll', '2', '--roll-timeout', '240']
+
+
+def _run_fleet(out, args, chaos=None, timeout=420):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('XLA_FLAGS', 'JAX_PLATFORMS',
+                        'CHAINERMN_TPU_CHAOS',
+                        'CHAINERMN_TPU_TELEMETRY')}
+    env['PYTHONPATH'] = ROOT + os.pathsep + env.get('PYTHONPATH', '')
+    if chaos:
+        env['CHAINERMN_TPU_CHAOS'] = chaos
+    proc = subprocess.run(
+        [sys.executable, '-m', 'chainermn_tpu.serving.fleet',
+         '--out', str(out)] + FAST_FLAGS + args,
+        env=env, capture_output=True, text=True, timeout=timeout)
+    ledger = Ledger.read(os.path.join(str(out), LEDGER_NAME))
+    return proc, ledger
+
+
+def _summary(proc):
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise AssertionError('no summary JSON in output:\n%s\n%s'
+                         % (proc.stdout, proc.stderr))
+
+
+@pytest.mark.slow
+def test_roll_promotes_under_live_traffic_zero_sheds(tmp_path):
+    out = tmp_path / 'run'
+    proc, ledger = _run_fleet(
+        out, ['--rolls', '1', '--canary-seconds', '2.5'])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = _summary(proc)
+
+    # the ladder, in order, one roll: boot at 2, promote 4
+    names = [e['event'] for e in ledger]
+    assert names == ['start', 'version_seen', 'roll_start',
+                     'replica_swap', 'canary_verdict',
+                     'replica_swap', 'promote', 'converged',
+                     'complete']
+    swaps = events(ledger, 'replica_swap')
+    assert {s['replica'] for s in swaps} == {'replica-0',
+                                             'replica-1'}
+    # ZERO sheds attributable to the swaps (per-swap counters) AND
+    # zero sheds overall (front + traffic counters): the roll was
+    # invisible to clients
+    assert all(s['ok'] and s['shed_during_swap'] == 0 for s in swaps)
+    assert all(s['drained'] for s in swaps)
+    comp = events(ledger, 'complete')[0]
+    assert comp['promotes'] == 1 and comp['rollbacks'] == 0
+    assert comp['dropped_during_swap'] == 0
+    traffic = comp['traffic']
+    assert traffic['served'] > 0
+    assert traffic['served'] == traffic['offered']
+    assert traffic['shed_submit'] == traffic['shed_result'] == 0
+    assert summary['version'] == 4
+    conv = events(ledger, 'converged')[0]
+    assert conv['version'] == 4
+    assert set(conv['replicas'].values()) == {4}
+
+
+@pytest.mark.slow
+def test_serve_slow_canary_breach_rolls_back(tmp_path):
+    out = tmp_path / 'run'
+    proc, ledger = _run_fleet(
+        out, ['--rolls', '1', '--canary-seconds', '5',
+              '--latency-floor-ms', '20', '--min-events', '4',
+              '--replica-chaos', 'serve_slow=*:0.12'])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    cv = events(ledger, 'canary_verdict')
+    assert len(cv) == 1
+    assert cv[0]['verdict'] == 'breach'
+    assert any('intertoken_p99' in r for r in cv[0]['reasons'])
+    rbs = events(ledger, 'rollback')
+    assert len(rbs) == 1
+    assert rbs[0]['version'] == 4 and rbs[0]['to_version'] == 2
+    assert not events(ledger, 'promote')
+    # the rollback swap is ledgered like any other, and sheds nothing
+    swaps = events(ledger, 'replica_swap')
+    assert len(swaps) == 2          # canary out, canary back
+    assert all(s['replica'] == 'replica-0' for s in swaps)
+    assert swaps[1]['rollback'] and swaps[1]['to_version'] == 2
+    assert all(s['shed_during_swap'] == 0 for s in swaps)
+    conv = events(ledger, 'converged')[0]
+    assert conv['version'] == 2
+    assert set(conv['replicas'].values()) == {2}
+    comp = events(ledger, 'complete')[0]
+    assert comp['rollbacks'] == 1
+    assert comp['traffic']['served'] > 0
+    assert comp['traffic']['shed_submit'] == 0
+    assert comp['traffic']['shed_result'] == 0
+
+
+@pytest.mark.slow
+def test_swap_kill_mid_roll_converges_on_restart(tmp_path):
+    out = tmp_path / 'run'
+    # occurrence 0 = the canary swap (survives), occurrence 1 = the
+    # first promote swap: the controller dies with the canary ON the
+    # new version and the incumbent still on the old one
+    proc, ledger = _run_fleet(
+        out, ['--rolls', '1', '--canary-seconds', '2'],
+        chaos='swap_kill=@1:44')
+    assert proc.returncode == 44, proc.stdout + proc.stderr
+    names = [e['event'] for e in ledger]
+    assert names == ['start', 'version_seen', 'roll_start',
+                     'replica_swap', 'canary_verdict']
+    assert events(ledger, 'replica_swap')[0]['to_version'] == 4
+    assert not events(ledger, 'promote')
+    assert not events(ledger, 'converged')
+
+    # relaunch over the same --out, no training: every replica boots
+    # from the newest VALID snapshot and the ledger records the
+    # reconciliation naming the roll it recovered from
+    proc2, ledger2 = _run_fleet(out, ['--rolls', '0'])
+    assert proc2.returncode == 0, proc2.stdout + proc2.stderr
+    conv = events(ledger2, 'converged')
+    assert len(conv) == 1
+    assert conv[0]['version'] == 4
+    assert conv[0]['recovered_roll'] == 4
+    assert set(conv[0]['replicas'].values()) == {4}
+    starts = events(ledger2, 'start')
+    assert starts[-1]['version'] == 4
+    comp = events(ledger2, 'complete')[-1]
+    assert comp['traffic']['served'] > 0   # converged fleet serves

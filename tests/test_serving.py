@@ -1186,3 +1186,121 @@ class TestServeForwardLintTarget:
         names = [t.name for t in targets.step_targets(
             include_resnet50=False)]
         assert 'step:serve_forward' in names
+
+
+# ---------------------------------------------------------------------
+# live weight hot-swap (ISSUE 13): the fleet's per-replica primitive
+
+
+class TestWeightSwap:
+    def test_swap_no_retrace_and_output_changes(self):
+        model, params, apply_fn, item = _mlp_setup()
+        eng = InferenceEngine(apply_fn, params, item, max_batch=4,
+                              label='rep-0', version=3)
+        eng.warmup()
+        x = np.random.RandomState(0).rand(4, 48).astype(np.float32)
+        y1 = np.asarray(eng.infer(x))
+        traces = eng.trace_count
+        scaled = jax.tree_util.tree_map(lambda a: a * 1.5, params)
+        assert eng.swap_params(scaled, version=7) == 7
+        y2 = np.asarray(eng.infer(x))
+        # shape-keyed executables: the swap never retraces, and the
+        # new weights demonstrably serve
+        assert eng.trace_count == traces
+        assert eng.param_version == 7
+        assert not np.allclose(y1, y2)
+
+    def test_swap_nonfinite_refused_typed_incumbent_serves(self):
+        from chainermn_tpu.utils.failure import WeightSwapError
+        model, params, apply_fn, item = _mlp_setup()
+        eng = InferenceEngine(apply_fn, params, item, max_batch=2)
+        eng.warmup()
+        x = np.random.RandomState(0).rand(2, 48).astype(np.float32)
+        y1 = np.asarray(eng.infer(x))
+        poisoned = jax.tree_util.tree_map(
+            lambda a: np.full_like(np.asarray(a), np.nan), params)
+        with pytest.raises(WeightSwapError):
+            eng.swap_params(poisoned, version=9)
+        # validation failed BEFORE cutover: version and outputs intact
+        assert eng.param_version == 0
+        np.testing.assert_allclose(np.asarray(eng.infer(x)), y1)
+
+    def test_swap_from_checkpoint_roundtrip(self, tmp_path):
+        from chainermn_tpu import serializers
+        model, params, apply_fn, item = _mlp_setup()
+        eng = InferenceEngine(apply_fn, params, item, max_batch=2)
+        eng.warmup()
+        scaled = jax.tree_util.tree_map(
+            lambda a: np.asarray(a) * 2.0, params)
+        path = serializers.save_npz(str(tmp_path / 'snapshot_iter_8'),
+                                    {'params': scaled})
+        assert eng.swap_from_checkpoint(path, version=8) == 8
+        x = np.random.RandomState(1).rand(2, 48).astype(np.float32)
+        ref = model.apply({'params': scaled}, jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(eng.infer(x)),
+                                   np.asarray(ref), rtol=1e-5)
+
+    def test_generation_swap_refused_while_slots_live(self):
+        from chainermn_tpu.serving.generate import (GenerationEngine,
+                                                    GenerationQueue)
+        from chainermn_tpu.utils.failure import WeightSwapError
+        model, params = _tiny_lm()
+        eng = GenerationEngine(model, params, n_slots=2,
+                               max_prompt_len=4)
+        eng.warmup()
+        q = GenerationQueue(4)
+        q.submit([1, 2], 8)
+        eng.step(q)   # prompt admitted: a live slot now holds KV
+        assert eng._slots
+        with pytest.raises(WeightSwapError):
+            eng.swap_params(params, version=5)
+        assert eng.param_version == 0
+        # drain (finish the sequence), then the swap goes through
+        # with a FLAT decode trace count -- the roll's no-retrace pin
+        while eng._slots:
+            eng.step(q)
+        traces = eng.decode_trace_count
+        scaled = jax.tree_util.tree_map(lambda a: a * 1.01, params)
+        assert eng.swap_params(scaled, version=5) == 5
+        req = q.submit([3, 1], 4)
+        while not req.done():
+            eng.step(q)
+        assert len(req.result(timeout=5)) == 4
+        assert eng.decode_trace_count == traces
+
+    def test_request_id_passthrough_both_queues(self):
+        from chainermn_tpu.serving.generate import GenerationQueue
+        q = RequestQueue(max_batch=4)
+        assert q.submit(np.zeros((1, 3), np.float32),
+                        request_id='r777').request_id == 'r777'
+        g = GenerationQueue(8)
+        assert g.submit([1], 2,
+                        request_id='r778').request_id == 'r778'
+
+    def test_version_labels_on_serve_records(self):
+        from chainermn_tpu import telemetry
+        model, params, apply_fn, item = _mlp_setup()
+        eng = InferenceEngine(apply_fn, params, item, max_batch=2,
+                              label='rep-7', version=4)
+        eng.warmup()
+        installed = telemetry.active() is None
+        if installed:
+            telemetry.enable()
+        try:
+            q = RequestQueue(max_batch=2, max_wait=0.001,
+                             label='rep-7')
+            req = q.submit(np.zeros((1, 48), np.float32))
+            for pb in q.take(timeout=1.0):
+                eng.serve_packed(pb)
+            req.result(timeout=5)
+            recs = [r for r in list(telemetry.active().events)
+                    if r.get('replica') == 'rep-7']
+            assert recs, 'no replica-labeled records'
+            assert {r.get('version') for r in recs} == {4}
+            stages = {r.get('name') for r in recs
+                      if r.get('kind') == 'request'}
+            assert {'queue_wait', 'bucket_pack',
+                    'execute'} <= stages
+        finally:
+            if installed:
+                telemetry.disable()

@@ -13,6 +13,7 @@ import os
 import pytest
 
 from chainermn_tpu import telemetry
+from chainermn_tpu.telemetry import slo
 from chainermn_tpu.telemetry.__main__ import main as telemetry_main
 from chainermn_tpu.telemetry.slo import (SLO, SLOMonitor,
                                          WindowedCounter,
@@ -414,3 +415,73 @@ class TestSLOMonitorLive:
         value = res['slos']['toks']['fast']['value']
         assert value == pytest.approx(8 / 1.76, rel=0.3)
         assert res['slos']['toks']['verdict'] == 'ok'
+
+
+# ---------------------------------------------------------------------
+# fleet additions (ISSUE 13): record filtering + batch-path latency
+
+
+class TestRecordFilter:
+    def test_filter_partitions_one_stream(self):
+        a = slo.SLOMonitor(
+            record_filter=lambda r: r.get('replica') == 'a')
+        b = slo.SLOMonitor(
+            record_filter=lambda r: r.get('replica') == 'b')
+        rec = {'type': 'span', 'kind': 'request', 'name': 'prefill',
+               'request_id': 'r1', 't0': 1.0, 't1': 1.5,
+               'replica': 'a', 'version': 4}
+        for mon in (a, b):
+            mon.ingest(dict(rec))
+        assert a.n_ingested == 1 and b.n_ingested == 0
+
+    def test_version_filter_isolates_post_swap_window(self):
+        # one replica, two versions: a monitor created at swap time
+        # with a version filter sees only post-swap traffic
+        mon = slo.SLOMonitor(
+            record_filter=lambda r: r.get('version') == 5)
+        for t, v in ((1.0, 4), (2.0, 5), (3.0, 5)):
+            mon.ingest({'type': 'span', 'kind': 'request',
+                        'name': 'decode', 'request_id': 'r1',
+                        't0': t - 0.01, 't1': t, 'version': v})
+        assert mon.n_ingested == 2
+        assert mon.intertoken.total_count() == 2
+
+
+class TestBatchLatencyMetric:
+    def _exec_records(self, lat_s, n, t0=10.0):
+        out = []
+        for i in range(n):
+            t = t0 + i
+            rid = 'r%d' % i
+            out.append({'type': 'span', 'kind': 'request',
+                        'name': 'queue_wait', 'request_id': rid,
+                        't0': t, 't1': t + 0.001})
+            out.append({'type': 'span', 'kind': 'request',
+                        'name': 'execute', 'request_id': rid,
+                        't0': t + 0.001, 't1': t + lat_s})
+            out.append({'type': 'event', 'kind': 'request',
+                        'name': 'complete', 'request_id': rid,
+                        't': t + lat_s})
+        return out
+
+    def test_execute_spans_feed_latency_slo(self):
+        slos = slo.default_slos(latency_s=0.05)
+        mon = slo.SLOMonitor(slos=slos)
+        for rec in self._exec_records(0.2, 12):
+            mon.ingest(rec)
+        result = mon.evaluate()
+        row = result['slos']['latency_p99']
+        # e2e = admission stamp -> execute end, judged as a latency
+        # SLO: every sample over the 50 ms target burns the budget
+        assert row['kind'] == 'latency'
+        assert row['fast']['count'] == 12
+        assert row['verdict'] == 'breach'
+        assert 'latency_p99' in result['verdict']['breaches']
+
+    def test_fast_batch_latency_is_ok(self):
+        mon = slo.SLOMonitor(slos=slo.default_slos(latency_s=0.5))
+        for rec in self._exec_records(0.01, 12):
+            mon.ingest(rec)
+        row = mon.evaluate()['slos']['latency_p99']
+        assert row['verdict'] == 'ok'
+        assert abs(row['fast']['p99'] - 0.009) < 0.01

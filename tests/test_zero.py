@@ -99,28 +99,44 @@ def test_shard_helpers_roundtrip():
 
 def test_zero_snapshot_resume(tmp_path):
     """Snapshot/resume restores the ZeRO state SHARDED, not
-    replicated, and training continues on the same trajectory."""
-    from chainermn_tpu import serializers
-    upd = _setup((2, 4), zero=True, opt=optax.sgd(0.1, momentum=0.9))
-    for _ in range(3):
-        upd.update()
-    path = serializers.save_npz(
-        str(tmp_path / 'snap'),
-        {'params': upd.params, 'opt_state': upd.opt_state,
-         'iteration': upd.iteration, 'epoch': upd.epoch})
-    ref_losses = [upd.update()['loss'] for _ in range(2)]
+    replicated, and training continues on the same trajectory.
 
-    upd2 = _setup((2, 4), zero=True, opt=optax.sgd(0.1, momentum=0.9))
-    upd2.update()  # compile + broadcast; then overwrite with snapshot
-    serializers.resume_updater(path, upd2, upd2.comm)
-    assert upd2.iteration == 3
-    leaves = [leaf for leaf in
-              jax.tree_util.tree_leaves(upd2.opt_state)
-              if getattr(leaf, 'ndim', 0) >= 1]
-    assert all(not leaf.sharding.is_fully_replicated
-               for leaf in leaves)
-    got = [upd2.update()['loss'] for _ in range(2)]
-    np.testing.assert_allclose(got, ref_losses, atol=1e-6)
+    DEFLAKE (ISSUE 13 satellite): this container intermittently
+    SIGABRTs inside this scenario's jitted resume step -- reproduced
+    on the unmodified seed commit, passes on re-run; an environmental
+    flake of the image's XLA CPU build that used to kill the ENTIRE
+    tier-1 pytest process.  A SIGABRT cannot be caught in-process, so
+    the scenario body now runs in a subprocess
+    (``tests/zero_resume_worker.py``, byte-for-byte the old test
+    body) with a single documented retry on SIGNAL deaths ONLY: a
+    negative returncode (rc -6 = SIGABRT) earns one re-run; an
+    ordinary failure (rc > 0, e.g. a trajectory mismatch) fails
+    immediately with the worker's traceback -- real regressions are
+    never retried away."""
+    import os
+    import subprocess
+    import sys
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          'zero_resume_worker.py')
+    env = {k: v for k, v in os.environ.items()
+           if k not in ('JAX_PLATFORMS', 'XLA_FLAGS')}
+    proc = None
+    for attempt in range(2):
+        proc = subprocess.run(
+            [sys.executable, worker, str(tmp_path)], env=env,
+            capture_output=True, text=True, timeout=300)
+        if proc.returncode == 0:
+            return
+        if proc.returncode > 0:
+            break   # genuine failure: no retry
+        # signal death (negative rc): the known environmental SIGABRT
+        print('zero_resume_worker died with signal rc %d on attempt '
+              '%d; retrying once (known container flake)'
+              % (proc.returncode, attempt), file=sys.stderr)
+    raise AssertionError(
+        'zero_resume_worker rc %d\n--- stdout ---\n%s\n--- stderr '
+        '---\n%s' % (proc.returncode, proc.stdout[-2000:],
+                     proc.stderr[-2000:]))
 
 
 def test_zero_cost_analysis():
