@@ -74,6 +74,10 @@ when a banked MFU-sweep artifact crowns a faster one; see
 ``--tp N`` (transformer only: composed dp x tp MeshPlan arm -- rows
 carry ``tp``/``mesh``/per-axis collective bytes and the PERF.md
 90-115k tok/s/chip anchor; ``docs/mesh_parallelism.md``),
+``--pp K`` (transformer only, composes with ``--tp``: the 3-D
+dp x tp x pp MeshPlan arm -- stage-sliced transformer trained 1F1B
+through the unified ``MeshPipelineUpdater``; rows add
+``pp``/``n_microbatches``/``bubble_fraction``),
 ``--donate`` (resnet50 only: donation + remat headline arm -- how
 real training runs; PERF.md knob #6),
 ``--serve`` (open-loop serving arm over
@@ -728,7 +732,7 @@ def build_seq2seq(quick, on_cpu, per_dev_override=None, policy=None):
 
 
 def build_transformer(quick, on_cpu, per_dev_override=None,
-                      policy=None, tp=None):
+                      policy=None, tp=None, pp=None):
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -743,6 +747,12 @@ def build_transformer(quick, on_cpu, per_dev_override=None,
             512, 8, 6, 1024, 32000, 8
     per_dev = per_dev_override or per_dev
     batch = per_dev * jax.device_count()
+    if pp:
+        return _build_transformer_pp(
+            quick, on_cpu, d_model, n_heads, n_layers, seq, vocab,
+            batch, policy=policy, tp=tp, pp=pp,
+            anchor_config_match=bool(not on_cpu
+                                     and per_dev_override is None))
     plan = comm = specs = None
     tp_kw = {}
     if tp:
@@ -808,6 +818,115 @@ def build_transformer(quick, on_cpu, per_dev_override=None,
     if tp:
         out['tp'] = int(plan.model_size)
         out['mesh'] = plan.describe()
+    return out
+
+
+def _pipeline_scan_maker(upd, arrays):
+    """Scan maker for the pipeline updaters: k 1F1B steps back to
+    back inside ONE outer jit over the raw (unjitted) step, carrying
+    (params, extra, opt_state) -- the pipeline twin of
+    ``_scan_maker``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    step = upd._raw_step
+    p0, e0, o0 = upd.params, upd.extra, upd.opt_state
+
+    def make(k):
+        @jax.jit
+        def run():
+            def body(carry, i):
+                p, e, o = carry
+                p, e, o, metrics = step(p, e, o, *arrays)
+                return (p, e, o), metrics['loss']
+
+            _, losses = lax.scan(body, (p0, e0, o0), jnp.arange(k))
+            return losses
+
+        return run
+
+    return make
+
+
+def _build_transformer_pp(quick, on_cpu, d_model, n_heads, n_layers,
+                          seq, vocab, batch, policy=None, tp=None,
+                          pp=2, anchor_config_match=False):
+    """``--pp K`` arm: the stage-sliced ``TransformerLM`` trained
+    1F1B through the unified :class:`chainermn_tpu.training.
+    MeshPipelineUpdater` on a 3-D ``(data, model, pipe)`` MeshPlan
+    (``docs/mesh_parallelism.md``).  The stage count clamps to the
+    largest value <= K that both divides ``n_layers`` and survives
+    the plan's shape-only mesh degradation; rows carry ``pp`` /
+    ``n_microbatches`` / ``bubble_fraction`` (the static schedule
+    cost) next to the usual anchor fields."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from chainermn_tpu import training
+    from chainermn_tpu.models import (TransformerLM, pipeline_parts,
+                                      pipeline_stage_specs)
+    from chainermn_tpu.parallel.meshplan import MeshPlan
+    from chainermn_tpu.parallel.pipeline import bubble_fraction
+
+    plan = None
+    for p in range(min(int(pp), n_layers), 0, -1):
+        if n_layers % p:
+            continue
+        cand = MeshPlan.create(tp=tp or 1, pp=p)
+        if cand.pipe_size == p:
+            plan = cand
+            break
+    n_stages = plan.pipe_size
+    tp_axis = plan.model_axis if plan.model_size > 1 else None
+    model = TransformerLM(vocab_size=vocab, d_model=d_model,
+                          n_heads=n_heads, n_layers=n_layers,
+                          d_ff=4 * d_model, max_len=seq)
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    tgts = rng.randint(0, vocab, (batch, seq)).astype(np.int32)
+    params = init_on_host(
+        model.init, jax.random.PRNGKey(0),
+        jnp.zeros((1, seq), jnp.int32))['params']
+    stage_fn, prologue, loss_on_last, stacked, extra = pipeline_parts(
+        model, params, n_stages=n_stages, local_loss=True,
+        tp_axis=tp_axis)
+    specs = pipeline_stage_specs(stacked, pipe_axis=plan.pipe_axis,
+                                 tp_axis=tp_axis)
+    per_replica = batch // plan.data_size
+    n_micro = next(m for m in (8, 4, 2, 1) if per_replica % m == 0)
+    pol = _resolve_policy(policy)
+    upd = training.MeshPipelineUpdater(
+        iter([]), optax.adam(1e-3), stage_fn, loss_on_last, stacked,
+        plan, n_micro=n_micro, prologue=prologue, extra_params=extra,
+        param_specs=specs, policy=pol, donate=False)
+    arrays = upd.shard_batch([(toks[i], tgts[i])
+                              for i in range(batch)])
+    tokens = batch * seq
+    ff = 4 * d_model
+    per_tok_fwd = n_layers * (
+        8.0 * d_model ** 2 + 2.0 * 2.0 * d_model * ff
+        + 2.0 * 2.0 * seq * d_model / 2.0) + 2.0 * d_model * vocab
+    flops = 3.0 * per_tok_fwd * tokens
+    base = BASELINE_IMG_PER_SEC_PER_CHIP * 4.1e9 * 3.0 / (
+        flops / tokens)
+    out = dict(make=_pipeline_scan_maker(upd, arrays), upd=upd,
+               arrays=arrays, items=tokens, analytic_flops=flops,
+               baseline=base, policy=_policy_row(pol),
+               baseline_derivation='resnet50 baseline converted to '
+               'tokens/sec via analytic flops per item',
+               anchor_tok_s_per_chip=[90000.0, 115000.0],
+               anchor_source='PERF.md: d512/L6/seq1024/V32k @ '
+               '30-40%% MFU of 197 TF/s',
+               anchor_config_match=anchor_config_match,
+               pp=int(plan.pipe_size), n_microbatches=int(n_micro),
+               bubble_fraction=round(
+                   bubble_fraction(n_micro, n_stages), 6),
+               mesh=plan.describe())
+    if tp:
+        out['tp'] = int(plan.model_size)
     return out
 
 
@@ -1025,14 +1144,16 @@ def measure(argv):
     policy_name = parse_policy(argv, model_name)
     fused_norm = parse_fused_norm(argv, model_name)
     tp = parse_tp(argv, model_name)
+    pp = parse_pp(argv, model_name)
     donate = parse_donate(argv, model_name)
-    _log('building %s%s%s%s%s%s%s' % (
+    _log('building %s%s%s%s%s%s%s%s' % (
         model_name,
         ' (per-device batch %d)' % per_dev if per_dev else '',
         ' (s2d stem)' if s2d else '',
         ' (policy %s)' % policy_name if policy_name else '',
         ' (fused norm)' if fused_norm else '',
         ' (tp %d)' % tp if tp else '',
+        ' (pp %d)' % pp if pp else '',
         ' (donate+remat)' if donate else ''))
     extra_kw = {}
     if s2d:
@@ -1043,6 +1164,8 @@ def measure(argv):
         extra_kw['fused_norm'] = True
     if tp:
         extra_kw['tp'] = tp
+    if pp:
+        extra_kw['pp'] = pp
     if donate:
         extra_kw['donate'] = True
     cfg = BUILDERS[model_name](quick, on_cpu, per_dev, **extra_kw)
@@ -1122,8 +1245,15 @@ def measure(argv):
         lo, hi = cfg['anchor_tok_s_per_chip']
         result['pct_of_anchor_mid'] = round(
             100.0 * per_chip / ((lo + hi) / 2.0), 1)
-    if cfg.get('tp'):
-        result['tp'] = cfg['tp']
+    if cfg.get('pp'):
+        # pipeline arm provenance: stage count, micro-batch count and
+        # the schedule's static bubble (docs/mesh_parallelism.md)
+        result['pp'] = cfg['pp']
+        result['n_microbatches'] = cfg['n_microbatches']
+        result['bubble_fraction'] = cfg['bubble_fraction']
+    if cfg.get('tp') or cfg.get('pp'):
+        if cfg.get('tp'):
+            result['tp'] = cfg['tp']
         result['mesh'] = cfg['mesh']
         try:
             # per-axis collective bytes of the traced per-device step
@@ -1393,6 +1523,34 @@ def parse_tp(argv, model):
         emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
                   error='bad_tp',
                   detail='--tp needs a positive integer, got %r'
+                  % (raw,)), rc=1)
+    return val
+
+
+def parse_pp(argv, model):
+    """``--pp K`` (transformer only): the pipeline-parallel MeshPlan
+    arm -- the stage-sliced transformer trained 1F1B through the
+    unified ``MeshPipelineUpdater`` on a 3-D ``(data, model, pipe)``
+    mesh (``docs/mesh_parallelism.md``); composes with ``--tp``.
+    Validated in the PARENT before the backend probe, like the other
+    flags."""
+    if '--pp' not in argv:
+        return None
+    if model != 'transformer':
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+                  error='bad_flag',
+                  detail='--pp (pipeline-parallel MeshPlan arm) '
+                  'applies to --model transformer only'), rc=1)
+    i = argv.index('--pp')
+    raw = argv[i + 1] if i + 1 < len(argv) else None
+    try:
+        val = int(raw)
+        if val <= 0:
+            raise ValueError
+    except (TypeError, ValueError):
+        emit(dict(metric_stub(model), value=0.0, vs_baseline=0.0,
+                  error='bad_pp',
+                  detail='--pp needs a positive integer, got %r'
                   % (raw,)), rc=1)
     return val
 
@@ -2462,6 +2620,7 @@ def main():
     parse_policy(argv, model)
     parse_fused_norm(argv, model)
     parse_tp(argv, model)
+    parse_pp(argv, model)
     parse_donate(argv, model)
     if '--child' in argv:
         measure([a for a in argv if a != '--child'])

@@ -389,6 +389,71 @@ def transformer_tp_step_target(policy=None, tp=2):
                            plan_axes=tuple(plan.mesh.axis_names))
 
 
+def _transformer_pp_updater(policy=None, tp=1, pp=2):
+    """Shared construction of the unified dp x tp x pp pipeline step
+    (``docs/mesh_parallelism.md``): a stage-sliced ``TransformerLM``
+    (``pipeline_parts``) trained 1F1B through
+    :class:`chainermn_tpu.training.MeshPipelineUpdater` on a 3-D
+    ``MeshPlan`` -- stage weights on their ``pipe`` coordinate,
+    optional Megatron sharding inside each stage."""
+    import optax
+    from chainermn_tpu import training
+    from chainermn_tpu.models import (TransformerLM, pipeline_parts,
+                                      pipeline_stage_specs)
+    from chainermn_tpu.parallel.meshplan import MeshPlan
+
+    plan = MeshPlan.create(tp=tp, pp=pp)
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, d_ff=64, max_len=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 16), jnp.int32))['params']
+    tp_axis = plan.model_axis if plan.model_size > 1 else None
+    stage_fn, prologue, loss_on_last, stacked, extra = pipeline_parts(
+        model, params, n_stages=plan.pipe_size, local_loss=True,
+        tp_axis=tp_axis)
+    specs = pipeline_stage_specs(stacked, pipe_axis=plan.pipe_axis,
+                                 tp_axis=tp_axis)
+    updater = training.MeshPipelineUpdater(
+        iter([]), optax.sgd(1e-2), stage_fn, loss_on_last, stacked,
+        plan, n_micro=2, prologue=prologue, extra_params=extra,
+        param_specs=specs, policy=policy)
+    n_seq = 2 * plan.data_size
+    batch = (jnp.zeros((n_seq, 16), jnp.int32),
+             jnp.zeros((n_seq, 16), jnp.int32))
+    return plan, updater, batch, n_seq
+
+
+def transformer_pp_step_target(policy=None, pp=2):
+    """The pipeline-parallel transformer step: dp x pp (tp = 1) with
+    the whole 1F1B ladder inside one jitted shard_map.  Declares
+    ``plan_axes=('data', 'model', 'pipe')`` so the SL010 family
+    audits the third axis -- the stage-boundary ``ppermute`` ring is
+    SL002-checked for free, the loss's last-stage data-mean must be
+    ONE multi-axis psum (SL011), and the size-1 model axis is exempt
+    from the dead-axis check."""
+    plan, updater, batch, n_seq = _transformer_pp_updater(
+        policy=policy, tp=1, pp=pp)
+    return _updater_target('step:transformer_pp', updater, batch,
+                           dict(plan.mesh.shape),
+                           compute_dtype='bfloat16',
+                           items=n_seq * 16,
+                           plan_axes=tuple(plan.mesh.axis_names))
+
+
+def transformer_tp_pp_step_target(policy=None, tp=2, pp=2):
+    """The fully composed dp x tp x pp step: Megatron psums inside
+    each stage (conjugate custom-vjp discipline), 1F1B ppermute
+    between stages, dp gradient pmean at the end -- every declared
+    plan axis combined by its own collective."""
+    plan, updater, batch, n_seq = _transformer_pp_updater(
+        policy=policy, tp=tp, pp=pp)
+    return _updater_target('step:transformer_tp_pp', updater, batch,
+                           dict(plan.mesh.shape),
+                           compute_dtype='bfloat16',
+                           items=n_seq * 16,
+                           plan_axes=tuple(plan.mesh.axis_names))
+
+
 def serve_forward_target(policy=None, tp=2, bucket=None):
     """The serving engine's forward-only apply over the MeshPlan
     (``docs/serving.md``): a tensor-parallel ``TransformerLM`` served
@@ -486,6 +551,8 @@ def step_targets(include_resnet50=True, policy=None):
            bucketed_overlap_step_target(policy=policy),
            pipeline_step_target(policy=policy),
            transformer_tp_step_target(policy=policy),
+           transformer_pp_step_target(policy=policy),
+           transformer_tp_pp_step_target(policy=policy),
            serve_forward_target(policy=policy),
            decode_forward_target(policy=policy)]
     if include_resnet50:

@@ -159,3 +159,30 @@ def divisor_leq(n, k):
     while n % k:
         k -= 1
     return k
+
+
+def divisors_leq(n, ks):
+    """:func:`divisor_leq` extended to N requested axis widths: each
+    requested ``k`` clamps (in the GIVEN priority order) to the
+    largest divisor of the devices still unclaimed, so the product of
+    the effective widths always divides ``n`` and the leading (data)
+    axis absorbs the remainder.
+
+    This is the 3-D graceful-degradation rule of
+    ``MeshPlan.create(tp=..., pp=...)``: ``ks=(tp, pp)`` -- tensor
+    parallelism has placement priority (it rides the tightest ICI
+    neighbors), the pipeline axis clamps within what remains, and
+    degenerate counts degrade SHAPE-ONLY -- 1 device -> ``(1, 1)``
+    effective widths (the (1, 1, 1) mesh), ``tp * pp > n`` clamps
+    both down, a prime remainder degrades the later axis to 1
+    (``divisors_leq(6, (2, 2)) == (2, 1)``: 3 devices left, no even
+    divisor).  Axis NAMES never change with the shape."""
+    if n < 1:
+        raise ValueError('need at least one device, got %d' % n)
+    remaining = n
+    out = []
+    for k in ks:
+        eff = divisor_leq(remaining, k)
+        out.append(eff)
+        remaining //= eff
+    return tuple(out)

@@ -22,8 +22,8 @@ from chainermn_tpu.models.resnet50 import (  # noqa
 from chainermn_tpu.models.seq2seq import Seq2seq, seq2seq_loss  # noqa
 from chainermn_tpu.models.transformer import (  # noqa
     TransformerLM, TransformerBlock, decode_step, init_kv_cache,
-    kv_cache_specs, lm_loss, lm_loss_sum, pipeline_parts, prefill,
-    tp_oracle, tp_param_specs)
+    kv_cache_specs, lm_loss, lm_loss_sum, pipeline_parts,
+    pipeline_stage_specs, prefill, tp_oracle, tp_param_specs)
 
 
 def get_arch(name, **kwargs):

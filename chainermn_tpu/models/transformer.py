@@ -638,9 +638,11 @@ def prefill(model, params, cache, tokens, length, slot):
     return _head_logits(model, params, x_last)[0], cache
 
 
-def pipeline_parts(model, params, n_stages, pad_id=-1):
+def pipeline_parts(model, params, n_stages, pad_id=-1, tp_axis=None,
+                   local_loss=False):
     """Split a ``TransformerLM`` parameter tree into
-    :class:`~chainermn_tpu.training.PipelineUpdater` pieces.
+    :class:`~chainermn_tpu.training.PipelineUpdater` /
+    :class:`~chainermn_tpu.training.MeshPipelineUpdater` pieces.
 
     Returns ``(stage_fn, prologue, loss_on_last, params_stacked,
     extra)``: the block stack becomes the stage-sharded body
@@ -653,11 +655,25 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
     (``tests/test_pipeline_training.py::test_transformer_pipeline_parts``).
 
     ``model`` must have ``sequence_axis=None`` (pipeline shards the
-    batch, not the sequence) and is used with ``train=False``
-    semantics (no dropout).  Use the updater's default gpipe
-    schedule: the returned ``loss_on_last`` psums masked token counts
-    over the data axis (global pad weighting), which 1f1b's
-    per-device loss vjp cannot transpose.
+    batch, not the sequence), ``tp_axis=None`` (the params tree IS
+    the unsharded oracle's) and is used with ``train=False``
+    semantics (no dropout).
+
+    ``tp_axis`` (e.g. a 3-D plan's ``model`` axis) makes the STAGE
+    BODY tensor-parallel: each stage's blocks run the Megatron
+    ``_tp_call`` path (heads / MLP columns+rows split over the axis,
+    conjugate custom-vjp psums -- exact under 1F1B's per-device
+    backward), while the embedding/head ``extra`` ends stay
+    replicated and collective-free.  Shard the stacked stage tree
+    with :func:`pipeline_stage_specs`.
+
+    ``local_loss=True`` returns a collective-free ``loss_on_last``
+    (the 1F1B requirement: its vjp is taken per device): a LOCAL
+    masked mean, exact vs :func:`lm_loss` whenever every data shard
+    carries the same valid-token count -- always true at
+    ``pad_id=-1`` (no padding); unevenly padded shards need the
+    default GLOBAL form, whose data-axis psums require the gpipe
+    schedule.
     """
     if model.sequence_axis is not None:
         raise ValueError('pipeline_parts shards the batch dimension; '
@@ -665,9 +681,9 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
     if model.tp_axis is not None:
         raise ValueError('pipeline_parts expects the unsharded block '
                          'body; build the model with tp_axis=None '
-                         '(tensor parallelism composes with the '
-                         'pipeline via MeshPlan, not through the '
-                         'stacked stage tree)')
+                         '(stage-internal tensor parallelism is the '
+                         'tp_axis= argument HERE, over the oracle '
+                         'parameter tree)')
     if model.dropout:
         raise ValueError('pipeline_parts runs the blocks without '
                          'dropout rngs; build the model with '
@@ -682,7 +698,7 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
 
     n_per = model.n_layers // n_stages
     block = TransformerBlock(model.d_model, model.n_heads, model.d_ff,
-                             model.dtype)
+                             model.dtype, tp_axis=tp_axis)
     layer_trees = [params['block_%d' % i]
                    for i in range(model.n_layers)]
     per_stage = [stack_stage_params(layer_trees[s * n_per:
@@ -708,8 +724,7 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
         pos = e['pos_embed'][:tokens.shape[1]]
         return x + pos.astype(model.dtype)
 
-    def loss_on_last(e, outs, y_micro):
-        from chainermn_tpu.training.pipeline_updater import AXIS_DATA
+    def masked_ce(e, outs, y_micro):
         h = ops.layer_norm(outs, e['lnf_scale'],
                            e['lnf_bias']).astype(model.dtype)
         logits = (h.astype(jnp.float32)
@@ -720,17 +735,70 @@ def pipeline_parts(model, params, n_stages, pad_id=-1):
         yy = y_micro.reshape(-1).astype(jnp.int32)
         ce = ops.softmax_cross_entropy(flat, yy)
         mask = (yy != pad_id).astype(jnp.float32)
+        return jnp.sum(ce * mask), jnp.sum(mask)
+
+    def loss_on_last(e, outs, y_micro):
+        from chainermn_tpu.training.pipeline_updater import AXIS_DATA
+        total, n = masked_ce(e, outs, y_micro)
         # GLOBAL masked mean: sums psum'd over the data axis BEFORE
         # dividing, so unevenly padded shards weight each token
         # equally -- exactly lm_loss's reduction (a per-shard mean
         # pmean'd by the updater would weight a lightly-padded
         # shard's tokens less)
-        total = lax.psum(jnp.sum(ce * mask), AXIS_DATA)
-        n = jnp.maximum(lax.psum(jnp.sum(mask), AXIS_DATA), 1.0)
+        total = lax.psum(total, AXIS_DATA)
+        n = jnp.maximum(lax.psum(n, AXIS_DATA), 1.0)
         loss = total / n
         return loss, {'perp': jnp.exp(jnp.minimum(loss, 20.0))}
 
-    return stage_fn, prologue, loss_on_last, params_stacked, extra
+    def local_loss_on_last(e, outs, y_micro):
+        # LOCAL masked mean (collective-free; see docstring): the
+        # updater's last-stage data-mean completes the global mean
+        # when shards hold equal valid-token counts
+        total, n = masked_ce(e, outs, y_micro)
+        loss = total / jnp.maximum(n, 1.0)
+        return loss, {'perp': jnp.exp(jnp.minimum(loss, 20.0))}
+
+    return (stage_fn, prologue,
+            local_loss_on_last if local_loss else loss_on_last,
+            params_stacked, extra)
+
+
+def pipeline_stage_specs(params_stacked, pipe_axis='pipe',
+                         tp_axis=None):
+    """``PartitionSpec`` tree for a :func:`pipeline_parts` stacked
+    stage tree: every leaf leads with ``pipe_axis`` (each stage's
+    weights live on its pipe coordinate -- the
+    :meth:`chainermn_tpu.parallel.MeshPlan.stage_specs` placement),
+    and with ``tp_axis`` set the Megatron dims shard exactly as
+    :func:`tp_param_specs` does for the unstacked tree -- attention
+    heads and MLP columns on the axis, row-parallel kernels on their
+    input dim, layer norms and post-psum biases replicated (per
+    stage).  Leaves carry TWO leading stacking dims
+    ``(n_stages, layers_per_stage)`` ahead of the block dims."""
+    from jax.sharding import PartitionSpec as P
+
+    def one(path, leaf):
+        names = {str(getattr(k, 'key', k)) for k in path}
+        nd = getattr(leaf, 'ndim', 0)
+        if tp_axis is None:
+            return P(pipe_axis)
+        if 'qkv' in names:
+            # kernel (S, L, d, 3, H, d_head) / bias (S, L, 3, H, d_head)
+            return (P(pipe_axis, None, None, None, tp_axis, None)
+                    if nd == 6
+                    else P(pipe_axis, None, None, tp_axis, None))
+        if 'ff_in' in names:
+            # kernel (S, L, d, ff) / bias (S, L, ff): column-parallel
+            return (P(pipe_axis, None, None, tp_axis) if nd == 4
+                    else P(pipe_axis, None, tp_axis))
+        if ('ff_out' in names or 'proj' in names) and nd == 4:
+            # row-parallel kernels (S, L, in, d): input dim sharded
+            return P(pipe_axis, None, tp_axis, None)
+        # layer norms, post-psum biases: stage-stacked, tp-replicated
+        return P(pipe_axis)
+
+    import jax
+    return jax.tree_util.tree_map_with_path(one, params_stacked)
 
 
 def lm_loss_sum(apply_fn, pad_id=-1):

@@ -21,10 +21,16 @@ requested tp clamps to the largest divisor of the device count, so
 a ``psum`` over a size-1 axis is the identity and the same program
 runs unchanged.
 
-A pipeline axis is a planned extension, not wired yet: the
-:class:`~chainermn_tpu.training.PipelineUpdater` owns its own
-``(data, stage)`` mesh today, and ``MeshPlan.create`` reserves the
-``pp=`` slot so the 3-D composition lands without an API break.
+The composition is 3-D: ``MeshPlan.create(tp=N, pp=K)`` binds a
+``pipe`` axis (minor, so the 1F1B stage-boundary ``ppermute`` rides
+neighbor links) whose coordinates own the pipeline stages'
+parameters (:meth:`MeshPlan.stage_specs`), trained through
+:class:`chainermn_tpu.training.MeshPipelineUpdater` -- the unified
+plan-based pipeline path (``docs/mesh_parallelism.md``).
+``MeshPlan.create(ep=N)`` is the expert-axis on-ramp: a
+``(data, expert)`` mesh whose ``expert`` axis carries the
+:class:`chainermn_tpu.parallel.MoELayer` ``all_to_all``
+(:meth:`MeshPlan.expert_param_specs`).
 
 Threading: ``plan.communicator()`` returns a
 :class:`MeshPlanCommunicator` -- the updater-facing adapter whose
@@ -51,63 +57,128 @@ from chainermn_tpu.communicators.base import CommunicatorBase
 #: pattern under the repo's own vocabulary)
 AXIS_DATA = 'data'
 AXIS_MODEL = 'model'
+AXIS_PIPE = 'pipe'
+AXIS_EXPERT = 'expert'
 PLAN_AXES = (AXIS_DATA, AXIS_MODEL)
+PLAN_AXES_3D = (AXIS_DATA, AXIS_MODEL, AXIS_PIPE)
 
 
 class MeshPlan:
     """A named-axis mesh plus the spec handout for training on it.
 
     Attributes:
-      mesh: the 2-D ``jax.sharding.Mesh`` (axes ``(data, model)``).
+      mesh: the ``jax.sharding.Mesh`` -- 2-D ``(data, model)``, 3-D
+        ``(data, model, pipe)`` when a pipeline width was requested,
+        or ``(data, expert)`` for an expert-parallel plan.
       data_axes: axes batch sharding / gradient reduction / ZeRO span.
-      model_axis: the tensor-parallel axis name.
-      requested_tp: the tp the caller asked for (the effective tp is
-        ``model_size``; they differ only under graceful degradation).
+      model_axis: the tensor-parallel axis name (None on expert plans).
+      pipe_axis: the pipeline-stage axis name, or None on 2-D plans.
+      expert_axis: the expert-parallel axis name, or None.
+      requested_tp / requested_pp / requested_ep: the widths the
+        caller asked for (the effective widths are ``model_size`` /
+        ``pipe_size`` / ``expert_size``; they differ only under
+        graceful degradation).
     """
 
     def __init__(self, mesh, data_axes=(AXIS_DATA,),
-                 model_axis=AXIS_MODEL, requested_tp=None):
+                 model_axis=AXIS_MODEL, requested_tp=None,
+                 pipe_axis=None, requested_pp=None,
+                 expert_axis=None, requested_ep=None):
         self.mesh = mesh
         self.data_axes = tuple(data_axes)
+        if model_axis is not None and model_axis not in mesh.shape:
+            model_axis = None
         self.model_axis = model_axis
+        # a directly-constructed Mesh that binds the canonical pipe /
+        # expert names IS a 3-D / expert plan (test meshes build this
+        # way); explicit kwargs override
+        if pipe_axis is None and AXIS_PIPE in mesh.shape:
+            pipe_axis = AXIS_PIPE
+        if expert_axis is None and AXIS_EXPERT in mesh.shape:
+            expert_axis = AXIS_EXPERT
+        self.pipe_axis = pipe_axis
+        self.expert_axis = expert_axis
         self.requested_tp = requested_tp
-        for ax in self.data_axes + (self.model_axis,):
+        self.requested_pp = requested_pp
+        self.requested_ep = requested_ep
+        bound = self.data_axes + tuple(
+            ax for ax in (self.model_axis, self.pipe_axis,
+                          self.expert_axis) if ax is not None)
+        for ax in bound:
             if ax not in mesh.shape:
                 raise ValueError('mesh %r does not bind plan axis %r'
                                  % (dict(mesh.shape), ax))
 
     # -- construction --------------------------------------------------
     @classmethod
-    def create(cls, tp=1, devices=None, axis_names=PLAN_AXES, pp=None):
-        """Compose a ``(data, model)`` plan over the global devices.
+    def create(cls, tp=1, devices=None, axis_names=PLAN_AXES, pp=None,
+               ep=None):
+        """Compose a plan over the global devices.
 
         ``tp`` is the requested model-axis width; it degrades to the
         largest divisor of the device count
         (:func:`mesh_utility.divisor_leq`), never errors on a small
         host.  Devices are ordered by the same slice-aware sort as
         the communicators (``mesh_utility.sorted_devices``), and the
-        model axis is the MINOR (fastest-varying) one so tensor
-        parallelism lands on the tightest ICI neighbors.
+        model axis stays more minor than ``data`` so tensor
+        parallelism lands on tight ICI neighbors.
 
-        ``pp`` reserves the pipeline-axis slot for the 3-D extension;
-        any value other than ``None``/``1`` raises for now.
+        ``pp`` (an int >= 1) adds the pipeline axis: the mesh becomes
+        3-D ``(data, model, pipe)`` with ``pipe`` the MINOR
+        (fastest-varying) axis, so the 1F1B stage-boundary
+        ``ppermute`` rides neighbor links.  Degradation extends to
+        3-D via :func:`mesh_utility.divisors_leq` -- tp clamps first,
+        pp within what remains, the data axis absorbs the rest; the
+        axis NAMES never change with the shape (1 device ->
+        ``(1, 1, 1)``, ``tp * pp > n`` clamps both, primes degrade
+        the later axis to 1).  ``pp=None`` (the default) keeps the
+        2-D plan unchanged.
+
+        ``ep`` (an int >= 1) builds the expert-parallel on-ramp
+        instead: a ``(data, expert)`` mesh whose ``expert`` axis
+        carries the :class:`chainermn_tpu.parallel.MoELayer`
+        ``all_to_all`` (see :meth:`expert_param_specs`).  Composing
+        ``ep`` with ``tp > 1`` or ``pp`` is not implemented yet.
         """
-        if pp not in (None, 1):
-            raise NotImplementedError(
-                'the pipeline axis is a reserved extension slot '
-                '(PipelineUpdater owns its own (data, stage) mesh '
-                'today); pass pp=None')
         if tp < 1:
             raise ValueError('tp must be >= 1, got %d' % tp)
         devices = mesh_utility.sorted_devices(devices)
         n = len(devices)
-        eff = mesh_utility.divisor_leq(n, tp)
+        if ep is not None:
+            if ep < 1:
+                raise ValueError('ep must be >= 1, got %d' % ep)
+            if tp > 1 or pp is not None:
+                raise NotImplementedError(
+                    'the expert axis composes with data parallelism '
+                    'only for now: pass ep= without tp/pp (full '
+                    'mesh-placed MoE training is the follow-up)')
+            eff = mesh_utility.divisor_leq(n, ep)
+            arr = np.asarray(  # noqa: shardlint - eager driver-level
+                devices, dtype=object).reshape(n // eff, eff)
+            return cls(Mesh(arr, (AXIS_DATA, AXIS_EXPERT)),
+                       data_axes=(AXIS_DATA,), model_axis=None,
+                       expert_axis=AXIS_EXPERT, requested_ep=ep)
+        if pp is None:
+            eff = mesh_utility.divisor_leq(n, tp)
+            arr = np.asarray(  # noqa: shardlint - eager driver-level
+                devices, dtype=object).reshape(n // eff, eff)
+            data_name, model_name = axis_names
+            return cls(Mesh(arr, (data_name, model_name)),
+                       data_axes=(data_name,), model_axis=model_name,
+                       requested_tp=tp)
+        if pp < 1:
+            raise ValueError('pp must be >= 1, got %d' % pp)
+        eff_tp, eff_pp = mesh_utility.divisors_leq(n, (tp, pp))
         arr = np.asarray(  # noqa: shardlint - eager driver-level
-            devices, dtype=object).reshape(n // eff, eff)
-        data_name, model_name = axis_names
-        return cls(Mesh(arr, (data_name, model_name)),
+            devices, dtype=object).reshape(
+                n // (eff_tp * eff_pp), eff_tp, eff_pp)
+        if len(axis_names) == 2:
+            axis_names = tuple(axis_names) + (AXIS_PIPE,)
+        data_name, model_name, pipe_name = axis_names
+        return cls(Mesh(arr, (data_name, model_name, pipe_name)),
                    data_axes=(data_name,), model_axis=model_name,
-                   requested_tp=tp)
+                   requested_tp=tp, pipe_axis=pipe_name,
+                   requested_pp=pp)
 
     # -- topology ------------------------------------------------------
     @property
@@ -123,7 +194,24 @@ class MeshPlan:
 
     @property
     def model_size(self):
+        if self.model_axis is None:
+            return 1
         return self.mesh.shape[self.model_axis]
+
+    @property
+    def pipe_size(self):
+        """Pipeline-stage count (1 when no pipe axis is bound -- the
+        shape-only degradation contract: a size-1 pipeline is the
+        unpipelined program)."""
+        if self.pipe_axis is None:
+            return 1
+        return self.mesh.shape[self.pipe_axis]
+
+    @property
+    def expert_size(self):
+        if self.expert_axis is None:
+            return 1
+        return self.mesh.shape[self.expert_axis]
 
     @property
     def axis_names(self):
@@ -131,11 +219,20 @@ class MeshPlan:
 
     def describe(self):
         """Provenance dict for bench rows / checkpoint manifests."""
-        return {'axes': {k: int(v) for k, v in self.mesh.shape.items()},
-                'data_axes': list(self.data_axes),
-                'model_axis': self.model_axis,
-                'requested_tp': self.requested_tp,
-                'effective_tp': int(self.model_size)}
+        out = {'axes': {k: int(v) for k, v in self.mesh.shape.items()},
+               'data_axes': list(self.data_axes),
+               'model_axis': self.model_axis,
+               'requested_tp': self.requested_tp,
+               'effective_tp': int(self.model_size)}
+        if self.pipe_axis is not None:
+            out['pipe_axis'] = self.pipe_axis
+            out['requested_pp'] = self.requested_pp
+            out['effective_pp'] = int(self.pipe_size)
+        if self.expert_axis is not None:
+            out['expert_axis'] = self.expert_axis
+            out['requested_ep'] = self.requested_ep
+            out['effective_ep'] = int(self.expert_size)
+        return out
 
     # -- spec handout --------------------------------------------------
     def batch_spec(self, axis=0):
@@ -186,6 +283,48 @@ class MeshPlan:
                         '%r (size %d)' % (i, tuple(shape), ax, k))
                 shape[i] //= k
         return tuple(shape)
+
+    def stage_specs(self, params_stacked, body_specs=None):
+        """``PartitionSpec`` tree placing each pipeline stage's
+        parameters on its ``pipe`` coordinate: every leaf of a
+        stage-STACKED tree (leading dim = ``pipe_size``; see
+        :func:`chainermn_tpu.parallel.pipeline.stack_stage_params`)
+        gets ``P(pipe_axis)`` -- or, with ``body_specs`` (a leaf-exact
+        spec tree over the UNSTACKED leaf dims, e.g. the Megatron tp
+        specs of one stage body), ``P(pipe_axis, *body_spec)`` so
+        tensor parallelism composes inside each stage."""
+        if self.pipe_axis is None:
+            raise ValueError('stage_specs needs a pipeline axis: '
+                             'build the plan with MeshPlan.create('
+                             'pp=...)')
+        pipe = self.pipe_axis
+        if body_specs is None:
+            return jax.tree_util.tree_map(lambda _: P(pipe),
+                                          params_stacked)
+        from jax.sharding import PartitionSpec
+        return jax.tree_util.tree_map(
+            lambda _leaf, sp: P(pipe, *tuple(sp)),
+            params_stacked, body_specs,
+            is_leaf=lambda v: isinstance(v, PartitionSpec))
+
+    def expert_param_specs(self, params):
+        """``PartitionSpec`` tree for a
+        :class:`chainermn_tpu.parallel.MoELayer` parameter tree
+        (:meth:`MoELayer.init_params`): the expert-stacked
+        ``w_in``/``w_out`` shard their leading experts dim over the
+        ``expert`` axis, the ``router`` (and any other <3-D leaf)
+        replicates."""
+        if self.expert_axis is None:
+            raise ValueError('expert_param_specs needs an expert '
+                             'axis: build the plan with '
+                             'MeshPlan.create(ep=...)')
+        ax = self.expert_axis
+
+        def one(leaf):
+            if getattr(leaf, 'ndim', 0) >= 3:
+                return P(ax)
+            return P()
+        return jax.tree_util.tree_map(one, params)
 
     # -- updater threading ---------------------------------------------
     def communicator(self, reduce_dtype=None):
@@ -264,6 +403,8 @@ class MeshPlanCommunicator(CommunicatorBase):
         return rank
 
     def model_rank(self):
+        if self.plan.model_axis is None:
+            raise ValueError('this plan binds no model axis')
         return lax.axis_index(self.plan.model_axis)
 
     # -- collectives ---------------------------------------------------

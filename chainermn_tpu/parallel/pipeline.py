@@ -91,19 +91,35 @@ _COLLECTIVE_PRIMS = frozenset((
     'psum_scatter', 'psum_invariant'))
 
 
-def _jaxpr_collectives(jaxpr, found):
+def _eqn_axes(eq):
+    """Named mesh axes a collective equation acts over (positional
+    int axes dropped -- they are array dims)."""
+    axes = eq.params.get('axes', eq.params.get('axis_name', ()))
+    if isinstance(axes, str):
+        axes = (axes,)
+    elif not isinstance(axes, (tuple, list)):
+        axes = (axes,)
+    return tuple(a for a in axes if isinstance(a, str))
+
+
+def _jaxpr_collectives(jaxpr, found, allowed_axes=()):
     for eq in jaxpr.eqns:
         if eq.primitive.name in _COLLECTIVE_PRIMS:
-            found.add(eq.primitive.name)
+            axes = _eqn_axes(eq)
+            # a collective acting ONLY over allowed axes (the tensor-
+            # parallel conjugate discipline's model axis) is exempt
+            if not (allowed_axes and axes
+                    and all(a in allowed_axes for a in axes)):
+                found.add(eq.primitive.name)
         for v in eq.params.values():
             inner = getattr(v, 'jaxpr', None)
             if inner is not None:
-                _jaxpr_collectives(inner, found)
+                _jaxpr_collectives(inner, found, allowed_axes)
             elif isinstance(v, (list, tuple)):
                 for vv in v:
                     inner = getattr(vv, 'jaxpr', None)
                     if inner is not None:
-                        _jaxpr_collectives(inner, found)
+                        _jaxpr_collectives(inner, found, allowed_axes)
 
 
 def _dce(jaxpr):
@@ -125,13 +141,24 @@ def _dce(jaxpr):
     return jaxpr
 
 
-def assert_collective_free(what, fn, *args):
+def assert_collective_free(what, fn, *args, allowed_axes=()):
     """Trace-time guard: raise if ``fn(*args)``'s outputs -- or the
     cotangents of its VJP -- depend on collective primitives.  The
     1F1B schedule takes per-device vjps of the stage body, loss and
     prologue inside ``shard_map(check_vma=False)``, where collective
     transposes are silently WRONG (see the package AUTODIFF CAVEAT)
     -- fail loudly instead of training on corrupt gradients.
+
+    ``allowed_axes`` exempts collectives acting ONLY over the named
+    axes: the tensor-parallel conjugate pair
+    (:func:`chainermn_tpu.parallel.tensor.tp_copy` /
+    :func:`~chainermn_tpu.parallel.tensor.tp_reduce` and
+    ``row_parallel_dense(grad_conjugate=True)``) carries CORRECT
+    custom transposes for per-device differentiation, so a stage body
+    whose only cross-device traffic is model-axis psums through that
+    discipline is safe under 1F1B -- that is exactly how tp composes
+    inside a pipeline stage (``docs/mesh_parallelism.md``).  A
+    collective over any OTHER axis (data, pipe) still fails.
 
     Each jaxpr is dead-code-eliminated down to the probed outputs
     first: ``make_jaxpr`` records everything executed, so without DCE
@@ -148,7 +175,7 @@ def assert_collective_free(what, fn, *args):
     exactly what the 1f1b schedule will execute."""
     jaxpr = _dce(jax.make_jaxpr(fn)(*args).jaxpr)
     found = set()
-    _jaxpr_collectives(jaxpr, found)
+    _jaxpr_collectives(jaxpr, found, allowed_axes)
 
     if not found:
         import numpy as np
@@ -163,7 +190,7 @@ def assert_collective_free(what, fn, *args):
             return pullback(cots)
 
         bwd = _dce(jax.make_jaxpr(vjp_probe)(*args).jaxpr)
-        _jaxpr_collectives(bwd, found)
+        _jaxpr_collectives(bwd, found, allowed_axes)
         if found:
             found = {f + ' (in the backward)' for f in found}
 
@@ -366,3 +393,60 @@ def microbatch(x, n_micro):
         raise ValueError('batch %d not divisible into %d micro-batches'
                          % (x.shape[0], n_micro))
     return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+
+# ---------------------------------------------------------------------
+# schedule accounting: the pipeline bubble.
+#
+# Both schedules here are SPMD scans -- every stage executes every
+# tick's full body, and "idle" is the masked (invalid) work slots, so
+# the bubble is a STATIC property of (n_micro, n_stages) known at
+# trace time.  This is the number `telemetry report` surfaces per
+# stage (the pipeline twin of the overlap fraction) and `bench.py
+# --pp` stamps on its rows; a CI test pins that it strictly shrinks
+# as micro-batches grow at fixed global batch.
+
+def schedule_ticks(n_micro, n_stages, schedule='1f1b'):
+    """Total scan ticks of one pipelined step: ``M + S - 1`` for the
+    gpipe forward scan (its backward is the transposed scan, same
+    count), ``M + 2S - 1`` for the combined fwd+bwd 1F1B scan
+    (:func:`pipeline_1f1b_grads`)."""
+    if schedule == 'gpipe':
+        return n_micro + n_stages - 1
+    if schedule == '1f1b':
+        return n_micro + 2 * n_stages - 1
+    raise ValueError("schedule must be 'gpipe' or '1f1b', got %r"
+                     % (schedule,))
+
+
+def bubble_fraction(n_micro, n_stages, schedule='1f1b'):
+    """Fraction of a stage's work slots that are pipe-idle (masked)
+    in one step, in ``[0, 1)``.
+
+    gpipe: each stage runs M valid forwards in ``M + S - 1`` ticks ->
+    ``(S - 1) / (M + S - 1)`` (0 at one stage).  1f1b: each tick
+    holds a forward AND a backward slot, of which a stage fills
+    ``2M`` over ``M + 2S - 1`` ticks ->
+    ``(2S - 1) / (M + 2S - 1)`` (``1 / (M + 1)`` at one stage: the
+    combined scan still pays one turnaround tick).  Strictly
+    decreasing in ``n_micro`` -- "more microbatches -> smaller
+    bubble" as arithmetic, not a slide."""
+    if n_micro < 1 or n_stages < 1:
+        raise ValueError('n_micro and n_stages must be >= 1, got '
+                         '%d, %d' % (n_micro, n_stages))
+    ticks = schedule_ticks(n_micro, n_stages, schedule)
+    slots_per_tick = 1 if schedule == 'gpipe' else 2
+    busy = slots_per_tick * n_micro
+    return 1.0 - busy / float(slots_per_tick * ticks)
+
+
+def bubble_fractions_per_stage(n_micro, n_stages, schedule='1f1b'):
+    """Per-stage bubble fractions (list of length ``n_stages``).
+
+    In the SPMD scan formulation every stage holds the same valid
+    work count (M forwards [+ M backwards]), so the per-stage values
+    coincide -- reported per stage anyway because that is the shape
+    the timeline consumer expects (and a future interleaved schedule
+    will differ by stage)."""
+    b = bubble_fraction(n_micro, n_stages, schedule)
+    return [b] * n_stages

@@ -279,6 +279,57 @@ def step_table(spans):
     return [rows[k] for k in sorted(rows)]
 
 
+def pipeline_summary(events):
+    """The pipeline view of a capture: one row per distinct pipelined
+    step configuration, from the ``pipeline:schedule`` trace-time
+    events the pipeline updaters stamp once per compilation
+    (``kind='pipeline'``; schedule name, micro-batch count, stage
+    count, scan ticks, stage axis).
+
+    The **bubble fraction** -- pipe-idle work slots per stage per
+    step, the pipeline twin of the overlap fraction -- is computed
+    from the schedule arithmetic
+    (:func:`chainermn_tpu.parallel.pipeline.bubble_fraction`): both
+    schedules are SPMD scans whose idle is the masked slots, a static
+    property of ``(n_micro, n_stages)``, so the number here is exact,
+    not sampled.  Always in ``[0, 1]`` per stage, and strictly
+    decreasing in the micro-batch count at fixed stages -- the
+    property CI pins.  ``None`` when the capture recorded no pipeline
+    events."""
+    scheds = [e for e in events
+              if e.get('kind') == 'pipeline'
+              and e.get('name') == 'pipeline:schedule']
+    if not scheds:
+        return None
+    from chainermn_tpu.parallel.pipeline import (
+        bubble_fractions_per_stage)
+    out, seen = [], set()
+    for e in scheds:
+        try:
+            key = (e.get('schedule') or '1f1b',
+                   int(e.get('n_micro') or 0),
+                   int(e.get('n_stages') or 0))
+        except (TypeError, ValueError):
+            continue
+        if key in seen or key[1] < 1 or key[2] < 1:
+            continue
+        seen.add(key)
+        per_stage = bubble_fractions_per_stage(key[1], key[2], key[0])
+        axes = e.get('axes')
+        out.append({
+            'schedule': key[0],
+            'n_micro': key[1],
+            'n_stages': key[2],
+            'total_ticks': e.get('total_ticks'),
+            'axis': (axes[0] if isinstance(axes, (list, tuple))
+                     and axes else 'stage'),
+            'bubble_fraction': round(per_stage[0], 6),
+            'bubble_fraction_per_stage': [round(b, 6)
+                                          for b in per_stage],
+        })
+    return out or None
+
+
 def serve_summary(metrics):
     """The serving view of an aggregated metrics snapshot: request /
     batch / shed totals and the latency / queue-wait / pad-waste
@@ -530,6 +581,7 @@ def build_report(outdir):
     }
     report['serve'] = serve_summary(report['metrics'])
     report['requests'] = request_summary(spans + events)
+    report['pipeline'] = pipeline_summary(events)
     return report
 
 
@@ -582,6 +634,17 @@ def render_text(report, max_steps=24):
                    agg['total_collective_s'] * 1e3,
                    agg['exposed_collective_s'] * 1e3,
                    '-' if frac is None else '%.3f' % frac))
+    for row in report.get('pipeline') or ():
+        # the pipe-axis row of the per-axis story: the schedule's
+        # collectives live inside the jit (trace marks, not spans),
+        # so its cost is the static bubble, reported per stage
+        lines.append(
+            'pipeline [%s] %d stage(s) x %d micro-batch(es) over '
+            "axis '%s': bubble fraction %.3f per stage "
+            '(%s ticks/step; shrink it with more micro-batches)'
+            % (row['schedule'], row['n_stages'], row['n_micro'],
+               row['axis'], row['bubble_fraction'],
+               row.get('total_ticks')))
     serve = report.get('serve')
     if serve:
         lat = serve.get('latency_ms') or {}

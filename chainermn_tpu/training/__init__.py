@@ -15,7 +15,7 @@ from chainermn_tpu.training import iterators  # noqa
 from chainermn_tpu.training.trainer import Trainer  # noqa
 from chainermn_tpu.training.updater import StandardUpdater  # noqa
 from chainermn_tpu.training.pipeline_updater import (  # noqa
-    PipelineUpdater, pipeline_mesh)
+    MeshPipelineUpdater, PipelineUpdater, pipeline_mesh)
 from chainermn_tpu.training.evaluator import Evaluator  # noqa
 from chainermn_tpu.training import extensions  # noqa
 from chainermn_tpu.training import recovery  # noqa

@@ -248,6 +248,10 @@ class StandardUpdater:
             self.opt_state = owned_device_put(opt_state, opt_shardings,
                                               donate, protect=params)
         self.iteration = 0
+        #: distinct compilations of the jitted step (bumped at trace
+        #: time) -- the no-retrace pin shared with the pipeline
+        #: updaters: a stable loop keeps this at 1 across iterations
+        self.trace_count = 0
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self.scale_state = (comm.replicate(self._loss_scale.init())
                             if self._loss_scale is not None else None)
@@ -528,6 +532,7 @@ class StandardUpdater:
         # arity of in_specs depends on the batch tuple; resolved at
         # trace time (jit caches per shape signature)
         def mapped_call(*args):
+            self.trace_count += 1  # fires per compilation, not per step
             n_batch = len(args) - n_lead
             fn = jax.shard_map(
                 core, mesh=comm.mesh,

@@ -71,23 +71,38 @@ print('SL009 OK: bucketed_overlap clean, fused mlp flagged (%d '
 }
 
 # SL010-family gate (docs/mesh_parallelism.md): the composed dp x tp
-# transformer_tp step must be IN the sweep and lint clean under the
-# multi-axis rules (SL010 plan-axis discipline, SL011 cross-axis
-# chains, SL012 tp-aware donation) -- the known-bad shapes are pinned
-# by fixtures in tests/test_analysis.py; this check pins the clean
-# state in BOTH precision sweeps.
+# transformer_tp step -- and since ISSUE 14 the 3-D dp x pp
+# (transformer_pp) and dp x tp x pp (transformer_tp_pp) unified
+# pipeline steps -- must be IN the sweep and lint clean under the
+# multi-axis rules (SL010 plan-axis discipline incl. the third axis,
+# SL011 cross-axis chains, SL012 tp-aware donation); the pipeline
+# steps must additionally carry no SL002 finding (the 1F1B
+# stage-handoff ppermute ring is bijective BY the lint, not by
+# inspection).  Known-bad shapes are pinned by fixtures in
+# tests/test_analysis.py; this check pins the clean state in BOTH
+# precision sweeps.
 check_sl010() {
   python -c "
 import json, sys
 report = json.load(open(sys.argv[1]))
-assert 'step:transformer_tp' in report['targets'], report['targets']
-tp = [f for f in report['findings']
-      if f['target'] == 'step:transformer_tp'
-      and f['rule'] in ('SL010', 'SL011', 'SL012')]
-assert not tp, (
-    'transformer_tp must lint clean under the SL010 family: %r' % tp)
-print('SL010 OK: transformer_tp swept and clean under the '
-      'multi-axis rules')
+plan_targets = ('step:transformer_tp', 'step:transformer_pp',
+                'step:transformer_tp_pp')
+for t in plan_targets:
+    assert t in report['targets'], (t, report['targets'])
+multi = [f for f in report['findings']
+         if f['target'] in plan_targets
+         and f['rule'] in ('SL010', 'SL011', 'SL012')]
+assert not multi, (
+    'plan targets must lint clean under the SL010 family: %r' % multi)
+pperm = [f for f in report['findings']
+         if f['target'] in ('step:transformer_pp',
+                            'step:transformer_tp_pp')
+         and f['rule'] == 'SL002']
+assert not pperm, (
+    'the 1F1B ppermute handoff must pass SL002: %r' % pperm)
+print('SL010 OK: transformer_tp + transformer_pp + transformer_tp_pp '
+      'swept and clean under the multi-axis rules (SL002 clean on '
+      'the ppermute handoff)')
 " "$1"
 }
 

@@ -295,6 +295,11 @@ fi
 # MFU vs the PERF.md 90-115k tok/s/chip anchor, and per-axis
 # collective bytes (data vs model wire traffic)
 run bench_transformer_tp $QT python bench.py --model transformer --quick --tp 2
+# 3-D dp x pp pipeline arm (ISSUE 14): the stage-sliced transformer
+# trained 1F1B through the unified MeshPipelineUpdater; rows add
+# pp / n_microbatches / bubble_fraction (banked-sidecar conventions
+# apply through outages like every transformer row)
+run bench_transformer_pp $QT python bench.py --model transformer --quick --pp 2
 
 # --- serving arms (docs/serving.md) ----------------------------------
 # AFTER the training headline + the re-queued b128/b256/best rungs on

@@ -746,6 +746,109 @@ def test_sl012_same_sharding_donation_is_silent():
     assert not [f for f in fs if f.rule_id == 'SL012'], fs
 
 
+# ------------------------------------------------- third axis (pipe)
+# ISSUE 14 fixtures: the SL010 family audits the 3-D composition --
+# an undeclared-pipe collective, a dead pipe axis, and a cross-axis
+# reduce chain THROUGH a stage boundary (a stage-axis psum feeding
+# the data-axis mean) each seed one violation; the clean state is the
+# real step:transformer_pp / step:transformer_tp_pp targets below.
+
+def test_sl010_undeclared_pipe_collective_fires():
+    # a 3-D mesh whose plan declares only (data, model): a ppermute-
+    # style psum over 'pipe' leaks outside the declared topology --
+    # the exact bug class of a subsystem still assuming the 2-D plan
+    mesh = _plan_mesh((2, 2, 2), ('data', 'model', 'pipe'))
+
+    def bad(x):
+        return (lax.psum(x, 'pipe')
+                + lax.psum(x, 'data') + lax.psum(x, 'model'))
+
+    fs = _plan_target(bad, (jnp.zeros((4,)),), mesh,
+                      plan_axes=('data', 'model'))
+    sl10 = [f for f in fs if f.rule_id == 'SL010']
+    assert sl10 and any('outside the declared plan' in f.message
+                        and 'pipe' in f.message for f in sl10), fs
+
+
+def test_sl010_dead_pipe_axis_fires():
+    # the plan declares all three axes but nothing ever combines
+    # along pipe: stages hold disjoint weights yet no activation or
+    # gradient ever crosses the boundary -- a pipeline in name only
+    mesh = _plan_mesh((2, 2, 2), ('data', 'model', 'pipe'))
+
+    def bad(x):
+        return lax.psum(lax.pmean(x * 2.0, 'model') * x, 'data')
+
+    fs = _plan_target(bad, (jnp.zeros((4,)),), mesh,
+                      plan_axes=('data', 'model', 'pipe'))
+    sl10 = [f for f in fs if f.rule_id == 'SL010']
+    assert sl10 and any('never touched' in f.message
+                        and "'pipe'" in f.message for f in sl10), fs
+
+
+def test_sl011_stage_boundary_chain_fires():
+    # the loss shape the unified updater deliberately AVOIDS: a
+    # last-stage psum over pipe feeding directly into the data-axis
+    # mean serializes two launches where one psum(('pipe','data'))
+    # moves the same bytes once (see _last_stage_mean in
+    # training/pipeline_updater.py)
+    mesh = _plan_mesh((2, 2, 2), ('data', 'model', 'pipe'))
+
+    def bad(x):
+        x = lax.pmean(x * 2.0, 'model')
+        return lax.pmean(lax.psum(x, 'pipe'), 'data')
+
+    fs = _plan_target(bad, (jnp.zeros((4,)),), mesh,
+                      plan_axes=('data', 'model', 'pipe'))
+    assert [f for f in fs if f.rule_id == 'SL011'], fs
+
+
+def test_sl011_fused_stage_boundary_reduce_is_silent():
+    mesh = _plan_mesh((2, 2, 2), ('data', 'model', 'pipe'))
+
+    def good(x):
+        x = lax.pmean(x * 2.0, 'model')
+        return lax.psum(x, ('pipe', 'data')) / 2.0
+
+    fs = _plan_target(good, (jnp.zeros((4,)),), mesh,
+                      plan_axes=('data', 'model', 'pipe'))
+    assert not [f for f in fs if f.rule_id == 'SL011'], fs
+
+
+def test_sl002_pipe_ring_bijective_passes_and_broken_ring_fires():
+    # the 1F1B handoff permutation [(i, (i+1) % S)] is a bijection --
+    # SL002 passes "for free"; a duplicated destination fires
+    mesh = _plan_mesh((2, 2, 2), ('data', 'model', 'pipe'))
+
+    def ring(x):
+        out = lax.ppermute(x, 'pipe', [(0, 1), (1, 0)])
+        out = out + lax.psum(x, ('pipe', 'data'))
+        return lax.pmean(out * 2.0, 'model')
+
+    fs = _plan_target(ring, (jnp.zeros((4,)),), mesh,
+                      plan_axes=('data', 'model', 'pipe'))
+    assert not [f for f in fs if f.rule_id == 'SL002'], fs
+
+    def broken(x):
+        return lax.ppermute(x, 'pipe', [(0, 1), (1, 1)])
+
+    fs = _plan_target(broken, (jnp.zeros((4,)),), mesh,
+                      plan_axes=('data', 'model', 'pipe'))
+    assert [f for f in fs if f.rule_id == 'SL002'], fs
+
+
+def test_transformer_pp_targets_lint_clean():
+    # the real 3-D pipeline steps are the SL010-family clean state in
+    # the f32 sweep here (the bf16 sweep rides run_staticcheck.sh,
+    # which pins both precisions)
+    for maker in (targets_mod.transformer_pp_step_target,
+                  targets_mod.transformer_tp_pp_step_target):
+        target = maker()
+        assert target.plan_axes == ('data', 'model', 'pipe')
+        fs = analysis.lint_target(target)
+        assert fs == [], (target.name, fs)
+
+
 def test_sl010_family_silent_without_plan_axes():
     # the hierarchical-style staged reduction is DELIBERATE on
     # single-axis strategies: without a declared plan the family

@@ -1,0 +1,312 @@
+"""The unified dp x tp x pp pipeline path (ISSUE 14).
+
+Acceptance pins: ``TransformerLM`` trained 1F1B through the REAL
+:class:`chainermn_tpu.training.MeshPipelineUpdater` on CPU meshes
+``(2, 1, 2)``, ``(1, 2, 2)`` and the ``(2, 2, 1)`` pp-fallback
+matches the single-device oracle trajectory (rtol 1e-5 f32 /
+5e-2 bf16) with the whole schedule inside ONE jit (trace count flat
+across steps); the old-signature :class:`PipelineUpdater` keeps
+working as a shim over the same machinery; the 1f1b collective guard
+admits conjugate-discipline tp psums and still rejects everything
+else.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from chainermn_tpu.models import (TransformerLM, lm_loss,
+                                  pipeline_parts,
+                                  pipeline_stage_specs)
+from chainermn_tpu.parallel.meshplan import MeshPlan
+from chainermn_tpu.precision import Policy
+from chainermn_tpu.training import MeshPipelineUpdater
+from chainermn_tpu.training.pipeline_updater import (
+    PipelineUpdater, pipeline_mesh)
+
+SEQ = 16
+VOCAB = 64
+N_STEPS = 3
+
+
+def _tiny_lm(dtype=jnp.float32):
+    return TransformerLM(vocab_size=VOCAB, d_model=32, n_heads=4,
+                         n_layers=2, d_ff=64, max_len=SEQ,
+                         dtype=dtype)
+
+
+def _data(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    toks = rng.randint(0, VOCAB, (n, SEQ)).astype(np.int32)
+    return toks, np.roll(toks, -1, axis=1).astype(np.int32)
+
+
+def _oracle_losses(model, params, toks, tgts, policy=None):
+    """Single-device full-batch sgd trajectory (the unsharded truth
+    every mesh shape must reproduce).  Under a policy the oracle
+    applies the same master-weight contract as the updaters: f32
+    masters, compute-dtype cast inside the differentiated loss."""
+    loss_fn = lm_loss(lambda p, t: model.apply({'params': p}, t))
+    opt = optax.sgd(0.1, momentum=0.9)
+    if policy is not None:
+        from chainermn_tpu.precision import cast_floating
+        params = cast_floating(params, policy.param_dtype)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(p, s):
+        def wrapped(pp):
+            cp = policy.cast_to_compute(pp) if policy else pp
+            loss, _ = loss_fn(cp, jnp.asarray(toks),
+                              jnp.asarray(tgts))
+            return loss.astype(jnp.float32)
+
+        loss, g = jax.value_and_grad(wrapped)(p)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s, loss
+
+    out = []
+    for _ in range(N_STEPS):
+        params, state, loss = step(params, state)
+        out.append(float(loss))
+    return out
+
+
+def _pp_updater(model, params, plan, n_micro, policy=None):
+    tp_axis = plan.model_axis if plan.model_size > 1 else None
+    stage_fn, prologue, loss_on_last, stacked, extra = pipeline_parts(
+        model, params, n_stages=plan.pipe_size, local_loss=True,
+        tp_axis=tp_axis)
+    specs = pipeline_stage_specs(stacked, pipe_axis=plan.pipe_axis,
+                                 tp_axis=tp_axis)
+    return MeshPipelineUpdater(
+        iter([]), optax.sgd(0.1, momentum=0.9), stage_fn,
+        loss_on_last, stacked, plan, n_micro=n_micro,
+        prologue=prologue, extra_params=extra, param_specs=specs,
+        policy=policy, donate=False)
+
+
+def _plans():
+    devs = jax.devices()
+    return [
+        ('dp2_pp2', MeshPlan.create(tp=1, pp=2, devices=devs[:4])),
+        ('tp2_pp2', MeshPlan.create(tp=2, pp=2, devices=devs[:4])),
+        # the pp-fallback shape: pipe axis present at size 1 (the
+        # shape-only degradation contract -- same program, no stages)
+        ('tp2_pp1', MeshPlan.create(tp=2, pp=1, devices=devs[:4])),
+    ]
+
+
+class TestOracleParity:
+    """The ISSUE 14 acceptance pin: every mesh shape reproduces the
+    single-device trajectory through the real updater, one jit."""
+
+    @pytest.mark.parametrize('name,plan', _plans())
+    def test_f32_matches_oracle(self, name, plan):
+        model = _tiny_lm()
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, SEQ), jnp.int32))['params']
+        toks, tgts = _data()
+        oracle = _oracle_losses(model, params, toks, tgts)
+        upd = _pp_updater(model, params, plan, n_micro=2)
+        batch = [(toks[i], tgts[i]) for i in range(len(toks))]
+        losses = [float(upd.update_core(upd.shard_batch(batch))
+                        ['loss']) for _ in range(N_STEPS)]
+        np.testing.assert_allclose(oracle, losses, rtol=1e-5)
+        # the whole 1F1B ladder is ONE compiled program: no step
+        # after the first may retrace
+        assert upd.trace_count == 1, upd.trace_count
+
+    def test_bf16_matches_oracle(self):
+        policy = Policy.bf16()
+        model = _tiny_lm(dtype=jnp.bfloat16)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, SEQ), jnp.int32))['params']
+        toks, tgts = _data()
+        oracle = _oracle_losses(model, params, toks, tgts,
+                                policy=policy)
+        plan = MeshPlan.create(tp=2, pp=2,
+                               devices=jax.devices()[:4])
+        upd = _pp_updater(model, params, plan, n_micro=2,
+                          policy=policy)
+        batch = [(toks[i], tgts[i]) for i in range(len(toks))]
+        losses = [float(upd.update_core(upd.shard_batch(batch))
+                        ['loss']) for _ in range(N_STEPS)]
+        np.testing.assert_allclose(oracle, losses, rtol=5e-2)
+        assert upd.trace_count == 1
+
+    def test_final_params_match_oracle(self):
+        # beyond losses: the updated parameter trees agree leaf for
+        # leaf after N steps (stage tree re-assembled from the plan)
+        model = _tiny_lm()
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.zeros((1, SEQ), jnp.int32))['params']
+        toks, tgts = _data()
+        loss_fn = lm_loss(
+            lambda p, t: model.apply({'params': p}, t))
+        opt = optax.sgd(0.1, momentum=0.9)
+        state = opt.init(params)
+        p_ref = params
+
+        @jax.jit
+        def step(p, s):
+            (_, _), g = jax.value_and_grad(
+                lambda pp: loss_fn(pp, jnp.asarray(toks),
+                                   jnp.asarray(tgts)),
+                has_aux=True)(p)
+            u, s = opt.update(g, s, p)
+            return optax.apply_updates(p, u), s
+
+        for _ in range(N_STEPS):
+            p_ref, state = step(p_ref, state)
+
+        plan = MeshPlan.create(tp=2, pp=2, devices=jax.devices()[:4])
+        upd = _pp_updater(model, params, plan, n_micro=2)
+        batch = [(toks[i], tgts[i]) for i in range(len(toks))]
+        for _ in range(N_STEPS):
+            upd.update_core(upd.shard_batch(batch))
+        # stage-stacked body leaves: (S, L/S, ...) vs block_i trees
+        n_per = model.n_layers // plan.pipe_size
+        for i in range(model.n_layers):
+            s, j = divmod(i, n_per)
+            got = jax.tree_util.tree_map(lambda a: a[s][j],
+                                         upd.params)
+            want = p_ref['block_%d' % i]
+            for a, b in zip(jax.tree_util.tree_leaves(want),
+                            jax.tree_util.tree_leaves(got)):
+                np.testing.assert_allclose(
+                    np.asarray(a), np.asarray(b), rtol=1e-4,
+                    atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(p_ref['embed']['embedding']),
+            np.asarray(upd.extra['embedding']), rtol=1e-4, atol=1e-5)
+
+
+class TestShim:
+    """The deprecation-shim satellite: the old constructor signature
+    over a bare (data, stage) mesh keeps working, both schedules, and
+    its 1f1b trajectory is IDENTICAL to the unified plan path (they
+    are the same machinery)."""
+
+    @staticmethod
+    def _mlp_pieces():
+        dim = 8
+        rng = np.random.RandomState(0)
+        params = [{'w': jnp.asarray(rng.randn(dim, dim) * 0.5,
+                                    jnp.float32),
+                   'b': jnp.asarray(rng.randn(dim) * 0.1,
+                                    jnp.float32)}
+                  for _ in range(2)]
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p['w'] + p['b'])
+
+        def loss_on_last(outs, y_micro):
+            loss = jnp.mean((outs - y_micro) ** 2)
+            return loss, {'mse': loss}
+
+        x = jnp.asarray(rng.randn(8, dim), jnp.float32)
+        y = jnp.asarray(rng.randn(8, dim), jnp.float32)
+        return params, stage_fn, loss_on_last, x, y
+
+    @pytest.mark.parametrize('schedule', ['gpipe', '1f1b'])
+    def test_old_signature_matches_unified_path(self, schedule):
+        from chainermn_tpu.parallel.pipeline import stack_stage_params
+        params, stage_fn, loss_on_last, x, y = self._mlp_pieces()
+        stacked = stack_stage_params(params)
+        batch = [(np.asarray(x[i]), np.asarray(y[i]))
+                 for i in range(len(x))]
+
+        old = PipelineUpdater(
+            iter([]), optax.sgd(0.1), stage_fn, loss_on_last,
+            stacked, pipeline_mesh(2, devices=jax.devices()[:4]),
+            n_micro=2, donate=False, schedule=schedule)
+        plan = MeshPlan.create(tp=1, pp=2, devices=jax.devices()[:4])
+        new = MeshPipelineUpdater(
+            iter([]), optax.sgd(0.1), stage_fn, loss_on_last,
+            stacked, plan, n_micro=2, donate=False,
+            schedule=schedule)
+        l_old = [float(old.update_core(old.shard_batch(batch))
+                       ['loss']) for _ in range(3)]
+        l_new = [float(new.update_core(new.shard_batch(batch))
+                       ['loss']) for _ in range(3)]
+        np.testing.assert_allclose(l_old, l_new, rtol=1e-6)
+        assert old.trace_count == new.trace_count == 1
+
+    def test_plan_without_pipe_axis_rejected(self):
+        params, stage_fn, loss_on_last, _x, _y = self._mlp_pieces()
+        from chainermn_tpu.parallel.pipeline import stack_stage_params
+        with pytest.raises(ValueError, match='pipeline axis'):
+            MeshPipelineUpdater(
+                iter([]), optax.sgd(0.1), stage_fn, loss_on_last,
+                stack_stage_params(params), MeshPlan.create(tp=2),
+                n_micro=2)
+
+
+class TestCollectiveGuard:
+    """1f1b safety under tp: conjugate-discipline model-axis psums
+    are admitted; any other collective still fails loudly."""
+
+    def test_data_axis_collective_still_rejected(self):
+        from jax import lax
+        plan = MeshPlan.create(tp=2, pp=2, devices=jax.devices()[:4])
+        dim = 8
+        stacked = {'w': jnp.zeros((2, dim, dim), jnp.float32)}
+
+        def bad_stage(p, x):
+            return jnp.tanh(x @ p['w']) + lax.pmean(x, 'data')
+
+        def loss_on_last(outs, y_micro):
+            loss = jnp.mean((outs - y_micro) ** 2)
+            return loss, {}
+
+        upd = MeshPipelineUpdater(
+            iter([]), optax.sgd(0.1), bad_stage, loss_on_last,
+            stacked, plan, n_micro=2, donate=False)
+        x = jnp.zeros((4, dim), jnp.float32)
+        with pytest.raises(ValueError, match='collective'):
+            upd.update_core(upd.shard_batch(
+                [(np.zeros((dim,), np.float32),
+                  np.zeros((dim,), np.float32)) for _ in range(4)]))
+        del x
+
+    def test_param_specs_off_tp_axis_rejected(self):
+        from jax.sharding import PartitionSpec as P
+        plan = MeshPlan.create(tp=1, pp=2, devices=jax.devices()[:4])
+        dim = 8
+        stacked = {'w': jnp.zeros((2, dim, dim), jnp.float32)}
+        with pytest.raises(ValueError, match='tp_axis'):
+            MeshPipelineUpdater(
+                iter([]), optax.sgd(0.1),
+                lambda p, x: x @ p['w'],
+                lambda o, y: (jnp.mean((o - y) ** 2), {}),
+                stacked, plan, n_micro=2,
+                param_specs={'w': P('pipe', None, 'data')})
+
+
+def test_stage_specs_and_pipeline_stage_specs_agree():
+    # MeshPlan.stage_specs(body_specs=...) and the transformer-aware
+    # pipeline_stage_specs produce the same placement family: every
+    # leaf leads with pipe and tp entries sit on the Megatron dims
+    from jax.sharding import PartitionSpec as P
+    plan = MeshPlan.create(tp=2, pp=2, devices=jax.devices()[:4])
+    model = _tiny_lm()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, SEQ), jnp.int32))['params']
+    _sf, _pro, _ll, stacked, _extra = pipeline_parts(
+        model, params, n_stages=2, local_loss=True,
+        tp_axis=plan.model_axis)
+    specs = pipeline_stage_specs(stacked, pipe_axis=plan.pipe_axis,
+                                 tp_axis=plan.model_axis)
+    leaves = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda v: isinstance(v, P))
+    assert all(tuple(sp)[0] == 'pipe' for sp in leaves)
+    assert any('model' in tuple(sp) for sp in leaves)
+    # local shapes divide cleanly on the plan (the placement is real)
+    for (kp, leaf), sp in zip(
+            jax.tree_util.tree_flatten_with_path(stacked)[0],
+            leaves):
+        plan.local_shape(leaf.shape, sp)

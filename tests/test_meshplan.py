@@ -65,14 +65,96 @@ class TestCreate:
             assert plan.axis_names == ('data', 'model')
             assert (plan.data_size, plan.model_size) == shape
 
-    def test_pp_slot_reserved(self):
+    def test_pp_builds_3d_mesh(self):
+        # the previously reserved pp= slot is live (ISSUE 14): a 3-D
+        # (data, model, pipe) mesh, pipe MINOR so the 1F1B stage
+        # handoff rides neighbor links
+        plan = MeshPlan.create(tp=2, pp=2)
+        assert plan.axis_names == ('data', 'model', 'pipe')
+        assert (plan.data_size, plan.model_size,
+                plan.pipe_size) == (2, 2, 2)
+        assert plan.pipe_axis == 'pipe'
+        d = plan.describe()
+        assert d['effective_pp'] == 2 and d['requested_pp'] == 2
+
+    def test_pp_none_keeps_2d_mesh(self):
+        # back-compat: without a pp request the plan stays 2-D
+        assert MeshPlan.create(tp=2).axis_names == ('data', 'model')
+        assert MeshPlan.create(tp=2, pp=1).axis_names == (
+            'data', 'model', 'pipe')
+
+    def test_pp_degradation_shape_only(self):
+        # tp clamps first, pp within what remains, axis NAMES stable
+        # (the 3-D extension of the SNIPPETS [2] contract)
+        import jax as _jax
+        devs = _jax.devices()
+        # 1 device -> (1, 1, 1)
+        plan1 = MeshPlan.create(tp=4, pp=4, devices=devs[:1])
+        assert plan1.axis_names == ('data', 'model', 'pipe')
+        assert (plan1.data_size, plan1.model_size,
+                plan1.pipe_size) == (1, 1, 1)
+        # tp * pp > n: both clamp to what fits
+        plan2 = MeshPlan.create(tp=4, pp=4, devices=devs[:4])
+        assert (plan2.data_size, plan2.model_size,
+                plan2.pipe_size) == (1, 4, 1)
+        # prime count -> pure data parallelism, axes intact
+        plan3 = MeshPlan.create(tp=2, pp=2, devices=devs[:7])
+        assert (plan3.data_size, plan3.model_size,
+                plan3.pipe_size) == (7, 1, 1)
+        # prime REMAINDER degrades the later (pipe) axis to 1
+        plan4 = MeshPlan.create(tp=2, pp=2, devices=devs[:6])
+        assert (plan4.data_size, plan4.model_size,
+                plan4.pipe_size) == (3, 2, 1)
+        # non-divisible stage count clamps down, not up
+        plan5 = MeshPlan.create(tp=1, pp=3, devices=devs[:8])
+        assert (plan5.data_size, plan5.model_size,
+                plan5.pipe_size) == (4, 1, 2)
+        assert plan5.requested_pp == 3
+
+    def test_stage_specs_place_stages_on_pipe(self):
+        from jax.sharding import PartitionSpec
+        plan = MeshPlan.create(tp=1, pp=2)
+        stacked = {'w': jnp.zeros((2, 4, 4)), 'b': jnp.zeros((2, 4))}
+        specs = plan.stage_specs(stacked)
+        assert specs == {'w': P('pipe'), 'b': P('pipe')}
+        body = {'w': PartitionSpec(None, 'model'),
+                'b': PartitionSpec()}
+        specs = plan.stage_specs(stacked, body_specs=body)
+        assert specs['w'] == P('pipe', None, 'model')
+        assert specs['b'] == P('pipe')
+        with pytest.raises(ValueError):
+            MeshPlan.create(tp=2).stage_specs(stacked)
+
+    def test_ep_expert_plan(self):
+        # the expert-axis on-ramp: a (data, expert) mesh whose expert
+        # axis carries the MoE all_to_all; spec handout shards the
+        # expert-stacked weights, replicates the router
+        plan = MeshPlan.create(ep=4)
+        assert plan.axis_names == ('data', 'expert')
+        assert plan.expert_size == 4
+        assert plan.data_size == jax.device_count() // 4
+        assert plan.model_size == 1      # no model axis on ep plans
+        params = {'router': jnp.zeros((8, 4)),
+                  'w_in': jnp.zeros((4, 8, 16)),
+                  'w_out': jnp.zeros((4, 16, 8))}
+        specs = plan.expert_param_specs(params)
+        assert specs == {'router': P(), 'w_in': P('expert'),
+                         'w_out': P('expert')}
+        assert plan.describe()['effective_ep'] == 4
+        # comm contract unchanged: dp reduction spans data only
+        assert plan.communicator().data_axes == ('data',)
         with pytest.raises(NotImplementedError):
-            MeshPlan.create(tp=2, pp=2)
-        assert MeshPlan.create(tp=2, pp=1).model_size == 2
+            MeshPlan.create(tp=2, ep=2)
+        with pytest.raises(NotImplementedError):
+            MeshPlan.create(pp=2, ep=2)
 
     def test_bad_tp_rejected(self):
         with pytest.raises(ValueError):
             MeshPlan.create(tp=0)
+        with pytest.raises(ValueError):
+            MeshPlan.create(tp=2, pp=0)
+        with pytest.raises(ValueError):
+            MeshPlan.create(ep=0)
 
 
 # ---------------------------------------------------------------------
@@ -359,6 +441,60 @@ class TestUpdaterThreading:
                           ['loss']) for _ in range(2)]
 
         np.testing.assert_allclose(run(False), run(True), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------
+# the expert-axis on-ramp (ISSUE 14 satellite): MoELayer's all_to_all
+# over a MeshPlan.create(ep=...) mesh, parity-pinned against the
+# dense one-hot dispatch oracle
+
+def test_meshplan_ep_moe_matches_dense_dispatch_reference():
+    from chainermn_tpu.parallel.moe import (
+        MoELayer, _route, dense_dispatch_reference)
+
+    plan = MeshPlan.create(ep=4)          # (data 2, expert 4) on 8
+    assert (plan.data_size, plan.expert_size) == (2, 4)
+    n_experts, d_model, d_ff, t_local = 4, 8, 16, 8
+    layer = MoELayer(axis=plan.expert_axis, capacity_factor=2.0)
+    params = layer.init_params(jax.random.PRNGKey(1), d_model, d_ff,
+                               n_experts_total=n_experts,
+                               n_devices=plan.expert_size)
+    specs = plan.expert_param_specs(params)
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(plan.size * t_local, d_model),
+                    jnp.float32)
+
+    def f(p, xx):
+        y, aux = layer(p, xx)
+        return y, aux['dropped_fraction']
+
+    y, dropped = jax.jit(jax.shard_map(
+        f, mesh=plan.mesh,
+        in_specs=(specs, P(('data', 'expert'))),
+        out_specs=(P(('data', 'expert')), P()),
+        check_vma=False))(params, x)
+    y = np.asarray(y)
+
+    # oracle: per device (= per local token block), route + dispatch
+    # through the dense one-hot reference at the layer's own capacity
+    # and combine per token -- exactly what the sorted + all_to_all
+    # path must reproduce, drops included
+    capacity = max(1, int(2.0 * t_local // n_experts))
+    for dev in range(plan.size):
+        xd = x[dev * t_local:(dev + 1) * t_local]
+        probs, idx, gate = _route(params, xd, 1)
+        _in, _combine, keep = dense_dispatch_reference(
+            xd, idx[:, 0], n_experts, capacity)
+        h = jnp.maximum(
+            jnp.einsum('td,edf->tef', xd, params['w_in']), 0)
+        out = jnp.einsum('tef,efd->ted', h, params['w_out'])
+        picked = jnp.take_along_axis(out, idx[:, :, None],
+                                     axis=1)[:, 0]
+        want = (picked * (gate[:, 0] * keep)[:, None])
+        np.testing.assert_allclose(
+            y[dev * t_local:(dev + 1) * t_local], np.asarray(want),
+            rtol=1e-4, atol=1e-5)
+    assert 0.0 <= float(dropped) <= 1.0
 
 
 def test_divisor_leq():

@@ -762,3 +762,97 @@ class TestRequestTracePrimitives:
         text = rep_mod.render_text(report)
         assert 'request traces: 1' in text
         assert 'worst request r9' in text
+
+
+# ---------------------------------------------------------------------
+# pipeline bubble fraction (ISSUE 14): the pipe-axis row of the
+# per-axis story -- schedule events stamped at trace time turn into
+# per-stage bubble fractions in the merged report, and "more
+# microbatches -> smaller bubble" is a pinned property, not a slide.
+
+class TestPipelineBubble:
+    def test_bubble_fraction_bounds_and_monotonicity(self):
+        from chainermn_tpu.parallel.pipeline import bubble_fraction
+        for schedule in ('gpipe', '1f1b'):
+            prev = None
+            for m in (1, 2, 4, 8, 16, 64):
+                b = bubble_fraction(m, 4, schedule)
+                assert 0.0 <= b < 1.0
+                if prev is not None:
+                    assert b < prev, (schedule, m, b, prev)
+                prev = b
+        # one stage: gpipe has no bubble; the combined 1f1b scan
+        # still pays its single turnaround tick (1 / (M + 1))
+        assert bubble_fraction(8, 1, 'gpipe') == 0.0
+        assert abs(bubble_fraction(8, 1, '1f1b') - 1.0 / 9.0) < 1e-12
+
+    def test_pipeline_summary_from_events(self):
+        events = [
+            {'type': 'event', 'kind': 'pipeline',
+             'name': 'pipeline:schedule', 'schedule': '1f1b',
+             'n_micro': 2, 'n_stages': 2, 'total_ticks': 5,
+             'axes': ['pipe']},
+            # duplicate compile of the same config: deduped
+            {'type': 'event', 'kind': 'pipeline',
+             'name': 'pipeline:schedule', 'schedule': '1f1b',
+             'n_micro': 2, 'n_stages': 2, 'total_ticks': 5,
+             'axes': ['pipe']},
+            # torn/garbage record: skipped, not fatal
+            {'type': 'event', 'kind': 'pipeline',
+             'name': 'pipeline:schedule', 'n_micro': 'x'},
+        ]
+        rows = rep_mod.pipeline_summary(events)
+        assert len(rows) == 1
+        row = rows[0]
+        assert row['axis'] == 'pipe' and row['n_stages'] == 2
+        per_stage = row['bubble_fraction_per_stage']
+        assert len(per_stage) == row['n_stages']
+        assert all(0.0 <= b <= 1.0 for b in per_stage)
+        assert rep_mod.pipeline_summary([]) is None
+
+    def test_capture_bubble_strictly_decreases_2_to_8(self, tmp_path):
+        # the acceptance pin: REAL captures of the unified pipeline
+        # step at n_micro 2 and 8 over the SAME global batch -- the
+        # reported bubble fraction must strictly shrink
+        from chainermn_tpu.parallel.pipeline import stack_stage_params
+        from chainermn_tpu.parallel.meshplan import MeshPlan
+        from chainermn_tpu.training import MeshPipelineUpdater
+
+        dim = 8
+        rs = np.random.RandomState(0)
+        stacked = stack_stage_params(
+            [{'w': jnp.asarray(rs.randn(dim, dim) * 0.5,
+                               jnp.float32)} for _ in range(2)])
+
+        def stage_fn(p, x):
+            return jnp.tanh(x @ p['w'])
+
+        def loss_on_last(outs, y_micro):
+            return jnp.mean((outs - y_micro) ** 2), {}
+
+        batch = [(rs.randn(dim).astype(np.float32),
+                  rs.randn(dim).astype(np.float32))
+                 for _ in range(16)]
+        bubbles = {}
+        for n_micro in (2, 8):
+            out = tmp_path / ('m%d' % n_micro)
+            rec = telemetry.enable(str(out))
+            plan = MeshPlan.create(tp=1, pp=2,
+                                   devices=jax.devices()[:4])
+            upd = MeshPipelineUpdater(
+                iter([]), optax.sgd(0.1), stage_fn, loss_on_last,
+                stacked, plan, n_micro=n_micro, donate=False)
+            upd.update_core(upd.shard_batch(batch))
+            rec.flush()
+            telemetry.disable()
+            report = rep_mod.build_report(str(out))
+            (row,) = report['pipeline']
+            assert row['schedule'] == '1f1b'
+            assert row['axis'] == 'pipe'
+            assert row['n_micro'] == n_micro
+            assert all(0.0 <= b <= 1.0
+                       for b in row['bubble_fraction_per_stage'])
+            bubbles[n_micro] = row['bubble_fraction']
+            text = rep_mod.render_text(report)
+            assert 'bubble fraction' in text
+        assert bubbles[8] < bubbles[2], bubbles

@@ -135,6 +135,29 @@ def test_divisor_leq_degenerate():
     assert mesh_utility.divisor_leq(12, 5) == 4
 
 
+def test_divisors_leq_3d_degenerate():
+    # the 3-D extension MeshPlan.create(tp=, pp=) leans on: each
+    # requested width clamps in priority order within the devices
+    # still unclaimed, so the product always divides n
+    import pytest
+    # 1 device -> (1, 1): the (1, 1, 1) mesh
+    assert mesh_utility.divisors_leq(1, (4, 4)) == (1, 1)
+    # exact fit
+    assert mesh_utility.divisors_leq(8, (2, 2)) == (2, 2)
+    # tp * pp > n: both clamp (tp has priority)
+    assert mesh_utility.divisors_leq(4, (4, 4)) == (4, 1)
+    assert mesh_utility.divisors_leq(8, (4, 4)) == (4, 2)
+    # prime device count -> pure data parallelism
+    assert mesh_utility.divisors_leq(7, (2, 2)) == (1, 1)
+    # prime REMAINDER degrades the later (pipe) axis only
+    assert mesh_utility.divisors_leq(6, (2, 2)) == (2, 1)
+    # non-divisible stage count clamps DOWN, never up
+    assert mesh_utility.divisors_leq(8, (1, 3)) == (1, 2)
+    assert mesh_utility.divisors_leq(12, (2, 5)) == (2, 3)
+    with pytest.raises(ValueError):
+        mesh_utility.divisors_leq(0, (1, 1))
+
+
 def test_single_device_builds_1x1_mesh_with_stable_axis_names():
     devs = [FakeDev(id=0, process_index=0)]
     assert mesh_utility.detect_topology(devs) == (1, 1)
