@@ -188,6 +188,13 @@ def metric_stub(model):
         # lookup at bench_serve_<model>_rN.out
         return {'metric': '%s_requests_per_sec_per_chip' % model,
                 'unit': 'req/sec/chip'}
+    if model.startswith('loader_'):
+        # the streaming input-pipeline arm (--loader): streamed
+        # samples through the real train step, A/B'd against the
+        # device-resident feed (docs/data_pipeline.md)
+        return {'metric': '%s_streamed_samples_per_sec_per_chip'
+                          % model,
+                'unit': 'samples/sec/chip'}
     unit = {'seq2seq': 'tokens/sec/chip',
             'transformer': 'tokens/sec/chip',
             'mlp': 'images/sec/chip'}.get(model, 'images/sec/chip')
@@ -2053,6 +2060,144 @@ def measure_recovery(argv):
         shutil.rmtree(out, ignore_errors=True)
 
 
+#: loader-row sidecars (--loader): the input-pipeline A/B's
+#: vocabulary -- the device-resident twin, the streamed/resident
+#: efficiency ratio, H2D overlap and loader-pressure percentiles
+LOADER_SIDECAR_KEYS = (
+    'device_resident_samples_per_s', 'loader_efficiency',
+    'h2d_overlap_fraction', 'data_queue_depth_p50',
+    'data_worker_busy_fraction', 'corrupt_skipped')
+
+
+def measure_loader(argv):
+    """``--loader``: the streamed-vs-device-resident A/B row
+    (ISSUE 15).
+
+    Runs the SAME ``update_core`` training loop twice -- once fed the
+    pre-sharded device-resident arrays every bench arm uses, once fed
+    real record shards through
+    :class:`~chainermn_tpu.data.StreamingLoader` (decode thread pool)
+    composed with ``DevicePrefetchIterator`` (double-buffered
+    ``device_put``) -- and reports streamed samples/s/chip as the
+    value with the resident twin, their ratio
+    (``loader_efficiency``: 1.0 = the pipeline fully hides under the
+    step), the measured H2D overlap fraction (telemetry interval
+    intersection of ``host_batch_prep``/``h2d`` spans vs
+    ``jitted_step``), and the loader-pressure gauges
+    (queue-depth p50, worker busy fraction)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    quick = '--quick' in argv
+    on_cpu = '--cpu' in argv
+    model = parse_model(argv)
+    if model not in ('resnet50', 'mlp'):
+        emit(dict(metric_stub('loader_' + model), value=0.0,
+                  error='unsupported_model',
+                  detail='--loader supports resnet50/mlp'), rc=1)
+    n_workers = int(_flag_value(argv, '--loader-workers', 2))
+    prefetch = int(_flag_value(argv, '--loader-prefetch', 2))
+    steps = 6 if quick else 24
+    warm = 2
+
+    import jax
+
+    from chainermn_tpu import telemetry
+    from chainermn_tpu.data import (ShardSet, StreamingLoader,
+                                    write_examples)
+    from chainermn_tpu.telemetry.report import (load_rank_logs,
+                                                overlap_from_intervals)
+    from chainermn_tpu.training.iterators import DevicePrefetchIterator
+
+    cfg = BUILDERS[model](quick, on_cpu)
+    upd, arrays, batch = cfg['upd'], cfg['arrays'], cfg['items']
+
+    def timed_loop(next_batch):
+        for _ in range(warm):
+            upd.update_core(next_batch())
+        jax.block_until_ready(upd.params)
+        t0 = time.monotonic()
+        for _ in range(steps):
+            upd.update_core(next_batch())
+        jax.block_until_ready(upd.params)
+        return time.monotonic() - t0
+
+    # A: device-resident feed (every other bench arm's regime)
+    _log('loader A/B: device-resident %d steps of %d samples'
+         % (steps, batch))
+    wall_res = timed_loop(lambda: arrays)
+    resident_sps = batch * steps / wall_res / jax.device_count()
+
+    # B: streamed shards through the full pipeline, telemetry on so
+    # the overlap fraction is measured, not inferred
+    shard_dir = tempfile.mkdtemp(prefix='bench_loader_shards.')
+    tele_dir = tempfile.mkdtemp(prefix='bench_loader_tele.')
+    try:
+        rng = np.random.RandomState(7)
+        n = batch * 3
+        if model == 'mlp':
+            examples = [(rng.rand(784).astype(np.float32),
+                         np.int32(rng.randint(10)))
+                        for _ in range(n)]
+        else:
+            insize = cfg['insize']
+            examples = [
+                (rng.rand(insize, insize, 3).astype(np.float32),
+                 np.int32(rng.randint(1000))) for _ in range(n)]
+        paths = write_examples(examples, shard_dir,
+                               n_shards=max(2, n_workers))
+        loader = StreamingLoader(
+            ShardSet(paths), batch, size=1, rank=0, seed=11,
+            n_workers=n_workers, prefetch=prefetch)
+        rec = telemetry.enable(tele_dir)
+        it = DevicePrefetchIterator(loader, upd.shard_batch,
+                                    depth=prefetch)
+        _log('loader A/B: streamed %d steps (%d workers, prefetch %d)'
+             % (steps, n_workers, prefetch))
+        try:
+            wall_str = timed_loop(lambda: next(it))
+        finally:
+            it.finalize()
+            rec.flush()
+            telemetry.disable()
+        streamed_sps = batch * steps / wall_str / jax.device_count()
+
+        _, spans, _, _ = load_rank_logs(tele_dir)
+        input_iv = [(s['t0'], s['t1']) for s in spans
+                    if s.get('name') in ('host_batch_prep', 'h2d')]
+        compute_iv = [(s['t0'], s['t1']) for s in spans
+                      if s.get('name') == 'jitted_step']
+        ov = overlap_from_intervals(input_iv, compute_iv)
+        depth = sorted(loader.depth_samples)
+        result = dict(
+            metric_stub('loader_' + model),
+            value=round(streamed_sps, 3),
+            vs_baseline=round(streamed_sps / max(resident_sps, 1e-9),
+                              4),
+            device_resident_samples_per_s=round(resident_sps, 3),
+            loader_efficiency=round(
+                streamed_sps / max(resident_sps, 1e-9), 4),
+            h2d_overlap_fraction=ov['overlap_fraction'],
+            data_queue_depth_p50=(
+                float(depth[len(depth) // 2]) if depth else None),
+            data_worker_busy_fraction=round(loader.busy_fraction(), 4),
+            corrupt_skipped=loader.corrupt_skipped,
+            loader_workers=n_workers,
+            loader_prefetch=prefetch,
+            batch=batch, steps=steps, quick=quick,
+            backend=jax.default_backend(),
+            device_kind=jax.devices()[0].device_kind,
+            n_devices=jax.device_count(),
+        )
+        loader.finalize()
+        emit(result, rc=0)
+    finally:
+        shutil.rmtree(shard_dir, ignore_errors=True)
+        shutil.rmtree(tele_dir, ignore_errors=True)
+
+
 #: serve-row sidecar fields carried through backend_unavailable
 #: windows (the serving twin of BANKED_SIDECAR_KEYS)
 SERVE_SIDECAR_KEYS = (
@@ -2571,6 +2716,29 @@ def main():
         # self-contained CPU-subprocess scenario: no backend probe,
         # no watchdog child (the supervisor bounds its own attempts)
         measure_recovery(argv)
+        return
+    if '--loader' in argv:
+        # the streaming input-pipeline arm: same probe/child/banked
+        # conventions, keyed on the 'loader_<model>' metric family
+        family = 'loader_' + parse_model(argv)
+        if '--child' in argv:
+            measure_loader([a for a in argv if a != '--child'])
+            return
+        if '--cpu' not in argv:
+            ok = probe_backend()
+            if ok is not True:
+                row = dict(metric_stub(family), value=0.0,
+                           vs_baseline=0.0,
+                           error='backend_unavailable', detail=ok)
+                brow, banked, tag, src = banked_last_good_row(family)
+                if banked is not None:
+                    row.update(banked_value=banked, banked_round=tag,
+                               banked_source=src)
+                    for key in LOADER_SIDECAR_KEYS:
+                        if brow.get(key) is not None:
+                            row['banked_' + key] = brow[key]
+                emit(row, rc=1)
+        run_child(argv, family)
         return
     if '--serve' in argv:
         # serving arms: same probe/child/banked-row conventions as

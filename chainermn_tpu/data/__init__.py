@@ -1,0 +1,16 @@
+"""``chainermn_tpu.data`` -- sharded streaming input pipeline.
+
+The production front door for training (ROADMAP item 5): a
+record-shard on-disk format with typed integrity
+(:mod:`~chainermn_tpu.data.recordio`), and a host-side streaming
+loader whose global sample stream is a deterministic function of
+``(seed, epoch)`` alone -- never of topology -- with an exact
+elastic-resume stream cursor (:mod:`~chainermn_tpu.data.loader`).
+See ``docs/data_pipeline.md``.
+"""
+
+from chainermn_tpu.data.recordio import (  # noqa: F401
+    ShardReader, ShardSet, ShardWriter, decode_example,
+    encode_example, index_path, read_index, write_examples)
+from chainermn_tpu.data.loader import (  # noqa: F401
+    StreamingLoader, epoch_stream, stream_order)

@@ -343,6 +343,14 @@ def updater_state(updater):
         'epoch': updater.epoch,
         'epoch_detail': float(getattr(updater, 'epoch_detail', 0.0)),
     }
+    # streaming-loader cursor (chainermn_tpu.data): the EXACT global
+    # stream position, so an N->M elastic resume replays the
+    # remaining sample sequence with no repeats and no drops --
+    # epoch_detail alone only lands "nearby" after rounding
+    cursor = getattr(getattr(updater, 'iterator', None),
+                     'stream_cursor', None)
+    if cursor is not None:
+        state['stream_cursor'] = int(cursor)
     if getattr(updater, 'model_state', None) is not None:
         state['model_state'] = updater.model_state
     if getattr(updater, 'extra', None) is not None:
@@ -377,19 +385,29 @@ def gather_replicated(tree, mesh):
     return jax.tree_util.tree_unflatten(treedef, flat)
 
 
-def restore_counters(updater, iteration, epoch=0, epoch_detail=None):
+def restore_counters(updater, iteration, epoch=0, epoch_detail=None,
+                     stream_cursor=None):
     """Restore the step counter and the iterator's epoch position.
 
-    Elastic rule: when ``epoch_detail`` is available and the iterator
-    supports ``restore_position``, the GLOBAL fraction of the epoch
-    consumed is preserved -- re-expressed at the CURRENT topology's
-    shard length (``dataset.epoch_position``); otherwise the integer
-    epoch is restored as before."""
+    Elastic rules, most-exact first: when the snapshot carries a
+    ``stream_cursor`` and the iterator supports ``restore_cursor``
+    (the streaming loader), the EXACT global stream position is
+    restored -- the cursor is topology-free, so an N->M resume
+    replays the identical remaining sample sequence; else when
+    ``epoch_detail`` is available and the iterator supports
+    ``restore_position``, the GLOBAL fraction of the epoch consumed
+    is preserved -- re-expressed at the CURRENT topology's shard
+    length (``dataset.epoch_position``); otherwise the integer epoch
+    is restored as before."""
     updater.iteration = int(iteration)
     it = getattr(updater, 'iterator', None)
     if it is None:
         return
-    if epoch_detail is not None and hasattr(it, 'restore_position'):
+    if stream_cursor is not None and hasattr(it, 'restore_cursor'):
+        base = (int(float(epoch_detail)) if epoch_detail is not None
+                else int(epoch))
+        it.restore_cursor(base, int(stream_cursor))
+    elif epoch_detail is not None and hasattr(it, 'restore_position'):
         it.restore_position(float(epoch_detail))
     elif hasattr(it, 'restore_epoch'):
         it.restore_epoch(int(epoch))
@@ -474,9 +492,11 @@ def _restore_state(updater, by_key, manifest, path, elastic=True,
     if scale is not None:
         updater.scale_state = place(scale, updater.scale_state)
     detail = by_key.get('epoch_detail')
+    cursor = by_key.get('stream_cursor')
     restore_counters(updater, by_key['iteration'],
                      by_key.get('epoch', 0),
-                     None if detail is None else float(detail))
+                     None if detail is None else float(detail),
+                     None if cursor is None else int(cursor))
     return {'iteration': updater.iteration, 'resharded': resharded,
             'manifest': manifest}
 

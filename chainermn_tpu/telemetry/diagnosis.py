@@ -55,9 +55,10 @@ import json
 import os
 
 from chainermn_tpu.telemetry.report import (
-    SERVE_PHASES, STEP_PHASES, exposed_time, load_rank_logs,
-    load_rank_metrics, aggregate_metrics, merge_intervals,
-    request_summary, serve_summary, _percentile)
+    SERVE_PHASES, STEP_PHASES, exposed_time, input_bound_stats,
+    load_rank_logs, load_rank_metrics, aggregate_metrics,
+    merge_intervals, request_summary, serve_summary, step_table,
+    _percentile)
 
 #: phases the within-run anomaly scan pools samples for: the training
 #: step phases plus the serve-batch phases (``serve_execute`` spans
@@ -738,6 +739,20 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
             % (worst['request_id'], worst['e2e_ms'],
                ', '.join('%s %.3f' % (k, v) for k, v
                          in worst['stage_ms'].items())))
+    input_bound = input_bound_stats(step_table(spans))
+    if input_bound is not None and input_bound['input_bound']:
+        # the input twin of the straggler-phase attribution: the
+        # dominating phase is host-side batch prep, so the fix is
+        # loader capacity (workers/prefetch), not the device
+        summary.append(
+            'input-bound: rank %d host_batch_prep p50 %.1f ms >= '
+            'jitted_step p50 %.1f ms (%.0f%% of the step) -- scale '
+            'the streaming loader (n_workers/prefetch), the device '
+            'is idle waiting on data'
+            % (input_bound['rank'],
+               input_bound['host_batch_prep_p50_ms'],
+               input_bound['jitted_step_p50_ms'],
+               input_bound['input_fraction'] * 100))
     if healthy:
         summary.append('no cross-rank skew, stragglers, anomalies or '
                        'deaths detected')
@@ -753,6 +768,7 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
         'collective_skew': skew,
         'stragglers': stragglers,
         'step_anomalies': anomalies,
+        'input_bound': input_bound,
         'crash': crash,
         'verdict': {
             'healthy': healthy,

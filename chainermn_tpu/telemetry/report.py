@@ -28,9 +28,12 @@ import re
 from chainermn_tpu.telemetry.recorder import (
     _percentile, snapshot_to_prometheus)
 
-#: span names the per-step table columns come from (issue order)
-STEP_PHASES = ('host_batch_prep', 'h2d', 'jitted_step',
-               'metrics_sync')
+#: span names the per-step table columns come from (issue order);
+#: ``data_decode`` is the streaming loader's per-batch decode span
+#: (``chainermn_tpu/data/loader.py``) -- it rides the same table so
+#: the doctor's straggler-phase attribution covers the input path
+STEP_PHASES = ('data_decode', 'host_batch_prep', 'h2d',
+               'jitted_step', 'metrics_sync')
 
 #: serve-phase vocabulary (``chainermn_tpu/serving``): per-batch
 #: spans/events the engine emits and the registry histograms of the
@@ -277,6 +280,60 @@ def step_table(spans):
         row[s['name'] + '_ms'] = round((s['t1'] - s['t0']) * 1e3, 3)
         row['t0'] = min(row['t0'], s['t0'])
     return [rows[k] for k in sorted(rows)]
+
+
+#: per-step input-side phases charged against the device step by the
+#: input-bound verdict (decode overlaps prep when the loader runs
+#: under a prefetch iterator, so prep -- the span on the consuming
+#: thread -- is the charged one; data_decode is reported alongside)
+INPUT_PHASES = ('host_batch_prep',)
+
+
+def input_bound_stats(steps, warmup=1):
+    """The input-bound verdict of a training capture: per-rank p50 of
+    the input-side phases (``host_batch_prep``) vs the device step
+    (``jitted_step``), worst rank reported.  ``input_bound`` is True
+    when input prep's p50 meets or exceeds the step's -- the loader,
+    not the device, is pacing the run.  The first ``warmup``
+    iterations are exempt per (phase, rank), mirroring the doctor's
+    compile-step discipline.  ``None`` when the capture has no
+    step-phase rows to judge."""
+    per_rank = {}
+    for row in steps:
+        if int(row.get('iteration', 0)) < warmup:
+            continue
+        d = per_rank.setdefault(int(row.get('rank', 0)),
+                                {'prep': [], 'step': [],
+                                 'decode': []})
+        prep = sum(row.get(p + '_ms', 0.0) for p in INPUT_PHASES)
+        if prep > 0.0:
+            d['prep'].append(prep)
+        if 'jitted_step_ms' in row:
+            d['step'].append(row['jitted_step_ms'])
+        if 'data_decode_ms' in row:
+            d['decode'].append(row['data_decode_ms'])
+    worst = None
+    for rank, d in sorted(per_rank.items()):
+        if not d['prep'] or not d['step']:
+            continue
+        prep50 = _percentile(sorted(d['prep']), 0.50)
+        step50 = _percentile(sorted(d['step']), 0.50)
+        frac = prep50 / max(prep50 + step50, 1e-9)
+        cand = {
+            'rank': rank,
+            'host_batch_prep_p50_ms': round(prep50, 3),
+            'jitted_step_p50_ms': round(step50, 3),
+            'data_decode_p50_ms': (
+                round(_percentile(sorted(d['decode']), 0.50), 3)
+                if d['decode'] else None),
+            'input_fraction': round(frac, 4),
+            'n_steps': len(d['step']),
+            'input_bound': prep50 >= step50,
+        }
+        if worst is None or cand['input_fraction'] > \
+                worst['input_fraction']:
+            worst = cand
+    return worst
 
 
 def pipeline_summary(events):
@@ -582,6 +639,7 @@ def build_report(outdir):
     report['serve'] = serve_summary(report['metrics'])
     report['requests'] = request_summary(spans + events)
     report['pipeline'] = pipeline_summary(events)
+    report['input_bound'] = input_bound_stats(steps)
     return report
 
 
@@ -615,6 +673,26 @@ def render_text(report, max_steps=24):
         lines.append('jitted step: %d samples, p50 %.3f ms, '
                      'p99 %.3f ms' % (st['count'], st['p50'],
                                       st['p99']))
+    ib = report.get('input_bound')
+    if ib is not None:
+        if ib['input_bound']:
+            lines.append(
+                'INPUT-BOUND: rank %d host_batch_prep p50 %.3f ms >= '
+                'jitted_step p50 %.3f ms (%.0f%% of the step) -- the '
+                'input pipeline, not the device, paces this run; '
+                'scale decode workers/prefetch '
+                '(docs/data_pipeline.md)'
+                % (ib['rank'], ib['host_batch_prep_p50_ms'],
+                   ib['jitted_step_p50_ms'],
+                   ib['input_fraction'] * 100))
+        else:
+            lines.append(
+                'input: host_batch_prep p50 %.3f ms vs jitted_step '
+                'p50 %.3f ms (rank %d, %.0f%% of the step) -- not '
+                'input-bound'
+                % (ib['host_batch_prep_p50_ms'],
+                   ib['jitted_step_p50_ms'], ib['rank'],
+                   ib['input_fraction'] * 100))
     ov = report['overlap']
     if ov['overlap_fraction'] is None:
         lines.append('overlap: no collective spans in capture')

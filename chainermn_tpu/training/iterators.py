@@ -375,6 +375,7 @@ class DevicePrefetchIterator(_PrefetchingIterator):
         self.is_new_epoch = False
         self._consumed_detail = float(getattr(inner, 'epoch_detail',
                                               0.0))
+        self._consumed_cursor = getattr(inner, 'stream_cursor', None)
 
     def _produce(self):
         inner = self._source
@@ -383,11 +384,13 @@ class DevicePrefetchIterator(_PrefetchingIterator):
         return (placed, getattr(inner, 'epoch', 0),
                 getattr(inner, 'iteration', 0),
                 getattr(inner, 'is_new_epoch', False),
-                float(getattr(inner, 'epoch_detail', 0.0)))
+                float(getattr(inner, 'epoch_detail', 0.0)),
+                getattr(inner, 'stream_cursor', None))
 
     def __next__(self):
-        placed, self.epoch, self.iteration, self.is_new_epoch, \
-            self._consumed_detail = self._next_item()
+        (placed, self.epoch, self.iteration, self.is_new_epoch,
+         self._consumed_detail, self._consumed_cursor) = \
+            self._next_item()
         return placed
 
     next = __next__
@@ -395,6 +398,15 @@ class DevicePrefetchIterator(_PrefetchingIterator):
     @property
     def epoch_detail(self):
         return self._consumed_detail
+
+    @property
+    def stream_cursor(self):
+        """The streaming loader's elastic cursor AS CONSUMED (the
+        producer reads ahead; checkpoints must reflect what the train
+        loop actually took -- same contract as ``epoch_detail``).
+        ``None`` over inner iterators without a cursor, which makes
+        ``serializers.updater_state`` skip the field entirely."""
+        return self._consumed_cursor
 
     def reset(self):
         self._stop_worker()
@@ -414,6 +426,22 @@ class DevicePrefetchIterator(_PrefetchingIterator):
         # epoch/epoch_detail agree in the first post-resume log entry
         self.epoch = int(epoch)
         self._consumed_detail = float(int(epoch))
+        self._start_worker()
+
+    def restore_cursor(self, epoch, cursor):
+        """Exact elastic restore (streaming loader inner): position
+        the inner stream at global ``(epoch, cursor)`` and rebase the
+        consumer-side counters, discarding pre-restore read-ahead.
+        Only meaningful when the inner iterator supports it
+        (``serializers.restore_counters`` probes with hasattr, and
+        this method is only present via delegation)."""
+        if not hasattr(self.inner, 'restore_cursor'):
+            # cursor saved by a different pipeline shape: degrade to
+            # the epoch-boundary restore rather than crash the resume
+            return self.restore_position(float(int(epoch)))
+        self._stop_worker()
+        self.inner.restore_cursor(int(epoch), int(cursor))
+        self._rebase_counters()
         self._start_worker()
 
     def restore_position(self, epoch_detail):

@@ -310,9 +310,11 @@ def _resume_orbax(updater, path, it):
     if 'scale_state' in state and state['scale_state'] is not None:
         updater.scale_state = place(state['scale_state'],
                                     updater.scale_state)
+    cursor = state.get('stream_cursor')
     serializers.restore_counters(
         updater, state['iteration'], state.get('epoch', 0),
-        state.get('epoch_detail'))
+        state.get('epoch_detail'),
+        None if cursor is None else int(cursor))
     return updater.iteration
 
 

@@ -111,6 +111,18 @@ Sites (the action is part of the site name):
                     restores full speed.  The canary gate's
                     breach-then-rollback scenario is driven by
                     exactly this site
+``data_stall``      sleep ARG (default 0.05) seconds before a shard
+                    record read (``chainermn_tpu/data/recordio.py``)
+                    -- a slow/contended filesystem; the loader's
+                    prefetch depth must hide it, and the telemetry
+                    report's input-bound line must surface it when
+                    it cannot
+``data_corrupt``    XOR-flip ARG (default 4) spread bytes of a just-
+                    read record payload BEFORE its crc check -- bit
+                    rot on the data path; the reader must reject it
+                    with a typed ``failure.DataCorruptError``
+                    (kind=crc, shard+offset named) and the loader
+                    must skip-and-count it, never silently consume
 ==================  ====================================================
 
 Example -- drop the first publish, delay half the rest, stall the
@@ -132,7 +144,8 @@ ENV_VAR = 'CHAINERMN_TPU_CHAOS'
 SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
          'nan_batch', 'sigterm_step', 'kill_step', 'hang_step',
          'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip',
-         'serve_burst', 'serve_cancel', 'swap_kill', 'serve_slow')
+         'serve_burst', 'serve_cancel', 'swap_kill', 'serve_slow',
+         'data_stall', 'data_corrupt')
 
 
 class InjectedFault(RuntimeError):
@@ -512,6 +525,38 @@ def on_serve_cancel():
     if r is None:
         return 0
     return max(1, int(r.arg) if r.arg is not None else 1)
+
+
+def on_data_read():
+    """``data_stall``: sleep before one shard record read (a slow or
+    contended filesystem on the input path)."""
+    inj = _active
+    if inj is None:
+        return
+    r = inj.fires('data_stall')
+    if r is not None:
+        time.sleep(r.arg if r.arg is not None else 0.05)
+
+
+def corrupt_record(payload):
+    """``data_corrupt``: XOR-flip ARG (default 4) evenly-spaced bytes
+    of a just-read record payload BEFORE the reader's crc check --
+    silent bit rot on the data path, which the crc must catch and
+    type as ``DataCorruptError(kind='crc')``.  Returns the (possibly
+    new) payload; never mutates the caller's bytes."""
+    inj = _active
+    if inj is None:
+        return payload
+    r = inj.fires('data_corrupt')
+    if r is None or not payload:
+        return payload
+    n = max(1, int(r.arg) if r.arg is not None else 4)
+    blob = bytearray(payload)
+    size = len(blob)
+    for i in range(n):
+        off = min(size - 1, (size * (i + 1)) // (n + 1))
+        blob[off] ^= 0xFF
+    return bytes(blob)
 
 
 def corrupt_batch(arrays):

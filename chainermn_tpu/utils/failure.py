@@ -128,6 +128,40 @@ class CheckpointCorruptError(ValueError):
                      path=path, leaf=leaf, corruption_kind=kind)
 
 
+class DataCorruptError(ValueError):
+    """An input record failed integrity verification and must NOT be
+    consumed: a flipped byte caught by the record crc32, a record
+    extending past the shard's EOF (torn file), or a missing/
+    unparseable index sidecar.
+
+    The input-data member of the failure taxonomy (see
+    ``docs/data_pipeline.md``): where
+    :class:`CheckpointCorruptError` makes *state* failure typed, this
+    makes *data* failure typed -- the streaming loader catches it to
+    SKIP AND COUNT the sample (``corrupt_skipped`` +
+    ``data_corrupt_skipped`` telemetry events) instead of silently
+    training on poison or dying inside zipfile internals.
+
+    ``shard`` names the file, ``offset`` the byte offset and
+    ``record`` the in-shard record index (when identifiable);
+    ``kind`` classifies the defect: ``'crc'`` | ``'truncated'`` |
+    ``'unreadable'``.  Subclasses ``ValueError`` to mirror
+    :class:`CheckpointCorruptError`'s compatibility contract."""
+
+    status_name = 'CMN_DATA_CORRUPT'
+
+    def __init__(self, message, shard=None, offset=None, record=None,
+                 kind=None):
+        super().__init__(message)
+        self.shard = shard
+        self.offset = offset
+        self.record = record
+        self.kind = kind
+        _flight_dump('DataCorruptError', message=str(message),
+                     shard=shard, offset=offset, record=record,
+                     corruption_kind=kind)
+
+
 class OverloadError(CommFailure):
     """The serving admission layer REFUSED work instead of wedging:
     the bounded request queue is full, or a request's deadline expired

@@ -301,6 +301,15 @@ run bench_transformer_tp $QT python bench.py --model transformer --quick --tp 2
 # apply through outages like every transformer row)
 run bench_transformer_pp $QT python bench.py --model transformer --quick --pp 2
 
+# --- streaming input pipeline (docs/data_pipeline.md) ----------------
+# streamed-vs-device-resident A/B on the resnet50 step: the value is
+# streamed samples/s/chip, with the resident twin, the
+# loader_efficiency ratio (1.0 = decode + H2D fully hidden under the
+# step), the telemetry-measured h2d_overlap_fraction and the
+# queue-depth p50 as sidecars -- every other row in this round feeds
+# device-resident arrays; this one prices the production feed path.
+run bench_resnet50_loader $QT python bench.py --loader --model resnet50 --quick
+
 # --- serving arms (docs/serving.md) ----------------------------------
 # AFTER the training headline + the re-queued b128/b256/best rungs on
 # purpose: the training MFU chase is the round's primary unbanked

@@ -1,0 +1,306 @@
+"""Worker for the streaming-loader multi-process scenarios
+(ISSUE 15): real ``jax.distributed`` CPU processes train an MLP fed
+by :class:`chainermn_tpu.data.StreamingLoader` over REAL record
+shards, with per-rank sample-id ledgers appended fsynced so they
+survive kills.
+
+Two launch modes, one train loop:
+
+- ``CMN_MP_*`` (driven by ``tests/test_data_mp.py``'s spawn
+  harness): scenario ``stream_elastic`` -- SIGTERM mid-epoch at N
+  procs via the chaos injector, exact-cursor resume at M procs, the
+  fixed-topology oracle (losses AND id stream) computed
+  chaos-shielded in the resume phase;
+- ``CMN_SUP_*`` (driven by ``python -m chainermn_tpu.supervisor``):
+  the convergence-under-chaos worker -- heartbeats into the live
+  dir, auto-resumes the shared checkpoint dir elastically, trains to
+  a target loss while the supervisor heals injected deaths, and
+  leaves through ``worker_main``'s typed exit codes.
+
+The data is deterministic and LEARNABLE (labels are a fixed linear
+rule of the inputs), so "reaches the target loss" is a real
+convergence claim, not noise.
+"""
+
+import json
+import os
+import sys
+
+N_TOTAL = 48        # epoch id set: range(48)
+GLOBAL_BATCH = 12   # divisible by every pod shape used (2,3 procs x 2)
+N_SHARDS = 3
+SEED = 5
+LOCAL_DEVICES = 2
+
+
+def make_examples():
+    """The deterministic learnable dataset: y = argmax(x @ W_true)."""
+    import numpy as np
+    rs = np.random.RandomState(1234)
+    xs = rs.randn(N_TOTAL, 8).astype(np.float32)
+    w_true = np.random.RandomState(77).randn(8, 4).astype(np.float32)
+    ys = np.argmax(xs @ w_true, axis=1).astype(np.int32)
+    return [(xs[i], ys[i]) for i in range(N_TOTAL)]
+
+
+def ensure_shards(dirpath):
+    """Write the shard set if absent (atomic commits make a restart's
+    rewrite harmless; every rank writes its OWN directory so there
+    are no cross-rank file races)."""
+    from chainermn_tpu.data import ShardSet, write_examples
+    import glob
+    if not sorted(glob.glob(os.path.join(dirpath, '*.rec'))):
+        write_examples(make_examples(), dirpath, n_shards=N_SHARDS)
+    return ShardSet.from_dir(dirpath)
+
+
+def build_train(comm, loader):
+    import jax
+    import numpy as np
+    import optax
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, classifier_loss
+
+    model = MLP(n_units=16, n_out=4)
+    params0 = model.init(jax.random.PRNGKey(0),
+                         np.zeros((1, 8), np.float32))['params']
+    loss_fn = classifier_loss(
+        lambda p, x: model.apply({'params': p}, x))
+    opt = chainermn_tpu.create_multi_node_optimizer(
+        optax.sgd(0.2, momentum=0.9), comm)
+    upd = training.StandardUpdater(
+        loader, opt, loss_fn, params0, comm, has_aux=True,
+        donate=False)
+    jax.block_until_ready((upd.params, upd.opt_state))
+    return upd
+
+
+def step_streamed(upd, loader, comm):
+    """One step over the loader's LOCAL slice of the global batch,
+    placed multihost-safe, every output materialized (keeps each
+    rank's gloo collective stream strictly sequential)."""
+    import jax
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    batch = next(loader)
+    xs = np.stack([np.asarray(b[0]) for b in batch])
+    ys = np.stack([np.asarray(b[1]) for b in batch])
+    sh = NamedSharding(comm.mesh, comm.batch_spec())
+    gx = jax.make_array_from_process_local_data(
+        sh, xs, (GLOBAL_BATCH, 8))
+    gy = jax.make_array_from_process_local_data(
+        sh, ys, (GLOBAL_BATCH,))
+    metrics = upd.update_core((gx, gy))
+    jax.block_until_ready((upd.params, upd.opt_state))
+    return float(np.asarray(jax.device_get(  # noqa: shardlint
+        metrics['loss'])))
+
+
+def make_loader(shards, nprocs, rank, ledger_path=None):
+    from chainermn_tpu.data import StreamingLoader
+    return StreamingLoader(
+        shards, GLOBAL_BATCH, size=nprocs, rank=rank, seed=SEED,
+        n_workers=2, prefetch=2, ledger_path=ledger_path)
+
+
+def oracle_run(rank, nprocs, comm, shard_dir, steps):
+    """The fixed-topology oracle at THIS world size: fresh loader +
+    updater stepped ``steps`` times uninterrupted, chaos-shielded.
+    Returns (losses, ledger entries, final param sum)."""
+    import jax
+    import numpy as np
+    from chainermn_tpu.utils import chaos
+    saved = chaos.active()
+    chaos.uninstall()
+    try:
+        loader = make_loader(ensure_shards(shard_dir), nprocs, rank)
+        upd = build_train(comm, loader)
+        losses = [step_streamed(upd, loader, comm)
+                  for _ in range(steps)]
+        psum = float(sum(
+            np.asarray(jax.device_get(leaf)).sum()  # noqa: shardlint
+            for leaf in jax.tree_util.tree_leaves(upd.params)))
+        ledger = list(loader.ledger)
+        loader.finalize()
+        return losses, ledger, psum
+    finally:
+        if saved is not None:
+            chaos.install(saved)
+
+
+# ----------------------------------------------------------------------
+# CMN_MP_* mode: stream_elastic (SIGTERM mid-epoch, N -> M resume)
+# ----------------------------------------------------------------------
+
+def mp_main():
+    rank = int(os.environ['CMN_MP_RANK'])
+    nprocs = int(os.environ['CMN_MP_NPROCS'])
+    port = os.environ['CMN_MP_PORT']
+    outdir = os.environ['CMN_MP_OUT']
+    phase = os.environ.get('CMN_MP_PHASE', 'first')
+    steps = int(os.environ.get('CMN_MP_STEPS', '8'))
+
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = (
+        '--xla_force_host_platform_device_count=%d' % LOCAL_DEVICES)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    jax.distributed.initialize(
+        coordinator_address='localhost:' + port,
+        num_processes=nprocs, process_id=rank)
+
+    import chainermn_tpu
+    from chainermn_tpu.training import recovery
+    from chainermn_tpu.utils import chaos
+
+    chaos.maybe_install_from_env()
+    comm = chainermn_tpu.create_communicator(
+        'xla', mesh_shape=(nprocs, LOCAL_DEVICES))
+    shard_dir = os.path.join(outdir, 'shards-rank%d' % rank)
+    ckdir = os.path.join(outdir, 'stream_state')
+    res = {'rank': rank, 'world': nprocs, 'phase': phase}
+
+    loader = make_loader(ensure_shards(shard_dir), nprocs, rank)
+    upd = build_train(comm, loader)
+    handler = recovery.PreemptionHandler(upd, out=ckdir, method='npz')
+
+    if phase == 'resume':
+        res['oracle'], res['oracle_ledger'], res['oracle_param_sum'] \
+            = oracle_run(rank, nprocs, comm, shard_dir, steps)
+        res['resumed_at'] = recovery.auto_resume(upd, ckdir)
+        res['resume_state'] = loader.state()
+
+    losses = []
+    while upd.iteration < steps:
+        losses.append(step_streamed(upd, loader, comm))
+        if handler.maybe_checkpoint():
+            res['preempted_at'] = upd.iteration
+            res['preempt_state'] = loader.state()
+            break
+    res['losses'] = losses
+    res['final_iteration'] = upd.iteration
+    res['ledger'] = list(loader.ledger)
+    import numpy as np
+    res['param_sum'] = float(sum(
+        np.asarray(jax.device_get(leaf)).sum()  # noqa: shardlint
+        for leaf in jax.tree_util.tree_leaves(upd.params)))
+    loader.finalize()
+    with open(os.path.join(outdir, 'rank%d.json' % rank), 'w') as f:
+        json.dump(res, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+# ----------------------------------------------------------------------
+# CMN_SUP_* mode: convergence-under-chaos (supervised worker)
+# ----------------------------------------------------------------------
+
+def supervised_worker():
+    from chainermn_tpu.training import supervisor as sup
+
+    rank = int(os.environ[sup.ENV_RANK])
+    nprocs = int(os.environ[sup.ENV_NPROCS])
+    port = os.environ[sup.ENV_PORT]
+    out = os.environ[sup.ENV_OUT]
+    attempt = int(os.environ.get(sup.ENV_ATTEMPT, '0'))
+    steps = int(os.environ.get(sup.ENV_STEPS, '16'))
+    ckpt_every = int(os.environ.get(sup.ENV_CKPT_EVERY, '2'))
+    live = os.environ.get(sup.ENV_LIVE) or os.path.join(out, 'live')
+    ndev = int(os.environ.get(sup.ENV_LOCAL_DEVICES,
+                              str(LOCAL_DEVICES)))
+    target = float(os.environ.get('CMN_DATA_TARGET_LOSS', '1.25'))
+
+    os.environ['JAX_PLATFORMS'] = 'cpu'
+    os.environ['XLA_FLAGS'] = (
+        '--xla_force_host_platform_device_count=%d' % ndev)
+    import jax
+    jax.config.update('jax_platforms', 'cpu')
+    jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    jax.distributed.initialize(
+        coordinator_address='localhost:' + port,
+        num_processes=nprocs, process_id=rank)
+
+    import numpy as np
+    import chainermn_tpu
+    from chainermn_tpu import serializers, telemetry
+    from chainermn_tpu.training import recovery
+    from chainermn_tpu.utils import failure
+
+    comm = chainermn_tpu.create_communicator(
+        'xla', mesh_shape=(nprocs, ndev))
+    shard_dir = os.path.join(out, 'shards-rank%d' % rank)
+    ledger_dir = os.path.join(out, 'ledgers')
+    os.makedirs(ledger_dir, exist_ok=True)
+    loader = make_loader(
+        ensure_shards(shard_dir), nprocs, rank,
+        ledger_path=os.path.join(
+            ledger_dir, 'a%d-rank%d.jsonl' % (attempt, rank)))
+    upd = build_train(comm, loader)
+
+    ckdir = os.path.join(out, 'state')
+    handler = recovery.PreemptionHandler(upd, out=ckdir, method='npz')
+    hb = failure.Heartbeat(
+        os.path.join(live, 'heartbeat-%d.json' % rank),
+        interval=0.2).start()
+    res = {'rank': rank, 'attempt': attempt, 'world_size': nprocs,
+           'steps': steps, 'target_loss': target}
+    try:
+        resumed_at = recovery.auto_resume(upd, ckdir)
+        if resumed_at is None and recovery.snapshot_chain(ckdir):
+            raise failure.CheckpointCorruptError(
+                'restart found snapshots under %s but none valid -- '
+                'refusing to silently train from scratch' % ckdir,
+                path=ckdir, kind='crc')
+        res['resumed_at'] = resumed_at
+        res['resume_state'] = loader.state()
+        sup._write_worker_json(out, attempt, rank, res)  # early
+        hb.beat(upd.iteration)
+        losses = []
+        preempted = False
+        while upd.iteration < steps:
+            loss = step_streamed(upd, loader, comm)
+            losses.append(loss)
+            hb.beat(upd.iteration)
+            if handler.maybe_checkpoint():
+                preempted = True
+                break
+            # the loss is allreduced (metrics mean), so every rank
+            # sees the same value and stops in lockstep
+            if loss <= target and loader.epoch >= 1:
+                break
+            if (ckpt_every and upd.iteration < steps
+                    and upd.iteration % ckpt_every == 0):
+                handler.checkpoint()
+        res['losses'] = losses
+        res['final_loss'] = losses[-1] if losses else None
+        res['final_iteration'] = upd.iteration
+        res['epochs_completed'] = loader.epoch
+        res['corrupt_skipped'] = loader.corrupt_skipped
+        res['preempted'] = preempted
+        res['reached_target'] = bool(
+            losses and losses[-1] <= target)
+        res['param_sum'] = float(sum(
+            np.asarray(jax.device_get(leaf)).sum()  # noqa: shardlint
+            for leaf in jax.tree_util.tree_leaves(upd.params)))
+        sup._write_worker_json(out, attempt, rank, res)
+    finally:
+        hb.stop()
+        loader.finalize()
+    serializers.wait_checkpoints()
+    telemetry.flush()
+    return 'preempted' if preempted else None
+
+
+def main():
+    if os.environ.get('CMN_SUP_RANK') is not None:
+        from chainermn_tpu.training.supervisor import worker_main
+        worker_main(supervised_worker)  # never returns
+    mp_main()
+    sys.stdout.flush()
+
+
+if __name__ == '__main__':
+    main()

@@ -806,3 +806,42 @@ def test_new_sites_in_spec_grammar():
     # strip_sites (the supervisor/fleet consumed-fault accounting)
     assert chaos.strip_sites('swap_kill=@1;serve_slow=*:0.1',
                              ['swap_kill']) == 'serve_slow=*:0.1'
+
+
+def test_chaos_data_corruption_site_detected_typed(tmp_path):
+    """``data_corrupt`` flips record-payload bytes BEFORE the shard
+    reader's crc check (the input-data twin of the ckpt_flip test
+    above): the reader must reject with the typed
+    ``DataCorruptError(kind='crc')`` naming shard, record and byte
+    offset -- never hand back poisoned bytes."""
+    from chainermn_tpu.data import ShardReader, ShardWriter
+    path = str(tmp_path / 'd.rec')
+    with ShardWriter(path) as w:
+        w.append(b'record-zero-payload')
+    chaos.install(chaos.FaultInjector('data_corrupt=@0'))
+    try:
+        reader = ShardReader(path)
+        with pytest.raises(failure.DataCorruptError) as ei:
+            reader.read(0)
+        assert ei.value.kind == 'crc'
+        assert ei.value.shard == path and ei.value.record == 0
+        assert any(hit for _, _, hit in chaos.active().log)
+    finally:
+        chaos.uninstall()
+
+
+def test_chaos_data_stall_site_delays_read(tmp_path, monkeypatch):
+    """``data_stall`` sleeps before the shard read; the payload comes
+    back intact (a slow filesystem, not a corrupt one)."""
+    from chainermn_tpu.data import ShardReader, ShardWriter
+    path = str(tmp_path / 's.rec')
+    with ShardWriter(path) as w:
+        w.append(b'slow-but-sound')
+    slept = []
+    monkeypatch.setattr(chaos.time, 'sleep', slept.append)
+    chaos.install(chaos.FaultInjector('data_stall=@0:0.25'))
+    try:
+        assert ShardReader(path).read(0) == b'slow-but-sound'
+        assert slept == [0.25]
+    finally:
+        chaos.uninstall()

@@ -1,0 +1,554 @@
+"""Fast tier-1 coverage of ``chainermn_tpu.data`` (ISSUE 15): the
+record-shard format's typed integrity, the streaming loader's
+(seed, epoch)-only determinism contract, the exact elastic-resume
+cursor (simulated N -> M pods in-process), the cursor-edge cases the
+contract leans on, and the loader's observability (gauges, spans,
+the input-bound report line).  The real multi-process halves live in
+``tests/test_data_mp.py`` (slow)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.data import (ShardReader, ShardSet, ShardWriter,
+                                StreamingLoader, decode_example,
+                                encode_example, epoch_stream,
+                                read_index, stream_order,
+                                write_examples)
+from chainermn_tpu.utils import chaos, failure
+
+
+def _examples(n, dim=4, seed=0):
+    rs = np.random.RandomState(seed)
+    return [(rs.randn(dim).astype(np.float32),
+             np.int32(rs.randint(3))) for _ in range(n)]
+
+
+@pytest.fixture
+def shard_paths(tmp_path):
+    return write_examples(_examples(23), str(tmp_path / 'shards'),
+                          n_shards=4)
+
+
+def _collect_ids(loader, batches):
+    out = []
+    for _ in range(batches):
+        next(loader)
+    for e in loader.ledger:
+        out.append((e['epoch'], e['positions'], e['ids']))
+    return out
+
+
+# ----------------------------------------------------------------------
+# record-shard format
+# ----------------------------------------------------------------------
+
+class TestRecordShards:
+    def test_roundtrip_and_index_sidecar(self, tmp_path):
+        path = str(tmp_path / 'a.rec')
+        payloads = [b'alpha', b'bee', b'', b'x' * 1000]
+        with ShardWriter(path) as w:
+            for p in payloads:
+                w.append(p)
+        idx = read_index(path)
+        assert idx['n_records'] == 4 and idx['complete'] is True
+        r = ShardReader(path)
+        assert len(r) == 4
+        assert [r.read(i) for i in range(4)] == payloads
+
+    def test_example_codec_roundtrip(self):
+        ex = (np.arange(6, dtype=np.float32).reshape(2, 3),
+              np.int32(7))
+        back = decode_example(encode_example(ex))
+        np.testing.assert_array_equal(back[0], ex[0])
+        assert int(back[1]) == 7
+
+    def test_abandoned_writer_commits_nothing(self, tmp_path):
+        path = str(tmp_path / 'b.rec')
+        try:
+            with ShardWriter(path) as w:
+                w.append(b'partial')
+                raise RuntimeError('crash mid-write')
+        except RuntimeError:
+            pass
+        assert not os.path.exists(path)
+        assert not os.path.exists(path + '.idx')
+
+    def test_missing_sidecar_typed(self, shard_paths):
+        os.remove(shard_paths[0] + '.idx')
+        with pytest.raises(failure.DataCorruptError) as ei:
+            ShardReader(shard_paths[0])
+        assert ei.value.kind == 'unreadable'
+        assert ei.value.shard == shard_paths[0]
+
+    def test_flipped_byte_typed_crc(self, tmp_path):
+        path = str(tmp_path / 'c.rec')
+        with ShardWriter(path) as w:
+            w.append(b'payload-bytes-here')
+        blob = bytearray(open(path, 'rb').read())
+        blob[-3] ^= 0xFF
+        with open(path, 'wb') as f:
+            f.write(bytes(blob))
+        r = ShardReader(path)
+        with pytest.raises(failure.DataCorruptError) as ei:
+            r.read(0)
+        assert ei.value.kind == 'crc'
+        assert ei.value.record == 0 and ei.value.offset is not None
+
+    def test_truncated_typed(self, shard_paths):
+        path = shard_paths[1]
+        size = os.path.getsize(path)
+        with open(path, 'r+b') as f:
+            f.truncate(size - 10)
+        r = ShardReader(path)
+        with pytest.raises(failure.DataCorruptError) as ei:
+            for i in range(len(r)):
+                r.read(i)
+        assert ei.value.kind == 'truncated'
+        assert ei.value.shard == path
+
+    def test_shardset_global_index(self, shard_paths):
+        ss = ShardSet(shard_paths)
+        assert len(ss) == 23
+        # balanced split: 23 over 4 shards -> 5/6/6/6
+        assert sorted(ss.lengths) == [5, 6, 6, 6]
+        ex = decode_example(ss.read(0))
+        np.testing.assert_array_equal(ex[0], _examples(23)[0][0])
+        with pytest.raises(IndexError):
+            ss.read(23)
+
+    def test_zero_length_shard_in_set(self, tmp_path):
+        # 2 examples over 3 shards: the balanced split leaves the
+        # first shard empty (scatter_index semantics)
+        paths = write_examples(_examples(2), str(tmp_path), n_shards=3)
+        ss = ShardSet(paths)
+        assert len(ss) == 2
+        assert 0 in ss.lengths
+        for g in range(2):
+            decode_example(ss.read(g))
+
+
+# ----------------------------------------------------------------------
+# chaos sites (alongside the ckpt sites' discipline)
+# ----------------------------------------------------------------------
+
+class TestDataChaosSites:
+    def test_sites_registered_and_parse(self):
+        for site in ('data_stall', 'data_corrupt'):
+            assert site in chaos.SITES
+        seed, rank, rules = chaos.parse_spec(
+            'data_stall=p0.5:0.01;data_corrupt=@2:6')
+        assert rules['data_stall'].prob == 0.5
+        assert rules['data_corrupt'].at == frozenset([2])
+
+    def test_corrupt_record_deterministic_and_copying(self):
+        payload = bytes(range(64))
+        chaos.install(chaos.FaultInjector('data_corrupt=*'))
+        try:
+            a = chaos.corrupt_record(payload)
+            chaos.uninstall()
+            chaos.install(chaos.FaultInjector('data_corrupt=*'))
+            b = chaos.corrupt_record(payload)
+        finally:
+            chaos.uninstall()
+        assert a == b and a != payload
+        assert payload == bytes(range(64))  # caller's bytes untouched
+
+    def test_data_corrupt_is_skip_and_counted(self, shard_paths):
+        chaos.install(chaos.FaultInjector('data_corrupt=@1'))
+        try:
+            loader = StreamingLoader(ShardSet(shard_paths), 8,
+                                     size=1, rank=0, seed=0,
+                                     n_workers=1)
+            b1, b2 = next(loader), next(loader)
+        finally:
+            chaos.uninstall()
+            loader.finalize()
+        assert loader.corrupt_skipped == 1
+        assert len(b1) + len(b2) == 15  # one of 16 skipped, not fed
+        skipped = [e['skipped'] for e in loader.ledger if e['skipped']]
+        assert skipped == [loader.corrupt_ids]
+
+    def test_data_stall_delays_but_survives(self, shard_paths):
+        chaos.install(chaos.FaultInjector('data_stall=@0:0.01'))
+        try:
+            loader = StreamingLoader(ShardSet(shard_paths), 8,
+                                     size=1, rank=0, seed=0,
+                                     n_workers=1)
+            assert len(next(loader)) == 8
+            assert loader.corrupt_skipped == 0
+        finally:
+            chaos.uninstall()
+            loader.finalize()
+
+
+# ----------------------------------------------------------------------
+# determinism + exactly-once partition
+# ----------------------------------------------------------------------
+
+class TestStreamDeterminism:
+    def test_stream_order_function_of_seed_epoch_only(self):
+        a = stream_order(23, seed=3, epoch=1)
+        b = stream_order(23, seed=3, epoch=1)
+        np.testing.assert_array_equal(a, b)
+        assert not np.array_equal(a, stream_order(23, 3, 2))
+        assert not np.array_equal(a, stream_order(23, 4, 1))
+        np.testing.assert_array_equal(stream_order(5, 0, 0, False),
+                                      np.arange(5))
+
+    def test_two_loaders_identical_id_streams(self, shard_paths):
+        """The tier-1 determinism pin (ISSUE 15 CI satellite): two
+        independently constructed loaders at the same (seed, epoch,
+        topology) yield identical id streams."""
+        ls = [StreamingLoader(ShardSet(shard_paths), 8, size=1,
+                              rank=0, seed=3) for _ in range(2)]
+        try:
+            a = _collect_ids(ls[0], 6)
+            b = _collect_ids(ls[1], 6)
+        finally:
+            for l in ls:
+                l.finalize()
+        assert a == b
+        # and the ledger matches the declared oracle stream
+        oracle = epoch_stream(23, 3, 8, epoch=0)
+        got = [ids for ep, _, ids in a if ep == 0]
+        assert got == [o.tolist() for o in oracle]
+
+    def test_ranks_partition_each_global_batch(self, shard_paths):
+        loaders = [StreamingLoader(ShardSet(shard_paths), 8, size=3,
+                                   rank=r, seed=3) for r in range(3)]
+        try:
+            for _ in range(3):  # one epoch: 8 + 8 + 7
+                for l in loaders:
+                    next(l)
+        finally:
+            for l in loaders:
+                l.finalize()
+        posid = {}
+        for l in loaders:
+            for e in l.ledger:
+                for p, i in zip(e['positions'], e['ids']):
+                    assert posid.setdefault((e['epoch'], p), i) == i
+        assert {p for (_, p) in posid} == set(range(23))
+        assert sorted(i for (_, _p), i in
+                      zip(posid.keys(), posid.values())) \
+            == list(range(23))
+        assert all(l.epoch == 1 and l.is_new_epoch for l in loaders)
+
+    def test_global_stream_topology_independent(self, shard_paths):
+        """The same (seed, epoch) stream at 1, 2 and 3 simulated
+        processes -- merged, all three topologies consume identical
+        (position -> id) assignments."""
+        merged = []
+        for size in (1, 2, 3):
+            loaders = [StreamingLoader(ShardSet(shard_paths), 8,
+                                       size=size, rank=r, seed=9)
+                       for r in range(size)]
+            posid = {}
+            try:
+                for _ in range(3):
+                    for l in loaders:
+                        next(l)
+            finally:
+                for l in loaders:
+                    l.finalize()
+            for l in loaders:
+                for e in l.ledger:
+                    for p, i in zip(e['positions'], e['ids']):
+                        posid[(e['epoch'], p)] = i
+            merged.append(posid)
+        assert merged[0] == merged[1] == merged[2]
+
+
+# ----------------------------------------------------------------------
+# elastic resume: the exact-cursor contract
+# ----------------------------------------------------------------------
+
+class TestElasticCursor:
+    def test_n_to_m_resume_replays_exact_remaining_stream(
+            self, shard_paths):
+        """Consume 2 global batches at 3 procs, restore the cursor at
+        2 procs: the tail equals the uninterrupted oracle -- no
+        repeats, no drops."""
+        first = [StreamingLoader(ShardSet(shard_paths), 8, size=3,
+                                 rank=r, seed=3) for r in range(3)]
+        for _ in range(2):
+            for l in first:
+                next(l)
+        state = first[0].state()
+        assert state == {'epoch': 0, 'cursor': 16}
+        assert all(l.state() == state for l in first)
+        second = [StreamingLoader(ShardSet(shard_paths), 8, size=2,
+                                  rank=r, seed=3) for r in range(2)]
+        for l in second:
+            l.restore_cursor(state['epoch'], state['cursor'])
+        for l in second:
+            next(l)  # the final (partial, 7-sample) batch
+        head = sorted(i for l in first for e in l.ledger
+                      for i in e['ids'])
+        tail = sorted(i for l in second for e in l.ledger
+                      for i in e['ids'])
+        assert sorted(head + tail) == list(range(23))
+        oracle = np.concatenate(epoch_stream(23, 3, 8)).tolist()
+        assert sorted(head + tail) == sorted(oracle)
+        for l in first + second:
+            l.finalize()
+
+    def test_restore_position_fallback_agrees(self, shard_paths):
+        """A loader restored via the fractional epoch_detail (the
+        pre-cursor snapshot format) lands at the same position as
+        the exact cursor when the shard-set length is unchanged."""
+        a = StreamingLoader(ShardSet(shard_paths), 8, size=1, rank=0,
+                            seed=3)
+        next(a)
+        detail = a.epoch_detail
+        b = StreamingLoader(ShardSet(shard_paths), 8, size=1, rank=0,
+                            seed=3)
+        b.restore_position(detail)
+        assert b.state() == a.state()
+        assert b.remaining_ids().tolist() == a.remaining_ids().tolist()
+        a.finalize()
+        b.finalize()
+
+    def test_serial_iterator_resume_agreement(self, shard_paths):
+        """SerialIterator and the streaming loader restored at the
+        same (seed, epoch) epoch_detail agree on the epoch and the
+        epoch fraction -- the shared ``epoch_position`` contract."""
+        from chainermn_tpu.training.iterators import SerialIterator
+        loader = StreamingLoader(ShardSet(shard_paths), 8, size=1,
+                                 rank=0, seed=3)
+        next(loader)
+        detail = loader.epoch_detail
+        si = SerialIterator(list(range(23)), 8, seed=3)
+        si.restore_position(detail)
+        loader2 = StreamingLoader(ShardSet(shard_paths), 8, size=1,
+                                  rank=0, seed=3)
+        loader2.restore_position(detail)
+        assert si.epoch == loader2.epoch
+        assert abs(si.epoch_detail - loader2.epoch_detail) < 1e-9
+        loader.finalize()
+        loader2.finalize()
+
+    def test_shard_length_change_clamps_cursor(self, tmp_path):
+        """N->M resume onto a SHRUNK shard set: a saved cursor past
+        the new epoch length clamps to the boundary instead of
+        fabricating positions."""
+        paths = write_examples(_examples(6), str(tmp_path),
+                               n_shards=2)
+        loader = StreamingLoader(ShardSet(paths), 4, size=1, rank=0,
+                                 seed=0)
+        loader.restore_cursor(2, 50)
+        assert loader.state() == {'epoch': 2, 'cursor': 6}
+        batch = next(loader)  # rolls into epoch 3 cleanly
+        assert loader.epoch == 3 and len(batch) == 4
+        loader.finalize()
+
+    def test_zero_length_epoch_stops(self, tmp_path):
+        paths = write_examples([], str(tmp_path), n_shards=1)
+        loader = StreamingLoader(ShardSet(paths), 4, size=1, rank=0,
+                                 seed=0)
+        with pytest.raises(StopIteration):
+            next(loader)
+        assert loader.epoch_detail == 0.0
+        loader.finalize()
+
+    def test_final_partial_batch_and_drop_last(self, shard_paths):
+        # default: the 7-sample tail is emitted, balanced-split
+        loader = StreamingLoader(ShardSet(shard_paths), 8, size=2,
+                                 rank=0, seed=0)
+        sizes = [len(next(loader)) for _ in range(3)]
+        assert sizes == [4, 4, 3]  # rank 0 of global 8,8,7
+        assert loader.is_new_epoch and loader.epoch == 1
+        loader.finalize()
+        # drop_last: the tail is skipped, the epoch still rolls
+        loader = StreamingLoader(ShardSet(shard_paths), 8, size=1,
+                                 rank=0, seed=0, drop_last=True)
+        b1, b2 = next(loader), next(loader)
+        assert len(b1) == len(b2) == 8
+        assert loader.is_new_epoch and loader.epoch == 1
+        b3 = next(loader)  # first batch of epoch 1
+        assert len(b3) == 8
+        consumed_e0 = [i for e in loader.ledger if e['epoch'] == 0
+                       for i in e['ids']]
+        assert len(consumed_e0) == 16  # 7-sample tail dropped
+        loader.finalize()
+
+    def test_non_repeating_loader_exhausts(self, shard_paths):
+        loader = StreamingLoader(ShardSet(shard_paths), 8, size=1,
+                                 rank=0, seed=0, repeat=False)
+        sizes = [len(next(loader)) for _ in range(3)]
+        assert sizes == [8, 8, 7]
+        with pytest.raises(StopIteration):
+            next(loader)
+        loader.finalize()
+
+
+# ----------------------------------------------------------------------
+# updater-state integration (stream_cursor next to epoch_detail)
+# ----------------------------------------------------------------------
+
+class _StubUpdater:
+    def __init__(self, iterator):
+        self.params = {'w': np.zeros(2)}
+        self.opt_state = {'m': np.zeros(2)}
+        self.iteration = 3
+        self.iterator = iterator
+
+    @property
+    def epoch(self):
+        return self.iterator.epoch
+
+    @property
+    def epoch_detail(self):
+        return self.iterator.epoch_detail
+
+
+class TestUpdaterStateCursor:
+    def test_updater_state_carries_cursor(self, shard_paths):
+        from chainermn_tpu import serializers
+        loader = StreamingLoader(ShardSet(shard_paths), 8, size=1,
+                                 rank=0, seed=3)
+        next(loader)
+        st = serializers.updater_state(_StubUpdater(loader))
+        assert st['stream_cursor'] == 8
+        assert abs(st['epoch_detail'] - 8 / 23) < 1e-9
+        loader.finalize()
+
+    def test_updater_state_without_cursor_unchanged(self):
+        from chainermn_tpu import serializers
+        from chainermn_tpu.training.iterators import SerialIterator
+        st = serializers.updater_state(
+            _StubUpdater(SerialIterator(list(range(10)), 2)))
+        assert 'stream_cursor' not in st
+
+    def test_restore_counters_exact_cursor(self, shard_paths):
+        from chainermn_tpu import serializers
+        loader = StreamingLoader(ShardSet(shard_paths), 8, size=1,
+                                 rank=0, seed=3)
+        upd = _StubUpdater(loader)
+        serializers.restore_counters(upd, 7, epoch=1,
+                                     epoch_detail=1.0 + 16 / 23,
+                                     stream_cursor=16)
+        assert upd.iteration == 7
+        assert loader.state() == {'epoch': 1, 'cursor': 16}
+        loader.finalize()
+
+    def test_device_prefetch_cursor_is_consumer_side(
+            self, shard_paths):
+        from chainermn_tpu.training.iterators import (
+            DevicePrefetchIterator)
+        loader = StreamingLoader(ShardSet(shard_paths), 8, size=1,
+                                 rank=0, seed=3)
+        it = DevicePrefetchIterator(loader, lambda b: b, depth=3)
+        try:
+            next(it)
+            # the producer may have read ahead arbitrarily far; the
+            # consumer-facing cursor reflects ONE consumed batch
+            assert it.stream_cursor == 8
+            it.restore_cursor(0, 0)
+            assert it.stream_cursor == 0
+            next(it)
+            assert it.stream_cursor == 8
+        finally:
+            it.finalize()
+
+
+# ----------------------------------------------------------------------
+# observability
+# ----------------------------------------------------------------------
+
+class TestLoaderObservability:
+    def test_gauges_spans_and_ledger_file(self, shard_paths,
+                                          tmp_path):
+        from chainermn_tpu import telemetry
+        telemetry.disable()
+        rec = telemetry.enable()  # in-memory
+        try:
+            lpath = str(tmp_path / 'ledger.jsonl')
+            loader = StreamingLoader(ShardSet(shard_paths), 8,
+                                     size=1, rank=0, seed=3,
+                                     ledger_path=lpath)
+            next(loader)
+            next(loader)
+            reg = telemetry.registry()
+            names = set(reg.snapshot())
+            assert 'data_queue_depth' in names
+            assert 'data_worker_busy_fraction' in names
+            spans = [r for r in rec.events
+                     if r.get('name') == 'data_decode']
+            assert len(spans) >= 2
+            assert all(s.get('kind') == 'data' for s in spans)
+            loader.finalize()
+            rows = [json.loads(ln) for ln
+                    in open(lpath).read().splitlines()]
+            assert [r['ids'] for r in rows] \
+                == [e['ids'] for e in loader.ledger]
+        finally:
+            telemetry.disable()
+
+    def test_input_bound_stats_verdict(self):
+        from chainermn_tpu.telemetry.report import input_bound_stats
+        steps = []
+        for it in range(6):
+            steps.append({'iteration': it, 'rank': 0,
+                          'host_batch_prep_ms': 30.0,
+                          'jitted_step_ms': 10.0})
+        ib = input_bound_stats(steps)
+        assert ib['input_bound'] is True and ib['rank'] == 0
+        assert ib['host_batch_prep_p50_ms'] == 30.0
+        assert 0.74 < ib['input_fraction'] < 0.76
+        # device-bound capture: verdict present but False
+        fast = [dict(s, host_batch_prep_ms=1.0) for s in steps]
+        assert input_bound_stats(fast)['input_bound'] is False
+        # nothing to judge
+        assert input_bound_stats([]) is None
+
+    def test_report_renders_input_bound_line(self, shard_paths,
+                                             tmp_path):
+        from chainermn_tpu import telemetry
+        from chainermn_tpu.telemetry import report as trep
+        telemetry.disable()
+        tdir = str(tmp_path / 'tele')
+        rec = telemetry.enable(tdir)
+        try:
+            import time
+            for it in range(3):
+                with telemetry.span('host_batch_prep', kind='host',
+                                    iteration=it):
+                    time.sleep(0.02)
+                with telemetry.span('jitted_step', kind='compute',
+                                    iteration=it):
+                    time.sleep(0.001)
+            rec.flush()
+        finally:
+            telemetry.disable()
+        rep = trep.build_report(tdir)
+        assert rep['input_bound'] is not None
+        assert rep['input_bound']['input_bound'] is True
+        text = trep.render_text(rep)
+        assert 'INPUT-BOUND' in text
+
+    def test_doctor_carries_input_bound(self, tmp_path):
+        from chainermn_tpu import telemetry
+        from chainermn_tpu.telemetry import diagnosis
+        telemetry.disable()
+        tdir = str(tmp_path / 'tele')
+        rec = telemetry.enable(tdir)
+        try:
+            import time
+            for it in range(4):
+                with telemetry.span('host_batch_prep', kind='host',
+                                    iteration=it):
+                    time.sleep(0.01)
+                with telemetry.span('jitted_step', kind='compute',
+                                    iteration=it):
+                    time.sleep(0.001)
+            rec.flush()
+        finally:
+            telemetry.disable()
+        diag = diagnosis.diagnose(tdir)
+        assert diag['input_bound']['input_bound'] is True
+        assert any('input-bound' in s
+                   for s in diag['verdict']['summary'])
