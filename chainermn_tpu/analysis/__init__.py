@@ -21,5 +21,9 @@ from chainermn_tpu.analysis.rules import RULES, RuleContext  # noqa
 from chainermn_tpu.analysis.runner import (  # noqa
     build_report, lint_target, trace_target)
 from chainermn_tpu.analysis.targets import (  # noqa
-    LintTarget, default_targets, step_targets, strategy_targets)
+    LintTarget, STEP_FACTORIES, default_targets, step_targets,
+    strategy_targets)
+from chainermn_tpu.analysis import commcheck  # noqa
 from chainermn_tpu.analysis import memtraffic  # noqa
+from chainermn_tpu.analysis.commcheck import (  # noqa
+    match_p2p, run_commcheck, verify_streams)

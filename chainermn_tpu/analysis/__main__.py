@@ -44,6 +44,11 @@ def main(argv=None):
     parser.add_argument('--strategy', action='append', default=None,
                         help='lint only this strategy (repeatable); '
                              'default: all registered strategies')
+    parser.add_argument('--step', action='append', default=None,
+                        help='lint only this step target by registry '
+                             'name, e.g. transformer_pp (repeatable; '
+                             'skips the strategy sweep and commcheck '
+                             'unless --strategy is also given)')
     parser.add_argument('--rules', default=None,
                         help='comma-separated rule ids to run '
                              '(default: all)')
@@ -66,6 +71,11 @@ def main(argv=None):
                              'widest intermediates -- compiles each '
                              'step target, the slow part of the '
                              'sweep)')
+    parser.add_argument('--no-commcheck', action='store_true',
+                        help='skip the cross-rank verification sweep '
+                             '(strategies traced at world sizes '
+                             '{2,3,4}, eager-protocol simulation, '
+                             '1F1B handoff composition)')
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -78,8 +88,31 @@ def main(argv=None):
         only = {r.strip() for r in args.rules.split(',') if r.strip()}
         unknown = only - set(rules_mod.RULES)
         if unknown:
-            parser.error('unknown rule id(s): %s (see --list-rules)'
-                         % ', '.join(sorted(unknown)))
+            parser.error('unknown rule id(s): %s (valid: %s; see '
+                         '--list-rules)'
+                         % (', '.join(sorted(unknown)),
+                            ', '.join(sorted(rules_mod.RULES))))
+
+    # usage errors (rc 2) BEFORE any tracing: an unknown name must
+    # never silently sweep nothing
+    from chainermn_tpu import communicators
+    from chainermn_tpu.analysis import targets as targets_mod
+    if args.strategy:
+        unknown = sorted(set(args.strategy)
+                         - set(communicators._COMMUNICATORS))
+        if unknown:
+            parser.error(
+                'unknown strategy name(s): %s (valid: %s)'
+                % (', '.join(unknown),
+                   ', '.join(sorted(communicators._COMMUNICATORS))))
+    if args.step:
+        unknown = sorted(set(args.step)
+                         - set(targets_mod.STEP_FACTORIES))
+        if unknown:
+            parser.error(
+                'unknown step target(s): %s (valid: %s)'
+                % (', '.join(unknown),
+                   ', '.join(targets_mod.STEP_FACTORIES)))
 
     t0 = time.monotonic()
 
@@ -95,13 +128,39 @@ def main(argv=None):
         except ValueError as e:
             parser.error(str(e))
 
-    targets = analysis.default_targets(
-        strategies=args.strategy,
-        include_steps=not args.no_steps,
-        include_resnet50=not args.no_resnet50,
-        policy=policy)
+    if args.step:
+        # targeted iteration: exactly the named step target(s), plus
+        # any strategies the user ALSO asked for explicitly
+        targets = []
+        if args.strategy:
+            targets.extend(analysis.strategy_targets(
+                args.strategy,
+                reduce_dtype=policy.reduce_dtype
+                if policy is not None else None))
+        targets.extend(analysis.step_targets(policy=policy,
+                                             names=args.step))
+    else:
+        targets = analysis.default_targets(
+            strategies=args.strategy,
+            include_steps=not args.no_steps,
+            include_resnet50=not args.no_resnet50,
+            policy=policy)
     report = analysis.build_report(targets, only=only,
                                    progress=progress)
+    if not args.no_commcheck and not (args.step
+                                      and not args.strategy):
+        # cross-rank verification: strategies traced per simulated
+        # (world_size, rank), the eager protocol simulated through
+        # the recording communicator, the 1F1B handoff composed
+        from chainermn_tpu.analysis import commcheck
+        cc_findings, cc_meta = commcheck.run_commcheck(
+            strategies=args.strategy,
+            reduce_dtype=policy.reduce_dtype
+            if policy is not None else None,
+            progress=progress)
+        report.extend(f for f in cc_findings
+                      if only is None or f.rule_id in only)
+        report.commcheck = cc_meta
     if not args.no_memtraffic:
         # HBM-traffic audit over the STEP targets (strategy targets
         # move a synthetic 200-byte pytree; auditing them would be

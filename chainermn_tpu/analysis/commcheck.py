@@ -1,0 +1,659 @@
+"""Cross-rank communication verification (SL013/SL014/SL015 core).
+
+The reference's MPI layer inherited cross-rank correctness tooling
+(MUST/ISP-style deadlock detection over send/recv match sets); this
+module is the TPU-native equivalent, built on the observation that
+EVERY collective issue site in this codebase is either a traceable
+jaxpr (one SPMD program -- rank enters only through ``axis_index``)
+or an eager protocol call with introspectable rank parameters
+(``send_obj`` / ``recv_obj`` / ``barrier`` / ``allreduce_obj``).  That
+makes the classic dynamic MPI failure modes statically decidable:
+
+* **rank-divergent collective sequence** (SL013):
+  :func:`verify_streams` compares per-rank collective signature
+  streams position by position and names the first divergence --
+  exactly the Python ``if rank == k: allreduce()`` bug that wedges an
+  SPMD fleet at step N.
+* **p2p/ppermute match + deadlock** (SL014): :func:`match_p2p` runs a
+  wait-for-graph matcher over recorded eager streams (unmatched
+  send/recv, key/tag collision, cycle of blocking ops), and
+  :func:`check_ppermute_chain` extends SL002's single-shot bijectivity
+  check to MULTI-STEP schedules: a scan-repeated ``ppermute`` whose
+  iterated permutation never delivers data to some ranks of its axis.
+* **dynamic twin**: ``telemetry doctor`` replays per-rank collective
+  ``seq`` streams from a capture through the SAME
+  :func:`verify_streams` (``telemetry/diagnosis.py``), so the static
+  and dynamic verdicts cannot drift apart.
+
+:func:`run_commcheck` is the sweep driver ``python -m
+chainermn_tpu.analysis`` and ``ci/run_staticcheck.sh check_commcheck``
+call: every registered strategy's collective surface traced at world
+sizes {2, 3, 4}, the canonical eager protocol simulated per rank
+through a :class:`~chainermn_tpu.communicators.recording.
+RecordingCommunicator`, and the 1F1B warmup/steady/cooldown handoff
+chain composed for representative microbatch counts.
+"""
+
+from chainermn_tpu.analysis import walker
+from chainermn_tpu.analysis.findings import Finding, SEV_ERROR
+from chainermn_tpu.communicators.recording import (  # noqa: F401
+    RecordingCommunicator, simulate_protocol)
+
+#: the default simulated world-size grid (ISSUE: at least {2, 3, 4})
+WORLD_SIZES = (2, 3, 4)
+#: representative microbatch counts for the 1F1B handoff composition
+MICRO_COUNTS = (1, 2, 4, 8)
+
+
+# ---------------------------------------------------------------------
+# stream comparison (SL013 static core == doctor replay core)
+
+def _sig(rec):
+    """Hashable signature of one stream record: ``(op, tag, seq)``."""
+    return (rec.get('op'), rec.get('tag'), rec.get('seq'))
+
+
+def render_sig(sig):
+    """``'barrier[setup]#1'`` / ``'psum#0'`` -- compact op rendering
+    for divergence transcripts."""
+    if sig is None:
+        return '<ended>'
+    op, tag, seq = sig
+    if tag is not None:
+        return '%s[%s]#%s' % (op, tag, seq)
+    return '%s#%s' % (op, seq)
+
+
+def verify_streams(streams, rank_addressed=(), context=2):
+    """First divergence between per-rank collective streams, or None.
+
+    ``streams`` is ``{rank: [record, ...]}`` where each record carries
+    at least ``op`` (plus optional ``tag`` / ``seq`` / ``kind``).
+    p2p records (``kind == 'p2p'``) and ops in ``rank_addressed`` are
+    excluded -- those are DECLARED rank-asymmetric; everything else
+    must be identical across ranks position by position (bulk-
+    synchronous program order).
+
+    Returns ``None`` when the streams agree, else::
+
+        {'position': i, 'kind': 'mismatch' | 'truncated',
+         'ranks': {rank: {'op': str | None, 'context': [str, ...]}},
+         'summary': one-line transcript}
+
+    where each rank's ``context`` is its ±``context`` ops around the
+    divergent position.  This function is the SHARED core: the static
+    SL013 rule feeds it simulated/traced streams, the telemetry
+    doctor's protocol-divergence verdict feeds it recorded spans.
+    """
+    ranks = sorted(streams)
+    if len(ranks) < 2:
+        return None
+    excl = set(rank_addressed or ())
+    sigs = {}
+    for r in ranks:
+        sigs[r] = [_sig(rec) for rec in streams[r]
+                   if rec.get('kind') != 'p2p'
+                   and rec.get('op') not in excl]
+    length = max(len(s) for s in sigs.values())
+    for i in range(length):
+        at = {r: (sigs[r][i] if i < len(sigs[r]) else None)
+              for r in ranks}
+        if len(set(at.values())) <= 1:
+            continue
+        kind = ('truncated' if any(v is None for v in at.values())
+                else 'mismatch')
+        per_rank = {}
+        for r in ranks:
+            lo = max(0, i - context)
+            per_rank[r] = {
+                'op': render_sig(at[r]) if at[r] is not None else None,
+                'context': [render_sig(s)
+                            for s in sigs[r][lo:i + context + 1]]}
+        summary = ('position %d: %s' % (i, '; '.join(
+            'rank %d issues %s' % (r, render_sig(at[r]))
+            for r in ranks)))
+        return {'position': i, 'kind': kind, 'ranks': per_rank,
+                'summary': summary}
+    return None
+
+
+# ---------------------------------------------------------------------
+# eager p2p/barrier wait-for matcher (SL014 dynamic-shape core)
+
+def _find_cycle(waits):
+    """One cycle (list of ranks) in a wait-for graph, or None."""
+    color, stack = {}, []
+
+    def dfs(u):
+        color[u] = 1
+        stack.append(u)
+        for v in waits.get(u, ()):
+            if v not in waits:
+                continue
+            if color.get(v) == 1:
+                return stack[stack.index(v):]
+            if not color.get(v):
+                got = dfs(v)
+                if got:
+                    return got
+        color[u] = 2
+        stack.pop()
+        return None
+
+    for u in sorted(waits):
+        if not color.get(u):
+            got = dfs(u)
+            if got:
+                return got
+    return None
+
+
+def _describe(rec):
+    if rec is None:
+        return '<done>'
+    if rec.get('kind') == 'p2p':
+        return '%s(peer=%s, tag=%s, seq=%s)' % (
+            rec.get('op'), rec.get('peer'), rec.get('tag'),
+            rec.get('seq'))
+    return render_sig(_sig(rec))
+
+
+def match_p2p(streams):
+    """Match per-rank eager op streams; return protocol findings.
+
+    Models the real channel's semantics (``communicators/base.py``):
+    ``send_obj`` publishes to the KV store and returns (buffered,
+    non-blocking), ``recv_obj`` blocks until its exact key
+    ``(channel, src, dest, tag, seq)`` exists, ``barrier`` and
+    rendezvous collectives (``allreduce_obj``) block until EVERY rank
+    arrives at the same ``(op, tag, seq)``; ``broadcast_data`` is a
+    local replicate, never a blocking rendezvous.
+
+    Findings (list of dicts with ``kind`` / ``ranks`` / ``message``):
+
+    * ``tag_collision`` -- a send re-publishes a key whose earlier
+      message is still unconsumed (the rebuilt-communicator seq-0
+      hazard the ``_p2p_channel`` docstring documents).
+    * ``deadlock`` -- a cycle of blocked ops, each rank and its
+      blocking op named.
+    * ``unmatched_recv`` -- a recv whose sender already exited its
+      stream: the message can never arrive.
+    * ``exited_collective`` -- a rank waits at a rendezvous a peer
+      has already run past the end of its stream.
+    * ``unmatched_send`` -- the run completes but published messages
+      were never consumed.
+    """
+    ranks = sorted(streams)
+    findings = []
+    if len(ranks) < 2:
+        return findings
+    ptr = {r: 0 for r in ranks}
+    mailbox = {}  # undelivered key -> sender rank
+    published = {}  # every key ever published -> first sender rank
+
+    def head(r):
+        s = streams[r]
+        return s[ptr[r]] if ptr[r] < len(s) else None
+
+    progress = True
+    while progress:
+        progress = False
+        for r in ranks:
+            rec = head(r)
+            if rec is None:
+                continue
+            op = rec.get('op')
+            if op == 'send_obj':
+                key = rec.get('key')
+                if key in published:
+                    findings.append({
+                        'kind': 'tag_collision',
+                        'ranks': sorted({published[key], r}),
+                        'message':
+                            'p2p key collision: rank %d re-publishes '
+                            '%s -- two sends race on one wire key, '
+                            'so the receiver reads whichever landed '
+                            'last (a communicator rebuilt over a '
+                            'live channel restarts at seq 0; '
+                            'segregate with a distinct channel)'
+                            % (r, key)})
+                else:
+                    published[key] = r
+                mailbox[key] = r
+                ptr[r] += 1
+                progress = True
+            elif op == 'recv_obj':
+                key = rec.get('key')
+                if key in mailbox:
+                    del mailbox[key]
+                    ptr[r] += 1
+                    progress = True
+            elif (rec.get('kind') == 'collective'
+                  and op != 'broadcast_data'):
+                want = _sig(rec)
+                arrived = all(
+                    head(q) is not None
+                    and head(q).get('kind') == 'collective'
+                    and _sig(head(q)) == want for q in ranks)
+                if arrived:
+                    for q in ranks:
+                        ptr[q] += 1
+                    progress = True
+            else:
+                # unknown / local op: never blocks
+                ptr[r] += 1
+                progress = True
+
+    blocked = [r for r in ranks if head(r) is not None]
+    if not blocked:
+        for key, sender in sorted(mailbox.items()):
+            bits = key.split('/')
+            findings.append({
+                'kind': 'unmatched_send',
+                'ranks': [sender, int(bits[-3])],
+                'message':
+                    'unmatched send: rank %s published %s (dest rank '
+                    '%s, tag %s, seq %s) but no recv ever consumes it'
+                    % (bits[-4], key, bits[-3], bits[-2], bits[-1])})
+        return findings
+
+    waits = {}
+    for r in blocked:
+        rec = head(r)
+        if rec.get('op') == 'recv_obj':
+            waits[r] = [rec.get('peer')]
+        else:
+            want = _sig(rec)
+            waits[r] = [q for q in ranks if q != r
+                        and (head(q) is None
+                             or head(q).get('kind') != 'collective'
+                             or _sig(head(q)) != want)]
+    cycle = _find_cycle(waits)
+    reported = set()
+    if cycle:
+        reported.update(cycle)
+        findings.append({
+            'kind': 'deadlock', 'ranks': list(cycle),
+            'message': 'deadlock: cycle of blocking ops -- %s'
+                       % '; '.join('rank %d blocked at %s'
+                                   % (r, _describe(head(r)))
+                                   for r in cycle)})
+    for r in blocked:
+        if r in reported:
+            continue
+        rec = head(r)
+        if rec.get('op') == 'recv_obj':
+            peer = rec.get('peer')
+            if peer not in streams or head(peer) is None:
+                findings.append({
+                    'kind': 'unmatched_recv', 'ranks': [r, peer],
+                    'message':
+                        'unmatched recv: rank %d blocks at %s but '
+                        'rank %s already exited its stream -- the '
+                        'message never arrives' % (r, _describe(rec),
+                                                   peer)})
+        else:
+            gone = [q for q in waits.get(r, ()) if head(q) is None]
+            if gone:
+                findings.append({
+                    'kind': 'exited_collective',
+                    'ranks': [r] + gone,
+                    'message':
+                        'rank %d waits at %s but rank(s) %s already '
+                        'exited their streams and can never arrive'
+                        % (r, _describe(rec),
+                           ', '.join(str(q) for q in gone))})
+    if not findings:
+        # blocked with neither a cycle nor an exited peer cannot
+        # happen in a finite wait graph, but never let a wedge pass
+        findings.append({
+            'kind': 'deadlock', 'ranks': blocked,
+            'message': 'ranks %s blocked without progress: %s'
+                       % (blocked, '; '.join(
+                           'rank %d at %s' % (r, _describe(head(r)))
+                           for r in blocked))})
+    return findings
+
+
+# ---------------------------------------------------------------------
+# static jaxpr streams + multi-step ppermute chains
+
+def jaxpr_collective_stream(jaxpr):
+    """Ordered collective records of a traced program.
+
+    Depth-first program order, one record per collective equation:
+    ``{'op', 'kind': 'collective', 'tag': None, 'seq', 'axes'}`` with
+    ``seq`` the per-op occurrence index -- the same ``(op, tag, seq)``
+    signature shape the eager channel stamps on telemetry spans, so
+    :func:`verify_streams` consumes both without translation.
+    """
+    recs, counters = [], {}
+    for eqn, _path in walker.iter_eqns(jaxpr):
+        name = eqn.primitive.name
+        if name not in walker.COLLECTIVE_PRIMS:
+            continue
+        seq = counters.get(name, 0)
+        counters[name] = seq + 1
+        recs.append({'op': name, 'kind': 'collective', 'tag': None,
+                     'seq': seq, 'axes': tuple(walker.eqn_axes(eqn))})
+    return recs
+
+
+def repeated_ppermutes(jaxpr):
+    """``(eqn, reps)`` for every ppermute; ``reps`` is the product of
+    enclosing ``scan`` lengths (how many times the schedule applies
+    the permutation table)."""
+    out = []
+
+    def walk(j, reps):
+        for eqn in walker.raw_jaxpr(j).eqns:
+            inner_reps = reps
+            if eqn.primitive.name == 'scan':
+                inner_reps = reps * int(eqn.params.get('length', 1)
+                                        or 1)
+            if eqn.primitive.name == 'ppermute':
+                out.append((eqn, reps))
+            for sub in walker.subjaxprs(eqn):
+                walk(sub, inner_reps)
+
+    walk(jaxpr, 1)
+    return out
+
+
+def check_ppermute_chain(perm, size, n_steps):
+    """Verify a REPEATED permutation table delivers to every rank.
+
+    SL002 checks one application (bijectivity, range); a multi-step
+    schedule -- the same ``ppermute`` applied ``n_steps`` times by an
+    enclosing scan, e.g. a pipeline handoff ring -- must additionally
+    COMPOSE: iterating the table from its entry ranks (sources that
+    are never destinations; all sources when the table is a union of
+    cycles) must eventually hand data to every rank of the axis.  A
+    non-wrapping chain ``[(0,1),(1,2)]`` on a size-4 axis dead-ends
+    after two hops and never reaches rank 3; a full ring reaches
+    everyone within ``size - 1`` steps.
+
+    Returns ``None`` when the chain composes, else a dict with
+    ``unreachable`` (ranks never receiving data) and ``message``.
+    """
+    perm = [(int(s), int(d)) for s, d in perm]
+    if size <= 1 or not perm or n_steps < 2:
+        return None
+    sources = {s for s, _ in perm}
+    dests = {d for _, d in perm}
+    entries = sorted(sources - dests)
+    holders = set(entries) if entries else set(sources)
+    ever = set(holders)
+    for _ in range(min(int(n_steps), 2 * size)):
+        holders = {d for s, d in perm if s in holders}
+        ever |= holders
+        if not holders:
+            break
+    unreachable = sorted(set(range(size)) - ever)
+    if not unreachable:
+        return None
+    return {
+        'unreachable': unreachable,
+        'message':
+            'broken multi-step ppermute chain: permutation %r applied '
+            '%d times over an axis of size %d never delivers data to '
+            'rank(s) %s (chain entered at rank(s) %s only ever '
+            'reaches %s)' % (perm, n_steps, size, unreachable,
+                             entries or sorted(sources),
+                             sorted(ever))}
+
+
+def ppermute_chain_rule(ctx):
+    """SL014's static half over one RuleContext: every scan-repeated
+    ppermute's chain must compose (see :func:`check_ppermute_chain`).
+    Single-shot ppermutes (``reps < 2``) stay SL002's business."""
+    import numpy as np
+    out = []
+    if ctx.jaxpr is None:
+        return out
+    for eqn, reps in repeated_ppermutes(ctx.jaxpr):
+        if reps < 2:
+            continue
+        axes = walker.eqn_axes(eqn)
+        size = int(np.prod([ctx.mesh_axes.get(a, 1) for a in axes])) \
+            if axes else 0
+        res = check_ppermute_chain(eqn.params.get('perm', ()), size,
+                                   reps)
+        if res is not None:
+            out.append(ctx.finding('SL014', SEV_ERROR, res['message'],
+                                   eqn))
+    return out
+
+
+# ---------------------------------------------------------------------
+# 1F1B handoff-chain composition (warmup / steady / cooldown)
+
+def simulate_1f1b_streams(n_stages, n_micro):
+    """Per-stage eager p2p streams of the 1F1B pipeline schedule.
+
+    Each stage's program order follows the standard warmup (``min(M,
+    S-1-s)`` forward-only microbatches) / steady (one forward, one
+    backward) / cooldown (drain backwards) structure of
+    ``parallel/pipeline.py``; forward activations ship on tag 0,
+    backward grads on tag 1.  Feeding the result through
+    :func:`match_p2p` verifies the handoff chain COMPOSES deadlock-
+    free -- the multi-step extension of the single-hop ring check.
+    """
+    streams = {}
+    for s in range(n_stages):
+        comm = RecordingCommunicator(s, n_stages, channel='pipe')
+        state = {'fwd': 0, 'bwd': 0}
+
+        def forward(s=s, comm=comm, state=state):
+            if s > 0:
+                comm.recv_obj(s - 1, tag=0)
+            if s < n_stages - 1:
+                comm.send_obj(None, s + 1, tag=0)
+            state['fwd'] += 1
+
+        def backward(s=s, comm=comm, state=state):
+            if s < n_stages - 1:
+                comm.recv_obj(s + 1, tag=1)
+            if s > 0:
+                comm.send_obj(None, s - 1, tag=1)
+            state['bwd'] += 1
+
+        for _ in range(min(n_micro, n_stages - 1 - s)):
+            forward()
+        while state['fwd'] < n_micro:
+            forward()
+            backward()
+        while state['bwd'] < n_micro:
+            backward()
+        streams[s] = comm.records
+    return streams
+
+
+def reference_protocol(comm):
+    """The canonical eager protocol surface, in the order training
+    drives it: startup barrier, parameter broadcast, metric
+    allreduce, the neighbor p2p ring (dataset scatter pattern), a
+    bounded allreduce (barrier + collective), teardown barrier.  Runs
+    against the real communicator and the recording fake alike."""
+    comm.barrier(tag='startup')
+    comm.broadcast_data({'w': 0.0}, root=0)
+    comm.allreduce_obj(0.0, op='mean')
+    comm.send_obj(None, (comm.rank + 1) % comm.size, tag=7)
+    comm.recv_obj((comm.rank - 1) % comm.size, tag=7)
+    comm.allreduce_obj(0.0, op='sum', timeout=30.0)
+    comm.barrier(tag='teardown')
+
+
+# ---------------------------------------------------------------------
+# the sweep driver (CLI + ci/run_staticcheck.sh check_commcheck)
+
+def _strategy_commcheck(name, world_size, reduce_dtype, comm_factory,
+                        meta):
+    """SL013 findings for one strategy at one simulated world size."""
+    import jax
+    import jax.numpy as jnp
+
+    from chainermn_tpu import communicators
+    from chainermn_tpu.analysis import targets as targets_mod
+
+    findings = []
+    # without a factory the constructor has no rank parameter -- ONE
+    # SPMD program serves every rank (single-controller model), so one
+    # trace stands for all of them; a factory (the fixture surface)
+    # may branch on rank and is rebuilt + retraced per rank
+    ranks = range(world_size) if comm_factory is not None else (0,)
+    per_method = {}
+    for rank in ranks:
+        try:
+            if comm_factory is not None:
+                comm = comm_factory(name, rank, world_size)
+            else:
+                comm = communicators.create_communicator(
+                    name,
+                    mesh_shape=targets_mod._strategy_mesh_shape(
+                        name, world_size),
+                    devices=jax.devices()[:world_size],
+                    reduce_dtype=reduce_dtype)
+        except Exception as e:
+            per_method.setdefault('__init__', {})[rank] = (
+                'error', '%s: %s' % (type(e).__name__, e))
+            continue
+        grads = targets_mod._synthetic_grads()
+        perm = [(i, (i + 1) % comm.size) for i in range(comm.size)]
+        methods = (
+            ('allreduce_grad', comm.allreduce_grad, (grads,)),
+            ('broadcast_data', comm.broadcast_data, (grads,)),
+            ('send_recv',
+             lambda x, _c=comm, _p=perm: _c.send_recv(x, _p),
+             (jnp.zeros((4, 4), jnp.float32),)),
+        )
+        for mname, fn, args in methods:
+            try:
+                jaxpr = jax.make_jaxpr(
+                    targets_mod._mapped(comm, fn))(*args)
+                stream = jaxpr_collective_stream(jaxpr)
+                meta['n_stream_traces'] += 1
+            except Exception as e:
+                stream = ('error', '%s: %s'
+                          % (type(e).__name__,
+                             str(e).splitlines()[0] if str(e) else ''))
+            per_method.setdefault(mname, {})[rank] = stream
+
+    for mname in sorted(per_method):
+        by_rank = per_method[mname]
+        tname = 'commcheck:%s:%s@ws%d' % (name, mname, world_size)
+        errs = {r: v for r, v in by_rank.items()
+                if isinstance(v, tuple) and v and v[0] == 'error'}
+        if errs:
+            if len(errs) == len(by_rank):
+                # uniformly untraceable at this size: not a
+                # DIVERGENCE; the n=8 sweep lints the trace failure
+                meta['skipped'].append(
+                    {'target': tname,
+                     'reason': sorted(m for _, m in errs.values())[0]})
+            else:
+                findings.append(Finding(
+                    'SL013', SEV_ERROR,
+                    'rank-divergent collective sequence: rank(s) %s '
+                    'fail to trace (%s) while rank(s) %s trace fine'
+                    % (sorted(errs),
+                       sorted(m for _, m in errs.values())[0],
+                       sorted(set(by_rank) - set(errs))),
+                    target=tname))
+            continue
+        streams = (by_rank if comm_factory is not None
+                   else {r: by_rank[0] for r in range(world_size)})
+        div = verify_streams(streams)
+        if div is not None:
+            findings.append(Finding(
+                'SL013', SEV_ERROR,
+                'rank-divergent collective sequence at %s' %
+                div['summary'], target=tname))
+    return findings
+
+
+def run_commcheck(strategies=None, world_sizes=WORLD_SIZES,
+                  reduce_dtype=None, comm_factory=None, progress=None,
+                  micro_counts=MICRO_COUNTS):
+    """The full cross-rank sweep: ``(findings, meta)``.
+
+    * every strategy's collective surface traced at each simulated
+      world size (``comm_factory(name, rank, world_size)`` overrides
+      construction -- the fixture surface; default uses the real
+      registry on a device subset),
+    * the canonical eager protocol simulated per rank through the
+      recording communicator (stream identity + p2p match),
+    * the 1F1B handoff chain composed for representative microbatch
+      counts at each stage count.
+
+    ``meta`` is the machine-readable section the CI gate pins
+    (``report['commcheck']`` in the ``--json`` output).
+    """
+    from chainermn_tpu import communicators
+
+    if strategies is None:
+        strategies = sorted(communicators._COMMUNICATORS)
+    world_sizes = tuple(int(w) for w in world_sizes)
+    findings = []
+    meta = {'world_sizes': list(world_sizes),
+            'strategies': list(strategies),
+            'reduce_dtype': (None if reduce_dtype is None
+                             else str(reduce_dtype)),
+            'n_stream_traces': 0, 'skipped': [],
+            'protocols': [], 'pipeline_schedules': []}
+
+    for name in strategies:
+        for ws in world_sizes:
+            if progress is not None:
+                progress('commcheck:%s@ws%d' % (name, ws))
+            findings.extend(_strategy_commcheck(
+                name, ws, reduce_dtype, comm_factory, meta))
+
+    for ws in world_sizes:
+        if progress is not None:
+            progress('commcheck:eager_protocol@ws%d' % ws)
+        tname = 'commcheck:eager_protocol@ws%d' % ws
+        streams = simulate_protocol(reference_protocol, ws)
+        div = verify_streams(streams)
+        if div is not None:
+            findings.append(Finding(
+                'SL013', SEV_ERROR,
+                'rank-divergent eager protocol at %s' % div['summary'],
+                target=tname))
+        items = match_p2p(streams)
+        for item in items:
+            findings.append(Finding('SL014', SEV_ERROR,
+                                    item['message'], target=tname))
+        meta['protocols'].append(
+            {'world_size': ws,
+             'n_records': sum(len(s) for s in streams.values()),
+             'ok': div is None and not items})
+
+    ticks = None
+    try:
+        from chainermn_tpu.parallel.pipeline import schedule_ticks
+        ticks = schedule_ticks
+    except Exception:  # pragma: no cover - pipeline layer unavailable
+        pass
+    for n_stages in world_sizes:
+        for n_micro in micro_counts:
+            tname = 'commcheck:1f1b:stages%d:micro%d' % (n_stages,
+                                                         n_micro)
+            streams = simulate_1f1b_streams(n_stages, n_micro)
+            items = match_p2p(streams)
+            for item in items:
+                findings.append(Finding(
+                    'SL014', SEV_ERROR,
+                    '1f1b handoff chain (%d stages, %d microbatches) '
+                    'does not compose: %s'
+                    % (n_stages, n_micro, item['message']),
+                    target=tname))
+            meta['pipeline_schedules'].append(
+                {'n_stages': n_stages, 'n_micro': n_micro,
+                 'ticks': (int(ticks(n_micro, n_stages,
+                                     schedule='1f1b'))
+                           if ticks is not None else None),
+                 'ok': not items})
+
+    meta['ok'] = not findings
+    return findings, meta

@@ -49,6 +49,13 @@ class Report:
         # per-target bytes-accessed / bytes-per-item / top widest
         # intermediates; empty when the sweep skipped the audit
         self.memtraffic = []
+        # cross-rank verification metadata
+        # (chainermn_tpu.analysis.commcheck.run_commcheck): the world
+        # sizes / strategies swept, stream-trace and protocol counts,
+        # pipeline-schedule compositions -- the section
+        # ci/run_staticcheck.sh's check_commcheck gate pins.  Empty
+        # when the sweep skipped commcheck.
+        self.commcheck = {}
 
     def add(self, finding):
         self.findings.append(finding)
@@ -80,6 +87,7 @@ class Report:
             'ok': self.ok(),
             'findings': [f.as_dict() for f in self.findings],
             'memtraffic': list(self.memtraffic),
+            'commcheck': dict(self.commcheck),
         }
 
     def to_json(self, indent=None):
@@ -108,6 +116,17 @@ class Report:
             lines.append('memtraffic %s: %s'
                          % (row.get('target'),
                             '; '.join(bits) or 'no data'))
+        if self.commcheck:
+            lines.append(
+                'commcheck: %d strategies x world sizes %s, '
+                '%d stream traces, %d eager protocols, '
+                '%d pipeline schedules, ok=%s'
+                % (len(self.commcheck.get('strategies', ())),
+                   self.commcheck.get('world_sizes'),
+                   self.commcheck.get('n_stream_traces', 0),
+                   len(self.commcheck.get('protocols', ())),
+                   len(self.commcheck.get('pipeline_schedules', ())),
+                   self.commcheck.get('ok')))
         lines.append('shardlint: %d target(s), %d error(s), '
                      '%d warning(s)' % (len(self.targets),
                                         len(self.errors),

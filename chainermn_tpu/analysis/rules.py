@@ -48,6 +48,20 @@ class RuleContext:
         computation spans (a :class:`chainermn_tpu.parallel.MeshPlan`
         target declares ``('data', 'model')``); enables the SL010
         multi-axis family.  None (single-axis targets) disables it.
+      rank_addressed: op names the target DECLARES rank-asymmetric
+        (a root-addressed broadcast, a deliberate per-rank p2p leg);
+        SL013's stream comparison and SL015's control-flow audit
+        exempt exactly these.  None/empty means every collective must
+        be rank-uniform.
+      rank_streams: ``{rank: [record, ...]}`` per-rank collective
+        streams for SL013 (``commcheck.verify_streams`` record shape)
+        -- the runner replicates the traced jaxpr's stream (one SPMD
+        program serves every rank); ``commcheck.run_commcheck`` and
+        the fixtures supply genuinely per-rank simulated streams.
+      p2p_streams: ``{rank: [record, ...]}`` per-rank eager op streams
+        for SL014's wait-for matcher (``commcheck.match_p2p``); None
+        skips the dynamic half (the static ppermute-chain half always
+        runs off the jaxpr).
       trace_error: exception raised while tracing, if any.
     """
 
@@ -55,7 +69,8 @@ class RuleContext:
                  reduction_axes=None, signatures=None,
                  trace_error=None, declared_dtypes=None,
                  compute_dtype=None, overlap_check=False,
-                 plan_axes=None):
+                 plan_axes=None, rank_addressed=None,
+                 rank_streams=None, p2p_streams=None):
         self.target_name = target_name
         self.jaxpr = jaxpr
         self.mesh_axes = dict(mesh_axes or {})
@@ -65,6 +80,10 @@ class RuleContext:
         self.overlap_check = overlap_check
         self.plan_axes = (tuple(plan_axes) if plan_axes is not None
                           else None)
+        self.rank_addressed = (tuple(rank_addressed)
+                               if rank_addressed else ())
+        self.rank_streams = rank_streams
+        self.p2p_streams = p2p_streams
         self.signatures = signatures
         self.trace_error = trace_error
 
@@ -685,6 +704,102 @@ def rule_tp_donation(ctx):
     return out
 
 
+# ---------------------------------------------------------------------
+# SL013: rank-divergent collective sequence.  The streams come from
+# three sources feeding ONE checker core (commcheck.verify_streams):
+# the runner replicates a traced target's jaxpr stream per rank (one
+# SPMD program serves every rank -- uniform by construction, so this
+# half documents the invariant), commcheck.run_commcheck traces each
+# strategy at simulated world sizes {2,3,4} and simulates the eager
+# protocol per rank through the recording communicator (where a
+# Python branch on rank genuinely diverges), and telemetry doctor
+# replays RECORDED spans from a capture through the same core.
+def rule_rank_divergence(ctx):
+    streams = getattr(ctx, 'rank_streams', None)
+    if not streams:
+        return []
+    from chainermn_tpu.analysis import commcheck
+    div = commcheck.verify_streams(
+        streams, rank_addressed=getattr(ctx, 'rank_addressed', ()))
+    if div is None:
+        return []
+    return [ctx.finding(
+        'SL013', SEV_ERROR,
+        'rank-divergent collective sequence at %s -- every rank must '
+        'issue the same collectives in the same order or the fleet '
+        'wedges at the first unmatched rendezvous' % div['summary'])]
+
+
+# ---------------------------------------------------------------------
+# SL014: p2p/ppermute match + deadlock.  Dynamic half: the wait-for
+# matcher over recorded eager send_obj/recv_obj/barrier streams
+# (unmatched send/recv, key/tag collision, cycle of blocking ops).
+# Static half: every scan-REPEATED ppermute's permutation table must
+# compose into a chain that delivers to every rank of its axis --
+# SL002's bijectivity check extended to multi-step schedules.
+def rule_p2p_deadlock(ctx):
+    from chainermn_tpu.analysis import commcheck
+    out = []
+    streams = getattr(ctx, 'p2p_streams', None)
+    if streams:
+        for item in commcheck.match_p2p(streams):
+            out.append(ctx.finding('SL014', SEV_ERROR,
+                                   item['message']))
+    out.extend(commcheck.ppermute_chain_rule(ctx))
+    return out
+
+
+# ---------------------------------------------------------------------
+# SL015: collective under rank-dependent control flow.  Taint every
+# var derived from axis_index (the SL009-style per-level forward
+# pass); a lax.cond / lax.switch whose predicate is tainted and whose
+# branches contain a collective launches that collective on only SOME
+# ranks -- unless the target declares the op rank-addressed.
+# ppermute is auto-exempt (rank-addressed by definition).  The eager
+# mirror -- Python code guarded by ``comm.rank`` -- cannot appear in
+# a jaxpr; it is caught by SL013's recorded/simulated stream
+# comparison instead.
+def rule_rank_dependent_collective(ctx):
+    out = []
+    if ctx.jaxpr is None:
+        return out
+    exempt = set(getattr(ctx, 'rank_addressed', ()))
+    for jx, _path in walker.iter_jaxprs(ctx.jaxpr):
+        tainted = set()
+        for eqn in jx.eqns:
+            name = eqn.primitive.name
+            if name == 'axis_index':
+                tainted.update(id(v) for v in eqn.outvars)
+                continue
+            if name == 'cond' and eqn.invars:
+                pred = eqn.invars[0]
+                if not hasattr(pred, 'val') and id(pred) in tainted:
+                    colls = sorted({
+                        inner.primitive.name
+                        for br in eqn.params.get('branches', ())
+                        for inner, _p in walker.iter_eqns(br)
+                        if inner.primitive.name
+                        in walker.COLLECTIVE_PRIMS
+                        and inner.primitive.name != 'ppermute'
+                        and inner.primitive.name not in exempt})
+                    if colls:
+                        out.append(ctx.finding(
+                            'SL015', SEV_WARNING,
+                            'collective(s) %s inside lax.cond/'
+                            'lax.switch whose predicate derives from '
+                            'axis_index: ranks take different '
+                            'branches, so the collective launches on '
+                            'only SOME ranks and the rest never '
+                            'arrive at the rendezvous (declare the '
+                            'op rank-addressed on the target if this '
+                            'asymmetry is the design)'
+                            % ', '.join(colls), eqn))
+            if any(id(v) in tainted for v in eqn.invars
+                   if not hasattr(v, 'val')):
+                tainted.update(id(v) for v in eqn.outvars)
+    return out
+
+
 #: rule id -> (callable, one-line description)
 RULES = {
     'SL001': (rule_axis_topology,
@@ -725,6 +840,19 @@ RULES = {
               'donated plan-sharded buffers alias an output of the '
               'SAME sharding (a gathered/resharded output cannot '
               'alias and wastes the donation)'),
+    'SL013': (rule_rank_divergence,
+              'per-rank collective signature streams are identical '
+              'up to declared rank-addressed ops (simulated '
+              '(world_size, rank) sweep; doctor replays captures '
+              'through the same core)'),
+    'SL014': (rule_p2p_deadlock,
+              'eager send/recv/barrier streams match without tag '
+              'collisions or blocking-op cycles, and scan-repeated '
+              'ppermute chains compose to deliver to every rank'),
+    'SL015': (rule_rank_dependent_collective,
+              'no collective under lax.cond/lax.switch control flow '
+              'whose predicate derives from axis_index, unless '
+              'declared rank-addressed'),
 }
 
 

@@ -28,6 +28,20 @@ def lint_target(target, only=None):
                 for it in (1, 2)]
         except Exception as e:
             sig_err = e
+    # SL013 streams: ONE traced SPMD program serves every rank of the
+    # target's mesh (rank enters only through axis_index, which SL015
+    # audits), so the per-rank collective streams are the jaxpr's
+    # stream replicated -- uniform by construction.  Genuinely
+    # divergent streams (Python rank branching) enter through
+    # commcheck.run_commcheck's simulated sweep and the fixtures.
+    rank_streams = None
+    if jaxpr is not None:
+        from chainermn_tpu.analysis import commcheck
+        stream = commcheck.jaxpr_collective_stream(jaxpr)
+        n_ranks = 1
+        for size in target.mesh_axes.values():
+            n_ranks *= int(size)
+        rank_streams = {r: stream for r in range(max(2, n_ranks))}
     ctx = rules_mod.RuleContext(
         target.name, jaxpr=jaxpr, mesh_axes=target.mesh_axes,
         reduction_axes=target.reduction_axes,
@@ -35,6 +49,8 @@ def lint_target(target, only=None):
         compute_dtype=getattr(target, 'compute_dtype', None),
         overlap_check=getattr(target, 'overlap_check', False),
         plan_axes=getattr(target, 'plan_axes', None),
+        rank_addressed=getattr(target, 'rank_addressed', None),
+        rank_streams=rank_streams,
         signatures=signatures, trace_error=err)
     findings = rules_mod.run_rules(ctx, only=only)
     # a trace failure no rule claimed (SL001 claims unbound-axis

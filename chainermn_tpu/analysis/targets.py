@@ -41,12 +41,16 @@ class LintTarget:
         for train-step targets (set by ``_updater_target`` /
         ``zero_core_target``); a strategy's bare collective surface
         has nothing to overlap with by construction and is excluded.
+      rank_addressed: op names the target DECLARES rank-asymmetric
+        (a root-addressed broadcast, a deliberate per-rank leg);
+        SL013's cross-rank stream comparison and SL015's
+        rank-dependent-control-flow audit exempt exactly these ops.
     """
 
     def __init__(self, name, fn, args, mesh_axes, reduction_axes=None,
                  make_args=None, declared_dtypes=None,
                  compute_dtype=None, items=None, overlap_check=False,
-                 plan_axes=None):
+                 plan_axes=None, rank_addressed=None):
         self.name = name
         self.fn = fn
         self.args = tuple(args)
@@ -59,6 +63,8 @@ class LintTarget:
         self.overlap_check = overlap_check
         self.plan_axes = (tuple(plan_axes) if plan_axes is not None
                           else None)
+        self.rank_addressed = (tuple(rank_addressed)
+                               if rank_addressed else ())
         self.make_args = make_args
 
     def __repr__(self):
@@ -545,36 +551,80 @@ def decode_forward_target(policy=None, tp=2, bucket=None):
         make_args=lambda it: engine.traceable_decode(bucket)[1])
 
 
-def step_targets(include_resnet50=True, policy=None):
-    out = [mlp_step_target(policy=policy), zero_core_target(),
-           zero_step_target(policy=policy),
-           bucketed_overlap_step_target(policy=policy),
-           pipeline_step_target(policy=policy),
-           transformer_tp_step_target(policy=policy),
-           transformer_pp_step_target(policy=policy),
-           transformer_tp_pp_step_target(policy=policy),
-           serve_forward_target(policy=policy),
-           decode_forward_target(policy=policy)]
-    if include_resnet50:
-        # unfused (flax-oracle) AND fused train steps: the SL008 /
-        # memtraffic A/B pair ci/run_staticcheck.sh sweeps in both
-        # precisions
-        out.append(resnet50_step_target(policy=policy))
-        out.append(resnet50_step_target(policy=policy,
-                                        fused_norm=True))
+#: step name -> factory(policy) -- the CLI's ``--step`` catalogue.
+#: Keys are the short names (target name minus the ``step:`` prefix),
+#: in sweep order; the resnet50 pair sits last (the slowest traces,
+#: behind the ``--no-resnet50`` knob).
+STEP_FACTORIES = {
+    'mlp_example': lambda policy=None: mlp_step_target(policy=policy),
+    'zero_core': lambda policy=None: zero_core_target(),
+    'zero': lambda policy=None: zero_step_target(policy=policy),
+    'bucketed_overlap':
+        lambda policy=None: bucketed_overlap_step_target(
+            policy=policy),
+    'pipeline':
+        lambda policy=None: pipeline_step_target(policy=policy),
+    'transformer_tp':
+        lambda policy=None: transformer_tp_step_target(policy=policy),
+    'transformer_pp':
+        lambda policy=None: transformer_pp_step_target(policy=policy),
+    'transformer_tp_pp':
+        lambda policy=None: transformer_tp_pp_step_target(
+            policy=policy),
+    'serve_forward':
+        lambda policy=None: serve_forward_target(policy=policy),
+    'decode_forward':
+        lambda policy=None: decode_forward_target(policy=policy),
+    'resnet50_example':
+        lambda policy=None: resnet50_step_target(policy=policy),
+    'resnet50_fused':
+        lambda policy=None: resnet50_step_target(policy=policy,
+                                                 fused_norm=True),
+}
+
+
+def step_targets(include_resnet50=True, policy=None, names=None):
+    """Build step targets from :data:`STEP_FACTORIES`.
+
+    ``names`` (an iterable of registry keys -- the CLI's repeatable
+    ``--step``) builds exactly those, in registry order; unknown names
+    raise ``ValueError`` naming the catalogue.  Default: the full
+    sweep, with the resnet50 A/B pair (the SL008 / memtraffic pair
+    ``ci/run_staticcheck.sh`` sweeps in both precisions) gated on
+    ``include_resnet50``.
+    """
+    if names is not None:
+        unknown = sorted(set(names) - set(STEP_FACTORIES))
+        if unknown:
+            raise ValueError(
+                'unknown step target(s): %s (valid: %s)'
+                % (', '.join(unknown), ', '.join(STEP_FACTORIES)))
+        picked = set(names)
+        return [factory(policy=policy)
+                for name, factory in STEP_FACTORIES.items()
+                if name in picked]
+    out = []
+    for name, factory in STEP_FACTORIES.items():
+        if not include_resnet50 and name.startswith('resnet50'):
+            continue
+        out.append(factory(policy=policy))
     return out
 
 
 def default_targets(strategies=None, include_steps=True,
-                    include_resnet50=True, policy=None):
+                    include_resnet50=True, policy=None, steps=None):
     """``policy`` sweeps every target under a mixed-precision policy:
     strategies constructed with its reduce dtype, updaters with the
-    policy itself -- the second pass of ``ci/run_staticcheck.sh``."""
+    policy itself -- the second pass of ``ci/run_staticcheck.sh``.
+    ``steps`` (step registry names) overrides the step sweep with
+    exactly those targets."""
     out = strategy_targets(
         strategies,
         reduce_dtype=policy.reduce_dtype if policy is not None
         else None)
-    if include_steps:
+    if steps is not None:
+        out.extend(step_targets(policy=policy, names=steps))
+    elif include_steps:
         out.extend(step_targets(include_resnet50=include_resnet50,
                                 policy=policy))
     return out

@@ -34,6 +34,8 @@ from chainermn_tpu.communicators.flat_communicator import FlatCommunicator
 from chainermn_tpu.communicators.hierarchical_communicator import (
     HierarchicalCommunicator)
 from chainermn_tpu.communicators.naive_communicator import NaiveCommunicator
+from chainermn_tpu.communicators.recording import (  # noqa
+    RecordingCommunicator, simulate_protocol)
 from chainermn_tpu.communicators.non_cuda_aware_communicator import (
     NonCudaAwareCommunicator)
 from chainermn_tpu.communicators.single_node_communicator import (

@@ -541,6 +541,19 @@ class CommunicatorBase:
                              seq=self._next_eager_seq(
                                  'allreduce_obj')):
             vals = multihost_utils.process_allgather(value)
+        from chainermn_tpu.utils import chaos
+        if chaos._active is not None:
+            for _ in range(chaos.extra_collectives()):
+                # phantom collective: same span + seq discipline as a
+                # real rendezvous, but NO peer participates -- this
+                # rank's recorded protocol stream diverges while the
+                # run proceeds (the protocol-divergence doctor bait;
+                # never touches _barrier_epochs, so no real wait)
+                with _telemetry.span(
+                        'allreduce_obj', kind='collective', op=op,
+                        axes=list(self.mesh.axis_names),
+                        seq=self._next_eager_seq('allreduce_obj')):
+                    pass
 
         def red(stack):
             if op == 'mean':

@@ -1,0 +1,148 @@
+"""Recording fake communicator for cross-rank protocol verification.
+
+:class:`RecordingCommunicator` mirrors the EAGER protocol surface of
+:class:`~chainermn_tpu.communicators.base.CommunicatorBase`
+(``barrier`` / ``allreduce_obj`` / ``broadcast_data`` / ``send_obj`` /
+``recv_obj``) but performs NO communication: every call appends one
+``(op, kind, peer/axes, tag, seq)`` record to ``self.records``,
+stamped with exactly the sequence-number discipline of the real
+implementation --
+
+* ``barrier``: 1-based per-tag epoch counter (``_barrier_epochs``),
+* ``allreduce_obj`` / ``broadcast_data``: 0-based per-(name, tag)
+  eager-collective counter (``_next_eager_seq``),
+* ``send_obj`` / ``recv_obj``: 0-based per-(peer, tag, channel) stream
+  cursors, and the SAME KV key format
+  (``chainermn_tpu/p2p/<channel>/<src>/<dest>/<tag>/<seq>``) the real
+  channel publishes under, so the matcher in
+  :mod:`chainermn_tpu.analysis.commcheck` reasons about real wire keys
+  (including the rebuilt-communicator seq-0 collision hazard the
+  ``_p2p_channel`` docstring warns about).
+
+:func:`simulate_protocol` drives one protocol function once per
+simulated rank and hands the per-rank record streams to
+``commcheck.verify_streams`` / ``commcheck.match_p2p`` -- the SL013 /
+SL014 static twins of the run-time channel.
+"""
+
+P2P_KEY_FMT = 'chainermn_tpu/p2p/%s/%d/%d/%d/%d'
+
+
+class RecordingCommunicator:
+    """A fake eager communicator that logs instead of communicating.
+
+    Args:
+      rank: the simulated process index this instance plays.
+      size: the simulated process count (world size).
+      channel: p2p channel namespace (the real communicator derives it
+        from the mesh fingerprint; any stable string works here).
+      records: optionally share another instance's record list -- used
+        by :meth:`rebuilt` to model a communicator rebuilt over the
+        same mesh (same channel, FRESH seq counters: the documented
+        key-collision hazard).
+    """
+
+    def __init__(self, rank, size, channel='sim', records=None):
+        self.rank = int(rank)
+        self.size = int(size)
+        self.channel = channel
+        self.records = records if records is not None else []
+        self._eager_coll_seq = {}
+        self._barrier_epochs = {}
+        self._send_seq = {}
+        self._recv_seq = {}
+
+    # introspection parity with CommunicatorBase
+    @property
+    def intra_rank(self):
+        return self.rank
+
+    def rebuilt(self):
+        """A fresh communicator over the SAME channel with reset seq
+        counters -- the rebuild-mid-conversation hazard
+        (``base.py _p2p_channel`` docstring): its first ``send_obj``
+        reuses an already-published key."""
+        return RecordingCommunicator(self.rank, self.size,
+                                     channel=self.channel,
+                                     records=self.records)
+
+    def _rec(self, **kw):
+        kw['rank'] = self.rank
+        self.records.append(kw)
+        return kw
+
+    def _next_eager_seq(self, name, tag=None):
+        seqs = self._eager_coll_seq
+        key = (name, tag)
+        n = seqs.get(key, 0)
+        seqs[key] = n + 1
+        return n
+
+    # -- eager collectives ---------------------------------------------
+    def barrier(self, timeout=60.0, tag='barrier'):
+        if self.size == 1:
+            return
+        n = self._barrier_epochs[tag] = (
+            self._barrier_epochs.get(tag, 0) + 1)
+        self._rec(op='barrier', kind='collective', tag=tag, seq=n)
+
+    def allreduce_obj(self, value, op='mean', timeout=None):
+        if self.size == 1:
+            return value
+        if timeout is not None:
+            self.barrier(timeout=timeout, tag='allreduce_obj')
+        self._rec(op='allreduce_obj', kind='collective', tag=None,
+                  seq=self._next_eager_seq('allreduce_obj'), detail=op)
+        return value
+
+    def broadcast_data(self, params, root=0):
+        # eager multihost broadcast: a local replicate on every
+        # process (base.py broadcast_data) -- recorded for the stream
+        # comparison but NOT a blocking rendezvous for the matcher
+        self._rec(op='broadcast_data', kind='collective', tag=None,
+                  seq=self._next_eager_seq('broadcast_data'),
+                  detail=root)
+        return params
+
+    # -- eager p2p ------------------------------------------------------
+    def send_obj(self, obj, dest, tag=0, channel=None, timeout=30.0):
+        dest = int(dest)
+        channel = channel if channel is not None else self.channel
+        stream = (dest, tag, channel)
+        seq = self._send_seq.get(stream, 0)
+        self._rec(op='send_obj', kind='p2p', peer=dest, tag=tag,
+                  seq=seq, channel=channel,
+                  key=P2P_KEY_FMT % (channel, self.rank, dest, tag,
+                                     seq))
+        self._send_seq[stream] = seq + 1
+
+    def recv_obj(self, source, tag=0, timeout=120.0, channel=None):
+        source = int(source)
+        channel = channel if channel is not None else self.channel
+        stream = (source, tag, channel)
+        seq = self._recv_seq.get(stream, 0)
+        self._rec(op='recv_obj', kind='p2p', peer=source, tag=tag,
+                  seq=seq, channel=channel,
+                  key=P2P_KEY_FMT % (channel, source, self.rank, tag,
+                                     seq))
+        self._recv_seq[stream] = seq + 1
+        return None
+
+
+def simulate_protocol(protocol, world_size, channel='sim'):
+    """``{rank: [record, ...]}`` from running ``protocol(comm)`` once
+    per simulated rank of a ``world_size`` fleet.
+
+    Each rank gets a fresh :class:`RecordingCommunicator`; the
+    protocol function sees the usual eager surface (``comm.rank`` /
+    ``comm.size`` / ``comm.barrier`` / ...), so REAL protocol code can
+    be pointed at it unchanged.  A Python branch on ``comm.rank`` that
+    adds or reorders a collective shows up as diverging streams --
+    exactly what ``commcheck.verify_streams`` flags as SL013.
+    """
+    streams = {}
+    for rank in range(world_size):
+        comm = RecordingCommunicator(rank, world_size, channel=channel)
+        protocol(comm)
+        streams[rank] = comm.records
+    return streams

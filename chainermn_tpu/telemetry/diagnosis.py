@@ -160,6 +160,44 @@ def pair_collectives(spans):
     return groups
 
 
+def protocol_divergence(spans, exclude_ranks=()):
+    """Replay per-rank eager collective ``seq`` streams from a
+    capture through the cross-rank checker core
+    (:func:`chainermn_tpu.analysis.commcheck.verify_streams`) -- the
+    DYNAMIC twin of shardlint's SL013: the static rule feeds the same
+    core simulated streams, this replay feeds it recorded spans, so
+    the two verdicts cannot drift apart.
+
+    Streams are each rank's ``kind='collective'`` spans carrying a
+    ``seq`` (the PR 8 pairing stamps), in ``t0`` order.
+    ``exclude_ranks`` removes ranks already explained by crash
+    analysis: a dead rank's stream ends early by DEATH, which is the
+    crash verdict's finding, not a protocol divergence.  Returns
+    ``None`` when the surviving streams agree (or fewer than two
+    ranks recorded collectives), else the checker's divergence dict
+    (first divergent position, each rank's op and surrounding ops).
+    """
+    excl = {int(r) for r in exclude_ranks}
+    by_rank = {}
+    for s in spans:
+        if s.get('kind') != 'collective' or 'seq' not in s:
+            continue
+        r = int(s.get('rank', 0))
+        if r in excl:
+            continue
+        by_rank.setdefault(r, []).append(s)
+    if len(by_rank) < 2:
+        return None
+    streams = {}
+    for r, recs in by_rank.items():
+        recs.sort(key=lambda s: float(s.get('t0', 0.0)))
+        streams[r] = [{'op': s.get('name'), 'kind': 'collective',
+                       'tag': s.get('tag'), 'seq': int(s['seq'])}
+                      for s in recs]
+    from chainermn_tpu.analysis import commcheck
+    return commcheck.verify_streams(streams)
+
+
 def estimate_clock_offsets(groups, ranks=None):
     """Per-rank wall-clock offset (seconds; subtract from a rank's
     timestamps to land on the common clock), estimated from paired
@@ -659,8 +697,12 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
         r: rec.get('reason') for r, rec in sorted(flights.items())
         if rec.get('reason') in ('ChannelTimeout', 'PeerDeadError',
                                  'CheckpointCorruptError')}
+    # protocol replay: did every (surviving) rank issue the same
+    # collectives in the same order?  Dead ranks are excluded -- a
+    # stream truncated by death is the crash verdict's finding.
+    protocol = protocol_divergence(spans, exclude_ranks=dead)
     healthy = (not dead and not straggler and not anomalies
-               and not typed_flights)
+               and not typed_flights and protocol is None)
     summary = []
     for r in dead:
         info = crash['per_rank'][r]
@@ -698,6 +740,14 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
         if r not in dead:
             summary.append('rank %d hit a typed failure: %s (see its '
                            'flight record)' % (r, reason))
+    if protocol is not None:
+        summary.append('protocol divergence at %s'
+                       % protocol['summary'])
+        for r, info in sorted(protocol['ranks'].items()):
+            summary.append(
+                'rank %s ops around position %d: %s'
+                % (r, protocol['position'],
+                   ' '.join(info['context']) or '(stream ended)'))
     if anomalies and not straggler:
         a = anomalies[0]
         summary.append(
@@ -770,9 +820,11 @@ def diagnose(outdir, liveness_dirs=(), z=MAD_Z):
         'step_anomalies': anomalies,
         'input_bound': input_bound,
         'crash': crash,
+        'protocol_divergence': protocol,
         'verdict': {
             'healthy': healthy,
             'dead_ranks': dead,
+            'protocol_divergence': protocol,
             'straggler_rank': (None if straggler is None
                                else straggler['rank']),
             'straggler_phase': (None if straggler is None
@@ -874,6 +926,16 @@ def render_doctor_text(diag):
                 r, b.get('name'),
                 ', '.join('%s=%s' % (k, v) for k, v in sorted(b.items())
                           if k not in ('name', 'kind', 't0'))))
+    protocol = diag.get('protocol_divergence')
+    if protocol is not None:
+        lines.append('protocol divergence: first divergent position '
+                     '%d (%s)' % (protocol['position'],
+                                  protocol['kind']))
+        for r, info in sorted(protocol['ranks'].items()):
+            lines.append('  rank %s: %s   around: %s'
+                         % (r, info['op'] or '<stream ended>',
+                            ' '.join(info['context'])
+                            or '(stream ended)'))
     lines.append('verdict: %s' % ('HEALTHY' if diag['verdict']['healthy']
                                   else 'UNHEALTHY'))
     for s in diag['verdict']['summary']:

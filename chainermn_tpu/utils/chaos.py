@@ -123,6 +123,17 @@ Sites (the action is part of the site name):
                     with a typed ``failure.DataCorruptError``
                     (kind=crc, shard+offset named) and the loader
                     must skip-and-count it, never silently consume
+``extra_collective``  record ARG (default 1) PHANTOM eager collective
+                    span(s) after an ``allreduce_obj`` rendezvous:
+                    the per-rank eager ``seq`` counter advances and
+                    the span lands in the telemetry capture, but no
+                    peer participates -- this rank's recorded
+                    collective stream diverges while the run itself
+                    completes.  Combine with ``rank=N`` to model the
+                    classic SPMD bug (a Python branch on rank issuing
+                    an extra collective); the doctor's
+                    protocol-divergence verdict must replay the
+                    capture and name the divergence point
 ==================  ====================================================
 
 Example -- drop the first publish, delay half the rest, stall the
@@ -145,7 +156,7 @@ SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
          'nan_batch', 'sigterm_step', 'kill_step', 'hang_step',
          'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip',
          'serve_burst', 'serve_cancel', 'swap_kill', 'serve_slow',
-         'data_stall', 'data_corrupt')
+         'data_stall', 'data_corrupt', 'extra_collective')
 
 
 class InjectedFault(RuntimeError):
@@ -475,6 +486,25 @@ def on_serve_submit():
     if r is None:
         return 0
     return max(1, int(r.arg) if r.arg is not None else 4)
+
+
+def extra_collectives():
+    """``extra_collective``: the number of PHANTOM eager collective
+    spans ``allreduce_obj`` should record after the real rendezvous
+    (0 = none).  The phantom advances this rank's per-(name, tag)
+    eager ``seq`` counter and is recorded like a real collective, but
+    no cross-process rendezvous happens -- the run completes while
+    this rank's captured protocol stream gains ops its peers never
+    issued, which is exactly the divergence ``telemetry doctor``'s
+    protocol-divergence replay (``commcheck.verify_streams``) must
+    name."""
+    inj = _active
+    if inj is None:
+        return 0
+    r = inj.fires('extra_collective')
+    if r is None:
+        return 0
+    return max(1, int(r.arg) if r.arg is not None else 1)
 
 
 def on_swap(phase=None):

@@ -48,7 +48,7 @@ python -m pytest tests/test_multiprocess.py -q --runslow \
 # uninterrupted loss trajectory.  See docs/fault_tolerance.md.
 echo "=== multi-controller chaos leg: real jax.distributed CPU processes ==="
 python -m pytest tests/test_multiprocess.py -q --runslow \
-  -k 'not elastic and not corrupt and not doctor'
+  -k 'not elastic and not corrupt and not doctor and not protocol'
 
 # TELEMETRY DOCTOR LEG (ISSUE 8 acceptance): the cross-rank
 # diagnosis proved end-to-end over real jax.distributed processes.
@@ -62,6 +62,19 @@ python -m pytest tests/test_multiprocess.py -q --runslow \
 # seq, and the open recv_obj span the survivor was blocked in.
 echo "=== telemetry doctor leg: straggler attribution + crash post-mortem ==="
 python -m pytest tests/test_multiprocess.py -q --runslow -k 'doctor'
+
+# PROTOCOL-DIVERGENCE LEG (ISSUE 16 acceptance): the commcheck
+# dynamic twin proved over real jax.distributed processes.  Two
+# 2-proc runs of an interleaved allreduce_obj/barrier protocol:
+# (1) CLEAN -- the doctor's protocol-divergence verdict must be
+# silent and the capture healthy; (2) chaos-injected
+# (rank=1;extra_collective=@1) -- rank 1 records one phantom
+# collective span mid-protocol, and `telemetry doctor` must name the
+# first divergent position with each rank's surrounding ops (the
+# same commcheck.verify_streams core the static gate runs, fed from
+# the replayed per-rank seq streams).  See docs/observability.md.
+echo "=== protocol-divergence leg: commcheck replay over real processes ==="
+python -m pytest tests/test_multiprocess.py -q --runslow -k 'protocol'
 
 # SUPERVISOR LEG (ISSUE 9): the self-healing loop proved unattended
 # over real jax.distributed CPU procs -- one `python -m

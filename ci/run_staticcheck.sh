@@ -171,6 +171,81 @@ print('decode OK: decode_forward swept, no errors, SL010 family '
 " "$1"
 }
 
+# commcheck gate (docs/static_analysis.md "Cross-rank verification"):
+# the cross-rank communication verifier must have swept EVERY
+# registered strategy and the eager reference protocol at world sizes
+# {2,3,4} -- and every target (strategies, step/plan jaxprs, 1F1B
+# schedules) must be SL013/SL014-clean.  The second half is the
+# firing self-test: the verifier itself is exercised against three
+# known-bad protocols (rank-branched collective, unmatched send,
+# broken multi-step ppermute chain) and must name the ranks and ops
+# -- a commcheck that stops firing passes the clean sweep trivially,
+# so the gate pins both directions in BOTH precision sweeps.
+check_commcheck() {
+  python -c "
+import json, sys
+report = json.load(open(sys.argv[1]))
+cc = report.get('commcheck')
+assert cc, 'commcheck section missing from the report'
+assert cc['world_sizes'] == [2, 3, 4], cc['world_sizes']
+from chainermn_tpu.communicators import _COMMUNICATORS
+assert sorted(cc['strategies']) == sorted(_COMMUNICATORS), (
+    cc['strategies'])
+assert cc['ok'], 'commcheck sweep not clean: %r' % cc
+assert not cc['skipped'], 'strategies skipped: %r' % cc['skipped']
+assert all(p['ok'] for p in cc['protocols']), cc['protocols']
+assert all(s['ok'] for s in cc['pipeline_schedules']), (
+    cc['pipeline_schedules'])
+bad = [f for f in report['findings']
+       if f['rule'] in ('SL013', 'SL014') and f['severity'] == 'error']
+assert not bad, 'cross-rank findings on real targets: %r' % bad
+print('commcheck OK: %d strategies x ws %s clean, %d stream traces, '
+      '%d eager protocols, %d pipeline schedules'
+      % (len(cc['strategies']), cc['world_sizes'],
+         cc['n_stream_traces'], len(cc['protocols']),
+         len(cc['pipeline_schedules'])))
+"  "$1"
+}
+
+check_commcheck_fires() {
+  JAX_PLATFORMS=cpu python -c "
+from chainermn_tpu.analysis import commcheck
+from chainermn_tpu.communicators.recording import (
+    RecordingCommunicator, simulate_protocol)
+
+# 1. rank-branched collective: rank 1 issues an extra allreduce.
+def branched(comm):
+    comm.allreduce_obj(1.0, op='mean')
+    if comm.rank == 1:
+        comm.allreduce_obj(2.0, op='sum')
+    comm.barrier(tag='sync')
+d = commcheck.verify_streams(simulate_protocol(branched, 3))
+assert d is not None, 'rank-branched collective not detected'
+assert d['position'] == 1 and 1 in d['ranks'], d
+assert 'rank 1' in d['summary'] and 'allreduce_obj' in d['summary'], d
+
+# 2. unmatched send: rank 0 sends to a rank that never receives.
+def lonely_send(comm):
+    if comm.rank == 0:
+        comm.send_obj({'x': 1}, dest=1, tag=9)
+items = commcheck.match_p2p(simulate_protocol(lonely_send, 2))
+kinds = [i['kind'] for i in items]
+assert 'unmatched_send' in kinds, items
+msg = [i for i in items if i['kind'] == 'unmatched_send'][0]
+assert 0 in msg['ranks'] and 'tag' in msg['message'], msg
+
+# 3. broken multi-step ppermute chain: the composed permutation
+#    never delivers to rank 3 on a size-4 axis.
+d = commcheck.check_ppermute_chain([(0, 1), (1, 2)], size=4, n_steps=3)
+assert d is not None and d['unreachable'] == [3], d
+assert 'rank(s) [3]' in d['message'], d
+assert commcheck.check_ppermute_chain(
+    [(i, (i + 1) % 4) for i in range(4)], size=4, n_steps=8) is None
+print('commcheck firing self-test OK: rank-branch @pos %d, '
+      'unmatched send named, broken chain named' % 1)
+"
+}
+
 out_f32=$(mktemp)
 out_bf16=$(mktemp)
 trap 'rm -f "$out_f32" "$out_bf16"' EXIT
@@ -181,9 +256,12 @@ check_sl009 "$out_f32"
 check_sl010 "$out_f32"
 check_serve "$out_f32"
 check_decode "$out_f32"
+check_commcheck "$out_f32"
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16 | tee "$out_bf16"
 check_memtraffic "$out_bf16"
 check_sl009 "$out_bf16"
 check_sl010 "$out_bf16"
 check_serve "$out_bf16"
 check_decode "$out_bf16"
+check_commcheck "$out_bf16"
+check_commcheck_fires

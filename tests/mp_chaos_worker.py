@@ -55,6 +55,15 @@ Scenarios (``CMN_MP_SCENARIO``):
                     record snapshots the open span) -- the doctor
                     must name the dead rank, its last completed
                     collective seq, and where rank 0 was blocked
+- ``tele_protocol`` telemetry-captured interleaved collective
+                    protocol (allreduce_obj then barrier per lap);
+                    clean, every rank's (op, seq) stream is
+                    identical and the doctor's protocol-divergence
+                    verdict stays None; with
+                    ``rank=1;extra_collective=@1`` rank 1 records
+                    one phantom allreduce span mid-protocol and the
+                    doctor must name the first divergent position
+                    with each rank's surrounding ops
 """
 
 import json
@@ -569,6 +578,26 @@ def scenario_tele_dead(rank, nprocs, outdir, res):
     os._exit(0)
 
 
+def scenario_tele_protocol(rank, nprocs, outdir, res):
+    """Interleaved op kinds on purpose: allreduce_obj THEN barrier
+    each lap, so an injected phantom collective
+    (``rank=1;extra_collective=@1`` -- fired inside rank 1's second
+    allreduce_obj) lands BETWEEN two different op kinds and the
+    replayed streams diverge as a positional (op, seq) MISMATCH, not
+    a benign common-prefix truncation.  The phantom records a span
+    and advances the eager seq but never rendezvouses, so the run
+    itself completes -- exactly the class of silent protocol skew
+    commcheck exists to catch."""
+    from chainermn_tpu import telemetry
+    comm = _comm(nprocs)
+    res['telemetry_on'] = telemetry.enabled()
+    for lap in range(4):
+        comm.allreduce_obj(float(lap), op='mean', timeout=60.0)
+        comm.barrier(tag='proto', timeout=60.0)
+    res['laps'] = 4
+    telemetry.flush()
+
+
 SCENARIOS = {
     'p2p_ring': scenario_p2p_ring,
     'scatter': scenario_scatter,
@@ -581,6 +610,7 @@ SCENARIOS = {
     'nan_guard': scenario_nan_guard,
     'tele_skew': scenario_tele_skew,
     'tele_dead': scenario_tele_dead,
+    'tele_protocol': scenario_tele_protocol,
 }
 
 

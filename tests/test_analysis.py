@@ -372,7 +372,8 @@ def test_resnet50_fused_step_lints_fully_clean():
 def test_rule_catalogue_is_complete():
     assert sorted(rules_mod.RULES) == [
         'SL001', 'SL002', 'SL003', 'SL004', 'SL005', 'SL006', 'SL007',
-        'SL008', 'SL009', 'SL010', 'SL011', 'SL012']
+        'SL008', 'SL009', 'SL010', 'SL011', 'SL012', 'SL013', 'SL014',
+        'SL015']
 
 
 def test_report_json_roundtrip():
@@ -874,3 +875,353 @@ def test_transformer_tp_target_lints_clean_both_precisions():
         assert target.plan_axes == ('data', 'model')
         fs = analysis.lint_target(target)
         assert fs == [], (policy, fs)
+
+
+# ------------------------------------------ SL013/SL014/SL015 commcheck
+# the cross-rank verifier (chainermn_tpu/analysis/commcheck.py): one
+# known-bad fixture per failure mode asserting ranks and ops are
+# NAMED, one clean twin per surface, and the multi-world-size
+# clean-sweep regression the CI gate pins.
+from chainermn_tpu.analysis import commcheck  # noqa: E402
+from chainermn_tpu.communicators.recording import (  # noqa: E402
+    simulate_protocol)
+
+
+def test_sl013_rank_branched_collective_fires():
+    """The canonical SPMD bug: ``if rank == 1: allreduce()`` -- one
+    rank issues an extra collective and the fleet wedges at the next
+    rendezvous.  The verifier must name the first divergent position
+    and each rank's op there."""
+    def branched(comm):
+        comm.allreduce_obj(0.0, op='mean')
+        if comm.rank == 1:
+            comm.allreduce_obj(1.0, op='sum')
+        comm.barrier(tag='sync')
+
+    streams = simulate_protocol(branched, 3)
+    d = commcheck.verify_streams(streams)
+    assert d is not None
+    assert d['position'] == 1 and d['kind'] == 'mismatch', d
+    assert 'rank 1 issues allreduce_obj' in d['summary'], d
+    assert d['ranks'][0]['op'].startswith('barrier'), d
+    # the same streams through the rule surface fire SL013
+    ctx = rules_mod.RuleContext('fixture', rank_streams=streams)
+    fs = rules_mod.rule_rank_divergence(ctx)
+    assert _ids(fs, 'error') == ['SL013'], fs
+    assert 'position 1' in fs[0].message
+
+
+def test_sl013_reordered_collective_fires():
+    # same multiset of collectives, different ORDER on rank 0: still a
+    # divergence (rendezvous matches positionally, not by multiset)
+    def reordered(comm):
+        if comm.rank == 0:
+            comm.barrier(tag='a')
+            comm.allreduce_obj(0.0, op='mean')
+        else:
+            comm.allreduce_obj(0.0, op='mean')
+            comm.barrier(tag='a')
+
+    d = commcheck.verify_streams(simulate_protocol(reordered, 2))
+    assert d is not None and d['position'] == 0, d
+
+
+def test_sl013_clean_protocol_is_silent():
+    """The canonical eager protocol (startup barrier -> broadcast ->
+    allreduce -> p2p ring -> bounded allreduce -> teardown) is stream-
+    identical and p2p-matched at every world size in the grid."""
+    for ws in (2, 3, 4):
+        streams = simulate_protocol(commcheck.reference_protocol, ws)
+        assert commcheck.verify_streams(streams) is None, ws
+        assert commcheck.match_p2p(streams) == [], ws
+
+
+def test_sl013_rank_addressed_exemption():
+    """Ops DECLARED rank-addressed (a root-only gather, say) are
+    excluded from the stream comparison -- the declared escape hatch
+    for legitimately asymmetric protocols."""
+    def rooted(comm):
+        comm.allreduce_obj(0.0, op='mean')
+        if comm.rank == 0:
+            comm.allreduce_obj(0.0, op='gather')
+        comm.barrier(tag='done')
+
+    streams = simulate_protocol(rooted, 2)
+    assert commcheck.verify_streams(streams) is not None
+    # seqs keep counting through the exempt op, so exemption must
+    # compare (op, tag) streams AFTER filtering -- rebuild with a
+    # distinctly named op to model a declared rank-addressed call
+    for recs in streams.values():
+        for r in recs:
+            if r.get('op') == 'allreduce_obj' and r.get('seq') == 1 \
+                    and r.get('rank') == 0:
+                r['op'] = 'root_gather'
+    assert commcheck.verify_streams(
+        streams, rank_addressed=('root_gather',)) is None
+    ctx = rules_mod.RuleContext('fixture', rank_streams=streams,
+                                rank_addressed=('root_gather',))
+    assert rules_mod.rule_rank_divergence(ctx) == []
+
+
+def test_sl014_unmatched_send():
+    def lonely(comm):
+        if comm.rank == 0:
+            comm.send_obj({'x': 1}, 1, tag=9)
+
+    items = commcheck.match_p2p(simulate_protocol(lonely, 2))
+    assert [i['kind'] for i in items] == ['unmatched_send'], items
+    assert items[0]['ranks'] == [0, 1]
+    assert 'tag 9' in items[0]['message'], items[0]
+
+
+def test_sl014_tag_collision_on_rebuilt_communicator():
+    """The documented ``_p2p_channel`` hazard: a communicator rebuilt
+    over a live channel restarts its send cursors at seq 0 and
+    re-publishes a key the receiver has not consumed yet."""
+    def collide(comm):
+        if comm.rank == 0:
+            comm.send_obj('first', 1, tag=3)
+            comm.rebuilt().send_obj('second', 1, tag=3)
+        else:
+            comm.recv_obj(0, tag=3)
+
+    items = commcheck.match_p2p(simulate_protocol(collide, 2))
+    kinds = {i['kind'] for i in items}
+    assert 'tag_collision' in kinds, items
+    coll = [i for i in items if i['kind'] == 'tag_collision'][0]
+    assert 'rank 0 re-publishes' in coll['message'], coll
+
+
+def test_sl014_deadlock_cycle_names_ranks_and_ops():
+    """recv-before-send on both sides of a 2-rank exchange: the
+    classic head-to-head deadlock; the wait-for cycle must name both
+    ranks and the blocking recv ops."""
+    def headon(comm):
+        comm.recv_obj(1 - comm.rank, tag=0)
+        comm.send_obj(None, 1 - comm.rank, tag=0)
+
+    items = commcheck.match_p2p(simulate_protocol(headon, 2))
+    dl = [i for i in items if i['kind'] == 'deadlock']
+    assert dl, items
+    assert sorted(dl[0]['ranks']) == [0, 1]
+    assert 'rank 0 blocked at recv_obj' in dl[0]['message'], dl[0]
+    assert 'rank 1 blocked at recv_obj' in dl[0]['message'], dl[0]
+
+
+def test_sl014_exited_collective():
+    # rank 1 returns before the barrier every other rank waits at
+    def early_exit(comm):
+        if comm.rank != 1:
+            comm.barrier(tag='sync')
+
+    items = commcheck.match_p2p(simulate_protocol(early_exit, 3))
+    kinds = {i['kind'] for i in items}
+    assert 'exited_collective' in kinds, items
+
+
+def test_sl014_multi_step_ppermute_chain_fires():
+    """A scan-REPEATED partial ppermute whose composed chain never
+    reaches rank 3 of a size-4 axis: bijectivity per application is
+    SL002's business, the broken COMPOSITION is SL014's."""
+    def bad(x):
+        def body(c, _):
+            return lax.ppermute(c, 'intra', [(0, 1), (1, 2)]), ()
+        c, _ = lax.scan(body, x, None, length=3)
+        return c
+
+    fs = _lint_mapped(bad, (jnp.zeros((4,)),))
+    assert 'SL014' in _ids(fs, 'error'), fs
+    msg = [f for f in fs if f.rule_id == 'SL014'][0].message
+    assert 'rank(s) [3]' in msg, msg
+
+
+def test_sl014_full_ring_chain_is_silent():
+    def ring(x):
+        def body(c, _):
+            return lax.ppermute(
+                c, 'intra', [(i, (i + 1) % 4) for i in range(4)]), ()
+        c, _ = lax.scan(body, x, None, length=8)
+        return c
+
+    fs = _lint_mapped(ring, (jnp.zeros((4,)),))
+    assert 'SL014' not in _ids(fs), fs
+
+
+def test_sl015_axis_index_predicated_collective_warns():
+    """A collective under ``lax.cond`` whose predicate derives from
+    ``axis_index``: only SOME ranks enter the branch at run time, so
+    the traced uniformity SL013 relies on is an illusion."""
+    def f(x):
+        idx = lax.axis_index('intra')
+        return lax.cond(idx == 0,
+                        lambda v: lax.psum(v, 'intra'),
+                        lambda v: v * 1.0, x)
+
+    fs = _lint_mapped(f, (jnp.zeros((4,)),))
+    assert 'SL015' in _ids(fs), fs
+    w = [f for f in fs if f.rule_id == 'SL015'][0]
+    assert w.severity == 'warning'
+    assert 'psum' in w.message
+
+
+def test_sl015_rank_addressed_declaration_silences():
+    def f(x):
+        idx = lax.axis_index('intra')
+        return lax.cond(idx == 0,
+                        lambda v: lax.psum(v, 'intra'),
+                        lambda v: v * 1.0, x)
+
+    fs = _lint_mapped(f, (jnp.zeros((4,)),),
+                      rank_addressed=('psum',))
+    assert 'SL015' not in _ids(fs), fs
+
+
+def test_sl015_uniform_cond_is_silent():
+    # data-dependent (but rank-uniform) predicate: no warning
+    def f(x):
+        return lax.cond(x.sum() > 0.0,
+                        lambda v: lax.psum(v, 'intra'),
+                        lambda v: v * 1.0, x)
+
+    fs = _lint_mapped(f, (jnp.zeros((4,)),))
+    assert 'SL015' not in _ids(fs), fs
+
+
+def test_commcheck_clean_sweep_all_strategies():
+    """The CI gate's core cross-rank guarantee: every registered
+    strategy's collective surface is stream-identical at world sizes
+    {2, 3, 4}, the eager protocol matches, and the 1F1B handoff
+    composes at every (stages, microbatches) grid point."""
+    findings, meta = commcheck.run_commcheck()
+    assert findings == [], findings
+    assert meta['ok'] is True
+    assert meta['world_sizes'] == [2, 3, 4]
+    assert sorted(meta['strategies']) == STRATEGIES
+    assert meta['skipped'] == [], meta['skipped']
+    assert all(p['ok'] for p in meta['protocols'])
+    assert all(s['ok'] for s in meta['pipeline_schedules'])
+    assert meta['n_stream_traces'] >= 9 * 3 * 3
+
+
+def test_commcheck_comm_factory_rank_branch_fires():
+    """The fixture surface: a communicator whose traced collective
+    surface depends on the simulated rank -- the static analogue of
+    the Python rank branch -- must fire SL013 naming the method."""
+    class Branchy(NaiveCommunicator):
+        def __init__(self, sim_rank, **kw):
+            super().__init__(**kw)
+            self._sim_rank = sim_rank
+
+        def allreduce_grad(self, grads):
+            out = super().allreduce_grad(grads)
+            if self._sim_rank == 1:
+                out = super().allreduce_grad(out)  # rank 1 only!
+            return out
+
+    def factory(name, rank, world_size):
+        return Branchy(
+            rank,
+            mesh_shape=targets_mod._strategy_mesh_shape(
+                name, world_size),
+            devices=jax.devices()[:world_size])
+
+    findings, meta = commcheck.run_commcheck(
+        strategies=['naive'], world_sizes=(2,), comm_factory=factory)
+    sl13 = [f for f in findings if f.rule_id == 'SL013']
+    assert sl13, (findings, meta)
+    assert any('allreduce_grad' in f.target for f in sl13), sl13
+
+
+def test_commcheck_1f1b_handoff_composes():
+    # direct unit on the schedule simulator feeding match_p2p --
+    # covers microbatch counts below, at and above the stage count
+    for stages in (2, 3, 4):
+        for micro in (1, 3, 8):
+            streams = commcheck.simulate_1f1b_streams(stages, micro)
+            assert commcheck.match_p2p(streams) == [], (stages, micro)
+
+
+def test_doctor_protocol_divergence_synthetic_capture():
+    """The dynamic twin's unit: two synthetic rank span streams, one
+    with a phantom mid-protocol collective -- ``diagnosis.
+    protocol_divergence`` (same ``verify_streams`` core) names the
+    position; the clean capture and the dead-rank exclusion stay
+    None."""
+    from chainermn_tpu.telemetry import diagnosis
+
+    def span(rank, name, seq, t0, tag=None):
+        s = {'rank': rank, 'name': name, 'kind': 'collective',
+             'seq': seq, 't0': t0, 't1': t0 + 0.01}
+        if tag is not None:
+            s['tag'] = tag
+        return s
+
+    spans = [
+        span(0, 'allreduce_obj', 0, 1.0),
+        span(0, 'barrier', 1, 2.0, tag='proto'),
+        span(0, 'allreduce_obj', 1, 3.0),
+        span(1, 'allreduce_obj', 0, 1.0),
+        span(1, 'barrier', 1, 2.0, tag='proto'),
+        span(1, 'allreduce_obj', 1, 3.0),
+        span(1, 'allreduce_obj', 2, 3.5),  # the phantom
+    ]
+    d = diagnosis.protocol_divergence(spans)
+    assert d is not None and d['position'] == 3, d
+    assert d['kind'] == 'truncated', d
+    assert 'rank 1 issues allreduce_obj' in d['summary'], d
+    clean = spans[:-1]
+    assert diagnosis.protocol_divergence(clean) is None
+    # dead ranks are excluded (their stream ends early by DEATH, not
+    # divergence -- the crash analyzer owns that verdict)
+    assert diagnosis.protocol_divergence(
+        spans, exclude_ranks=(1,)) is None
+
+
+def test_cli_step_selector(capsys):
+    import json
+    from chainermn_tpu.analysis.__main__ import main
+    rc = main(['--step', 'mlp_example', '--json', '--no-memtraffic'])
+    data = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert data['targets'] == ['step:mlp_example'], data['targets']
+    # --step alone skips the strategy sweep AND commcheck (targeted
+    # iteration loop); no commcheck section in the report
+    assert data['commcheck'] == {}, data['commcheck']
+
+
+def test_cli_exit_code_contract(monkeypatch, capsys):
+    """The documented contract: 0 clean, 1 error findings, 2 usage
+    error naming the unknown id and the valid catalogue."""
+    import json
+    from chainermn_tpu import analysis as analysis_pkg
+    from chainermn_tpu.analysis.__main__ import main
+
+    # rc 0: a clean targeted run
+    rc = main(['--step', 'zero_core', '--json', '--no-memtraffic'])
+    capsys.readouterr()
+    assert rc == 0
+
+    # rc 1: error findings (an untraceable step -> SL000)
+    def boom_steps(policy=None, names=None):
+        def boom(x):
+            raise RuntimeError('fixture trace failure')
+        return [targets_mod.LintTarget('step:boom', boom,
+                                       (jnp.zeros((4,)),), {})]
+    monkeypatch.setattr(analysis_pkg, 'step_targets', boom_steps)
+    rc = main(['--step', 'mlp_example', '--json', '--no-memtraffic'])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert json.loads(out)['ok'] is False
+    monkeypatch.undo()
+
+    # rc 2: unknown ids, each naming the offender AND the catalogue
+    for argv, needle in (
+            (['--strategy', 'nosuch'], 'xla'),
+            (['--step', 'nosuch'], 'mlp_example'),
+            (['--rules', 'SL999'], 'SL001')):
+        with pytest.raises(SystemExit) as exc:
+            main(argv)
+        assert exc.value.code == 2, argv
+        err = capsys.readouterr().err
+        assert 'nosuch' in err or 'SL999' in err, (argv, err)
+        assert needle in err, (argv, err)

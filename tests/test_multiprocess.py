@@ -522,6 +522,74 @@ def test_doctor_post_mortem_from_flight_records(tmp_path):
 
 
 @pytest.mark.slow
+def test_protocol_divergence(tmp_path):
+    """ISSUE 16 acceptance (dynamic twin): two real 2-process runs of
+    an interleaved allreduce/barrier protocol.  CLEAN: every rank's
+    replayed (op, seq) stream is identical and the doctor's
+    protocol-divergence verdict is None.  INJECTED
+    (``rank=1;extra_collective=@1``): rank 1 records one phantom
+    collective span mid-protocol -- the run still completes, but the
+    doctor (same ``commcheck.verify_streams`` core as the static
+    gate) must name the first divergent position with each rank's
+    surrounding ops and flip the verdict unhealthy."""
+    from chainermn_tpu.telemetry import diagnosis
+
+    clean_dir = str(tmp_path / 'clean_tele')
+    (tmp_path / 'clean').mkdir()
+    results = _chaos(2, tmp_path / 'clean', 'tele_protocol',
+                     telemetry_dir=clean_dir)
+    for r in (0, 1):
+        assert results[r]['telemetry_on'] is True
+        assert results[r]['laps'] == 4
+    diag = diagnosis.diagnose(clean_dir)
+    assert diag['protocol_divergence'] is None, (
+        diag['protocol_divergence'])
+    assert diag['verdict']['protocol_divergence'] is None
+
+    inj_dir = str(tmp_path / 'inj_tele')
+    (tmp_path / 'inj').mkdir()
+    results = _chaos(2, tmp_path / 'inj', 'tele_protocol',
+                     chaos_spec='seed=5;rank=1;extra_collective=@1',
+                     telemetry_dir=inj_dir)
+    for r in (0, 1):
+        assert results[r]['laps'] == 4  # the run itself completes
+    diag = diagnosis.diagnose(inj_dir)
+    d = diag['protocol_divergence']
+    assert d is not None, 'phantom collective not detected'
+    # per lap each rank records barrier[allreduce_obj] (the bounded
+    # allreduce's pre-barrier), allreduce_obj, barrier[proto]; rank
+    # 1's phantom lands after the second real allreduce, so the first
+    # divergent position is 5 -- an op-kind MISMATCH (rank 0's
+    # barrier[proto]#2 vs rank 1's phantom allreduce_obj#2), not a
+    # benign common-prefix truncation
+    assert d['position'] == 5, d
+    assert d['kind'] == 'mismatch', d
+    assert set(d['ranks']) == {0, 1}, d
+    assert d['ranks'][0]['op'].startswith('barrier'), d['ranks'][0]
+    assert d['ranks'][1]['op'].startswith('allreduce_obj'), \
+        d['ranks'][1]
+    assert 'rank 0' in d['summary'] and 'rank 1' in d['summary'], d
+    assert diag['verdict']['healthy'] is False, diag['verdict']
+    assert diag['verdict']['protocol_divergence'] == d
+
+    # the CLI names the divergence point with per-rank context
+    proc = subprocess.run(
+        [sys.executable, '-m', 'chainermn_tpu.telemetry', 'doctor',
+         inj_dir], capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS='cpu'))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'protocol divergence' in proc.stdout, proc.stdout
+    assert 'position 5' in proc.stdout, proc.stdout
+    # ...and stays silent on the clean capture
+    proc = subprocess.run(
+        [sys.executable, '-m', 'chainermn_tpu.telemetry', 'doctor',
+         clean_dir], capture_output=True, text=True,
+        env=dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS='cpu'))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'protocol divergence' not in proc.stdout, proc.stdout
+
+
+@pytest.mark.slow
 def test_nan_burst_divergence_checkpoint_all_ranks(tmp_path):
     # chaos NaN burst in the host batch -> NanGuard stops the run
     # with a DivergenceError and writes the forensic checkpoint on
