@@ -2206,11 +2206,13 @@ SERVE_SIDECAR_KEYS = (
 
 #: generate-row sidecars (--serve --generate): the decode regime's
 #: own vocabulary -- tokens/s, TTFT and inter-token latency, plus
-#: the live SLO monitor's ok/warn/breach verdict (ISSUE 12)
+#: the live SLO monitor's ok/warn/breach verdict (ISSUE 12) and the
+#: paged-KV memory-economy trio (ISSUE 17; None on slot-cache rows)
 GENERATE_SIDECAR_KEYS = (
     'tokens_per_s', 'ttft_p50_ms', 'ttft_p99_ms',
     'intertoken_p50_ms', 'intertoken_p99_ms', 'shed_fraction',
-    'capacity_tok_per_s', 'slo_verdict')
+    'capacity_tok_per_s', 'slo_verdict', 'prefix_hit_rate',
+    'pages_per_request', 'kv_bytes_per_token')
 
 #: fleet-row sidecars (--serve --fleet): the deployment regime's
 #: vocabulary -- swap downtime, swap-attributable drops (the zero
@@ -2541,9 +2543,14 @@ def measure_fleet(argv):
 
 def generate_family(argv):
     """Metric-family name for the autoregressive arm: the --int8-kv
-    A/B banks under its own tag so sidecars never cross-pollinate."""
-    return ('serve_generate_int8kv' if '--int8-kv' in argv
-            else 'serve_generate')
+    and --paged A/Bs bank under their own tags so sidecars never
+    cross-pollinate."""
+    name = 'serve_generate'
+    if '--paged' in argv:
+        name += '_paged'
+    if '--int8-kv' in argv:
+        name += '_int8kv'
+    return name
 
 
 def measure_generate(argv):
@@ -2578,8 +2585,11 @@ def measure_generate(argv):
     n_dev = jax.device_count()
     on_cpu = jax.default_backend() == 'cpu'
     int8_kv = '--int8-kv' in argv
-    _log('generate: backend=%s n_dev=%d int8_kv=%s'
-         % (jax.default_backend(), n_dev, int8_kv))
+    paged = '--paged' in argv
+    prefill_chunk = _flag_value(argv, '--prefill-chunk', None, int)
+    _log('generate: backend=%s n_dev=%d int8_kv=%s paged=%s '
+         'prefill_chunk=%s' % (jax.default_backend(), n_dev, int8_kv,
+                               paged, prefill_chunk))
 
     import jax.numpy as jnp
 
@@ -2608,9 +2618,15 @@ def measure_generate(argv):
     params = init_on_host(
         lambda *a: model.init(*a)['params'], jax.random.PRNGKey(0),
         jnp.zeros((1, 8), jnp.int32))
+    paged_kw = {}
+    if paged:
+        paged_kw = dict(paged=True,
+                        page_size=int(_flag_value(
+                            argv, '--page-size', 16, int)),
+                        prefill_chunk=prefill_chunk)
     engine = serving.GenerationEngine(
         model, params, n_slots=n_slots, max_prompt_len=max_prompt,
-        policy=policy, int8_kv=int8_kv, cache_dir=cache)
+        policy=policy, int8_kv=int8_kv, cache_dir=cache, **paged_kw)
     _log('generate: warmup over prefill buckets %s + decode buckets '
          '%s' % (list(engine.prefill_edges),
                  list(engine.decode_edges)))
@@ -2622,8 +2638,9 @@ def measure_generate(argv):
     # instantaneous, queue sized to hold them all) and read the
     # steady-state token rate -- the ceiling any open-loop offered
     # rate is then set against
-    probe_q = serving.GenerationQueue(max_prompt_len=max_prompt,
-                                      max_queue=4 * n_slots)
+    probe_q = serving.GenerationQueue(
+        max_prompt_len=max_prompt, max_queue=4 * n_slots,
+        page_size=engine.page_size if paged else None)
     probe = serving.open_loop_generate(
         engine, probe_q, rate=1e9, n_requests=2 * n_slots, seed=1,
         prompt_len_range=(4, max_prompt), max_new_tokens=max_new)
@@ -2644,8 +2661,9 @@ def measure_generate(argv):
     capture = _serve_capture_dir(argv)
     from chainermn_tpu.telemetry import slo as slo_mod
     monitor = slo_mod.SLOMonitor(n_slots=n_slots, outdir=capture)
-    queue = serving.GenerationQueue(max_prompt_len=max_prompt,
-                                    max_queue=max(2 * n_slots, 16))
+    queue = serving.GenerationQueue(
+        max_prompt_len=max_prompt, max_queue=max(2 * n_slots, 16),
+        page_size=engine.page_size if paged else None)
     rep = serving.open_loop_generate(
         engine, queue, rate=rate, n_requests=n_requests, seed=0,
         prompt_len_range=(4, max_prompt), max_new_tokens=max_new,
@@ -2653,6 +2671,26 @@ def measure_generate(argv):
 
     mxu_anchor = 290000.0
     value = rep['tokens_per_s'] / n_dev
+
+    # the paged-KV memory-economy sidecars ride EVERY generate row so
+    # the A/B is one column-wise diff: bytes a stored token costs
+    # (cache dtype + int8 scale rows), pages a resident sequence pins
+    # at peak, and the radix index's prefix hit rate (slot-cache rows
+    # carry the bytes number and None for the page-economy pair)
+    d_head = model.d_model // model.n_heads
+    kv_bytes = 2 * model.n_layers * model.n_heads * d_head \
+        * (1 if int8_kv else jnp.dtype(model.dtype).itemsize)
+    if int8_kv:
+        kv_bytes += 2 * model.n_layers * model.n_heads * 4  # scales
+    paged_rep = rep.get('paged')
+    prefix_hit_rate = (
+        round(paged_rep['prefix_hit_rate'], 4)
+        if paged_rep and paged_rep.get('prefix_hit_rate') is not None
+        else None)
+    pages_per_request = (
+        round(paged_rep['peak_pages_in_use'] / float(n_slots), 2)
+        if paged_rep else None)
+
     row = dict(
         stub,
         value=round(value, 2),
@@ -2702,6 +2740,11 @@ def measure_generate(argv):
         prefill_trace_count=rep['prefill_trace_count'],
         decode_trace_count=rep['decode_trace_count'],
         int8_kv=int8_kv,
+        paged=paged,
+        paged_kv=paged_rep,
+        prefix_hit_rate=prefix_hit_rate,
+        pages_per_request=pages_per_request,
+        kv_bytes_per_token=kv_bytes,
         policy={'compute': str(policy.compute_dtype)}
         if policy is not None else None,
     )

@@ -21,9 +21,10 @@ from chainermn_tpu.models.resnet50 import (  # noqa
     ResNet, ResNet50, ResNet101, ResNet152)
 from chainermn_tpu.models.seq2seq import Seq2seq, seq2seq_loss  # noqa
 from chainermn_tpu.models.transformer import (  # noqa
-    TransformerLM, TransformerBlock, decode_step, init_kv_cache,
-    kv_cache_specs, lm_loss, lm_loss_sum, pipeline_parts,
-    pipeline_stage_specs, prefill, tp_oracle, tp_param_specs)
+    TransformerLM, TransformerBlock, decode_step, decode_step_paged,
+    init_kv_cache, init_paged_kv_cache, kv_cache_specs, lm_loss,
+    lm_loss_sum, pipeline_parts, pipeline_stage_specs, prefill,
+    prefill_paged, tp_oracle, tp_param_specs)
 
 
 def get_arch(name, **kwargs):

@@ -378,6 +378,42 @@ def init_kv_cache(model, n_slots, max_len=None, dtype=None, tp=1,
             'v': jnp.zeros(shape, dtype)}
 
 
+def init_paged_kv_cache(model, n_pages, page_size, dtype=None, tp=1,
+                        int8_kv=False):
+    """Zeroed PAGED KV cache: a fixed pool of ``n_pages`` pages of
+    ``page_size`` token positions each, shared by every sequence.
+
+    Layout: ``{'k'|'v': (n_layers, n_pages, page_size, H_local,
+    d_head)}`` (+ ``'k_scale'``/``'v_scale'`` ``(n_layers, n_pages,
+    page_size, H_local)`` f32 under ``int8_kv``) -- the slot cache's
+    layout with the ``(n_slots, S)`` slab axes re-cut into
+    ``(n_pages, page_size)``, so :func:`kv_cache_specs` shards it
+    unchanged (head axis over ``tp``).  Sequences address the pool
+    through per-sequence page tables (:func:`decode_step_paged` /
+    :func:`prefill_paged`); refcounting, prefix sharing and
+    copy-on-write live host-side in
+    :mod:`chainermn_tpu.serving.paged`.  By convention page 0 is the
+    allocator's SCRATCH page: pad rows write there and no live table
+    ever points at it, so garbage writes are structurally harmless.
+    Pages are reused without zeroing -- reads mask by live length.
+    """
+    if model.n_heads % tp:
+        raise ValueError('tp=%d must divide n_heads=%d'
+                         % (tp, model.n_heads))
+    h_local = model.n_heads // tp
+    d_head = model.d_model // model.n_heads
+    dtype = dtype or model.dtype
+    shape = (model.n_layers, int(n_pages), int(page_size), h_local,
+             d_head)
+    if int8_kv:
+        return {'k': jnp.zeros(shape, jnp.int8),
+                'v': jnp.zeros(shape, jnp.int8),
+                'k_scale': jnp.zeros(shape[:-1], jnp.float32),
+                'v_scale': jnp.zeros(shape[:-1], jnp.float32)}
+    return {'k': jnp.zeros(shape, dtype),
+            'v': jnp.zeros(shape, dtype)}
+
+
 def kv_cache_specs(cache, axis='model'):
     """``PartitionSpec`` tree for a cache under tensor parallelism:
     the head dim shards with the attention heads, everything else
@@ -492,28 +528,19 @@ def _head_logits(model, params, x):
         model.tp_axis, params['lm_head']['bias'])
 
 
-def decode_step(model, params, cache, tokens, positions, slots=None):
-    """One incremental decode step: ``tokens`` (N,) int32 -- the last
-    sampled token per row -- at ``positions`` (N,) int32 (0-based;
-    this token's K/V lands there and attention covers
-    ``positions + 1`` cache entries).  ``slots`` (N,) int32 maps rows
-    to cache slots for a compacted active-slot bucket; ``None`` (the
-    full bucket) requires ``N == n_slots`` and reads the cache in
-    place.  Returns ``(logits (N, vocab) f32, new_cache)``.
-
-    Works under ``tp_axis`` inside ``shard_map`` exactly like
-    ``__call__`` (heads and cache sharded over the axis, one psum per
-    half-block); parity vs the full-sequence causal forward is pinned
-    in tests/test_transformer.py, including across slot refills.
+def _decode_core(model, params, cache, tokens, positions, write,
+                 attend):
+    """Shared single-token decode body: embed + per-layer
+    (norm -> qkv -> ``write`` one token's K/V -> ``attend`` the cache
+    -> proj residual -> MLP residual) -> final norm -> head.  The
+    ``write(cache, layer, k_new, v_new)`` / ``attend(cache, layer,
+    q)`` closures are the ONLY difference between the slot-addressed
+    (:func:`decode_step`) and paged (:func:`decode_step_paged`)
+    caches -- paging is a storage indirection, never a model change.
     """
     from chainermn_tpu import ops
     from chainermn_tpu.parallel import tensor
 
-    if slots is None and tokens.shape[0] != cache['k'].shape[1]:
-        raise ValueError(
-            'full-bucket decode needs one row per cache slot '
-            '(%d rows vs %d slots); pass slots= for a compacted '
-            'bucket' % (tokens.shape[0], cache['k'].shape[1]))
     dtype = model.dtype
     tp_mode = model.tp_axis is not None
     if tp_mode:
@@ -524,15 +551,14 @@ def decode_step(model, params, cache, tokens, positions, slots=None):
                      axis=0).astype(dtype)
     x = x + jnp.take(params['pos_embed'], positions,
                      axis=0).astype(dtype)
-    lengths = positions.astype(jnp.int32) + 1
     for i in range(model.n_layers):
         bp = params['block_%d' % i]
         h = ops.layer_norm(x, bp['ln1_scale'],
                            bp['ln1_bias']).astype(dtype)
         qkv = _qkv_proj(h, bp, dtype)               # (N, 3, H, d_head)
         q, k_new, v_new = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-        cache = _write_kv(cache, i, k_new, v_new, slots, positions)
-        attn = _attend_cache(cache, i, q, slots, lengths)
+        cache = write(cache, i, k_new, v_new)
+        attn = attend(cache, i, q)
         attn = attn.reshape(attn.shape[0], -1)
         if tp_mode:
             out = tensor.row_parallel_dense(
@@ -555,6 +581,94 @@ def decode_step(model, params, cache, tokens, positions, slots=None):
                            bp['ff_out'], dtype)
     x = ops.layer_norm(x, params['lnf_scale'], params['lnf_bias'])
     return _head_logits(model, params, x), cache
+
+
+def decode_step(model, params, cache, tokens, positions, slots=None):
+    """One incremental decode step: ``tokens`` (N,) int32 -- the last
+    sampled token per row -- at ``positions`` (N,) int32 (0-based;
+    this token's K/V lands there and attention covers
+    ``positions + 1`` cache entries).  ``slots`` (N,) int32 maps rows
+    to cache slots for a compacted active-slot bucket; ``None`` (the
+    full bucket) requires ``N == n_slots`` and reads the cache in
+    place.  Returns ``(logits (N, vocab) f32, new_cache)``.
+
+    Works under ``tp_axis`` inside ``shard_map`` exactly like
+    ``__call__`` (heads and cache sharded over the axis, one psum per
+    half-block); parity vs the full-sequence causal forward is pinned
+    in tests/test_transformer.py, including across slot refills.
+    """
+    if slots is None and tokens.shape[0] != cache['k'].shape[1]:
+        raise ValueError(
+            'full-bucket decode needs one row per cache slot '
+            '(%d rows vs %d slots); pass slots= for a compacted '
+            'bucket' % (tokens.shape[0], cache['k'].shape[1]))
+    lengths = positions.astype(jnp.int32) + 1
+
+    def write(cache, layer, k_new, v_new):
+        return _write_kv(cache, layer, k_new, v_new, slots, positions)
+
+    def attend(cache, layer, q):
+        return _attend_cache(cache, layer, q, slots, lengths)
+
+    return _decode_core(model, params, cache, tokens, positions,
+                        write, attend)
+
+
+def decode_step_paged(model, params, cache, tokens, positions,
+                      page_tables):
+    """One incremental decode step against a PAGED cache
+    (:func:`init_paged_kv_cache`): ``tokens``/``positions`` (N,) int32
+    as in :func:`decode_step`, plus ``page_tables`` (N, n_max) int32
+    mapping each row's token position ``p`` to pool page
+    ``page_tables[i, p // page_size]``, offset ``p % page_size``.
+
+    The table entry covering ``positions[i]`` must already be
+    allocated (the serving scheduler appends a page BEFORE the tick
+    that crosses a page boundary); entries beyond the live prefix are
+    never read, so idle rows can point at the allocator's scratch
+    page.  Arithmetic is identical to :func:`decode_step` -- parity
+    (including under ``tp_axis`` and int8 KV) is pinned in
+    tests/test_transformer.py.
+    """
+    from chainermn_tpu import ops
+    from chainermn_tpu.precision import quantize_kv
+
+    ps = cache['k'].shape[2]
+    positions = positions.astype(jnp.int32)
+    lengths = positions + 1
+    n = tokens.shape[0]
+    pages = page_tables[jnp.arange(n), positions // ps]
+    offsets = positions % ps
+
+    def write(cache, layer, k_new, v_new):
+        out = dict(cache)
+        if _cache_int8(cache):
+            for name, val in (('k', k_new), ('v', v_new)):
+                qv, scale = quantize_kv(val)
+                out[name] = cache[name].at[
+                    layer, pages, offsets].set(qv)
+                out[name + '_scale'] = cache[name + '_scale'].at[
+                    layer, pages, offsets].set(scale)
+            return out
+        dt = cache['k'].dtype
+        out['k'] = cache['k'].at[layer, pages, offsets].set(
+            k_new.astype(dt))
+        out['v'] = cache['v'].at[layer, pages, offsets].set(
+            v_new.astype(dt))
+        return out
+
+    def attend(cache, layer, q):
+        if _cache_int8(cache):
+            return ops.flash_attention_decode_paged(
+                q, cache['k'][layer], cache['v'][layer], page_tables,
+                lengths, k_scale=cache['k_scale'][layer],
+                v_scale=cache['v_scale'][layer])
+        return ops.flash_attention_decode_paged(
+            q, cache['k'][layer], cache['v'][layer], page_tables,
+            lengths)
+
+    return _decode_core(model, params, cache, tokens, positions,
+                        write, attend)
 
 
 def prefill(model, params, cache, tokens, length, slot):
@@ -633,6 +747,120 @@ def prefill(model, params, cache, tokens, length, slot):
     # a (1, d) slice instead of a (T, vocab) logits block
     x_last = lax.dynamic_slice_in_dim(
         x[0], jnp.asarray(length, jnp.int32) - 1, 1, axis=0)
+    x_last = ops.layer_norm(x_last, params['lnf_scale'],
+                            params['lnf_bias'])
+    return _head_logits(model, params, x_last)[0], cache
+
+
+def prefill_paged(model, params, cache, tokens, length, page_table,
+                  pos0):
+    """Prefill ONE CHUNK of a prompt into a paged cache
+    (:func:`init_paged_kv_cache`): ``tokens`` (1, C) int32 -- the
+    chunk, padded to a fixed width; ``length`` scalar int32 (valid
+    chunk prefix); ``page_table`` (n_max,) int32 -- the sequence's
+    pages; ``pos0`` scalar int32 -- the running absolute position
+    (tokens already banked by earlier chunks).  Returns
+    ``(logits (vocab,) f32 at chunk position length-1, new_cache)``.
+
+    This is the chunked-prefill (SARATHI-style) building block: the
+    scheduler interleaves these fixed-cost calls with decode ticks so
+    a long prompt never freezes inter-token latency.  Each chunk's
+    K/V is scattered into its pages (pad rows land on the scratch
+    page 0); attention is :func:`~chainermn_tpu.ops.
+    flash_attention_chunk` -- causal within the chunk plus the banked
+    context masked at ``pos0`` -- so a whole-prompt call
+    (``pos0 == 0``) computes bitwise the same causal forward as the
+    slot :func:`prefill`.  int8 KV: the chunk half attends the fresh
+    float K/V exactly like the slot prefill; only the banked context
+    is dequantized.  Table entries covering ``[pos0, pos0+length)``
+    must be allocated; nothing before ``pos0`` is written (shared
+    prefix pages stay read-only -- the copy-on-write contract in
+    ``docs/serving.md``).
+    """
+    from chainermn_tpu import ops
+    from chainermn_tpu.parallel import tensor
+    from chainermn_tpu.precision import quantize_kv
+
+    dtype = model.dtype
+    tp_mode = model.tp_axis is not None
+    b, c = tokens.shape
+    if b != 1:
+        raise ValueError('prefill_paged takes one prompt chunk per '
+                         'call, got batch %d' % b)
+    n_max = page_table.shape[0]
+    ps = cache['k'].shape[2]
+    pos0 = jnp.asarray(pos0, jnp.int32)
+    length = jnp.asarray(length, jnp.int32)
+    if tp_mode:
+        x = _tp_embed_rows(params, tokens, model.vocab_size,
+                           model.d_model, dtype, model.tp_axis)
+    else:
+        x = jnp.take(params['embed']['embedding'], tokens,
+                     axis=0).astype(dtype)
+    x = x + lax.dynamic_slice_in_dim(
+        params['pos_embed'], pos0, c, axis=0).astype(dtype)
+
+    # chunk-row -> (page, offset): pad rows (t >= length) go to the
+    # scratch page so the scatter never touches a live table entry
+    t = jnp.arange(c, dtype=jnp.int32)
+    p_abs = pos0 + t
+    page_idx = jnp.clip(p_abs // ps, 0, n_max - 1)
+    pages = jnp.where(t < length, page_table[page_idx].astype(
+        jnp.int32), 0)
+    offsets = p_abs % ps
+    ctx_len = pos0[None]                               # (B=1,)
+    int8_kv = _cache_int8(cache)
+    cache = dict(cache)
+    for i in range(model.n_layers):
+        bp = params['block_%d' % i]
+        h = ops.layer_norm(x, bp['ln1_scale'],
+                           bp['ln1_bias']).astype(dtype)
+        qkv = _qkv_proj(h, bp, dtype)           # (1, C, 3, H, d_head)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        for name, val in (('k', k[0]), ('v', v[0])):
+            if int8_kv:
+                qv, scale = quantize_kv(val)
+                cache[name] = cache[name].at[
+                    i, pages, offsets].set(qv)
+                cache[name + '_scale'] = cache[name + '_scale'].at[
+                    i, pages, offsets].set(scale)
+            else:
+                cache[name] = cache[name].at[i, pages, offsets].set(
+                    val.astype(cache[name].dtype))
+
+        def gather(name):
+            g = jnp.take(cache[name][i], page_table.astype(jnp.int32),
+                         axis=0)
+            return g.reshape((1, n_max * ps) + g.shape[2:])
+
+        if int8_kv:
+            attn = ops.flash_attention_chunk(
+                q, k, v, gather('k'), gather('v'), ctx_len,
+                k_scale=gather('k_scale'), v_scale=gather('v_scale'))
+        else:
+            attn = ops.flash_attention_chunk(q, k, v, gather('k'),
+                                             gather('v'), ctx_len)
+        attn = attn.reshape(1, c, -1)
+        if tp_mode:
+            out = tensor.row_parallel_dense(
+                attn, bp['proj']['kernel'].astype(dtype),
+                model.tp_axis, bp['proj']['bias'].astype(dtype))
+        else:
+            out = _dense(attn, bp['proj'], dtype)
+        x = x + out
+        h = ops.layer_norm(x, bp['ln2_scale'],
+                           bp['ln2_bias']).astype(dtype)
+        if tp_mode:
+            g = nn.gelu(tensor.column_parallel_dense(
+                h, bp['ff_in']['kernel'].astype(dtype),
+                bp['ff_in']['bias'].astype(dtype)))
+            x = x + tensor.row_parallel_dense(
+                g, bp['ff_out']['kernel'].astype(dtype),
+                model.tp_axis, bp['ff_out']['bias'].astype(dtype))
+        else:
+            x = x + _dense(nn.gelu(_dense(h, bp['ff_in'], dtype)),
+                           bp['ff_out'], dtype)
+    x_last = lax.dynamic_slice_in_dim(x[0], length - 1, 1, axis=0)
     x_last = ops.layer_norm(x_last, params['lnf_scale'],
                             params['lnf_bias'])
     return _head_logits(model, params, x_last)[0], cache

@@ -16,8 +16,9 @@ interpret mode only when explicitly requested
 """
 
 from chainermn_tpu.ops.flash_attention import (  # noqa
-    decode_attention_reference, flash_attention,
-    flash_attention_decode, mha_reference)
+    chunk_attention_reference, decode_attention_paged_reference,
+    decode_attention_reference, flash_attention, flash_attention_chunk,
+    flash_attention_decode, flash_attention_decode_paged, mha_reference)
 from chainermn_tpu.ops.cross_entropy import (  # noqa
     softmax_cross_entropy, softmax_cross_entropy_reference)
 from chainermn_tpu.ops.layer_norm import layer_norm, layer_norm_reference  # noqa

@@ -745,6 +745,455 @@ def flash_attention_decode(q, k, v, lengths, scale=None,
     return out.reshape(b, h, d)
 
 
+# ----------------------------------------------------------------------
+# paged decode attention -- the same single-query online-softmax
+# recurrence as flash_attention_decode, but the KV cache is a POOL of
+# fixed-size pages shared across sequences (vLLM-style PagedAttention)
+# and each sequence reads its own pages through a PER-SEQUENCE page
+# table: key-block j of sequence b lives at page ``page_tables[b, j]``.
+# The page table rides in SMEM (scalar prefetch), so the kernel's
+# key-block grid axis is INDIRECT -- one HBM pass over only the pages
+# the sequence actually owns, never the whole pool.  Pages past the
+# sequence's fill level are skipped (dynamic pl.when) and their DMA is
+# elided by clamping the fetched page index at the live frontier, the
+# same idiom as the causal frontier clamp in _fwd_pallas.
+#
+# int8 KV pages compose exactly like the slot cache: per-(position,
+# head) symmetric scales (precision.quantize_kv) stored page-shaped,
+# dequantized per tile in VMEM.
+# ----------------------------------------------------------------------
+
+def decode_attention_paged_reference(q, k, v, page_tables, lengths,
+                                     scale=None, k_scale=None,
+                                     v_scale=None):
+    """Pure-jnp oracle for :func:`flash_attention_decode_paged`.
+
+    q: (B, H, D) -- the current token's query per sequence;
+    k/v: (P, page_size, H, D) -- the shared page pool (float, or int8
+    with ``k_scale``/``v_scale`` (P, page_size, H) scales);
+    page_tables: (B, n_max_pages) int32 -- page ids per sequence in
+    position order (entries past the live prefix are ignored);
+    lengths: (B,) int32 -- positions ``>= lengths[b]`` are masked out.
+
+    Gathers each sequence's pages into the contiguous (B, S, H, D)
+    layout and defers to :func:`decode_attention_reference` -- which
+    is exactly the correctness claim: paging is a storage indirection,
+    never an arithmetic change.
+    """
+    b = q.shape[0]
+    _, ps, h, d = k.shape
+    tables = page_tables.astype(jnp.int32)
+
+    def gather(x):
+        g = jnp.take(x, tables.reshape(-1), axis=0)
+        return g.reshape((b, tables.shape[1] * ps) + x.shape[2:])
+
+    return decode_attention_reference(
+        q, gather(k), gather(v), lengths, scale=scale,
+        k_scale=None if k_scale is None else gather(k_scale),
+        v_scale=None if v_scale is None else gather(v_scale))
+
+
+def _decode_paged_blockwise_jnp(q, k, v, page_tables, lengths, scale,
+                                k_scale=None, v_scale=None):
+    """Fallback paged decode: ``lax.scan`` over the page-table axis --
+    each step gathers ONE page per sequence and applies the kernel's
+    online-softmax update.  The pool operands enter the scan once
+    (one consumption in the jaxpr) and nothing (S,)-wide is ever
+    materialized beyond the per-page tile."""
+    b, h, d = q.shape
+    ps = k.shape[1]
+    n_max = page_tables.shape[1]
+    qf = q.astype(jnp.float32) * scale                 # (B, H, D)
+    quantized = k_scale is not None
+
+    def body(carry, j):
+        m, l, acc = carry
+        pages = page_tables[:, j]                      # (B,)
+        kj = jnp.take(k, pages, axis=0)                # (B, ps, H, D)
+        vj = jnp.take(v, pages, axis=0)
+        kjf = kj.astype(jnp.float32)
+        vjf = vj.astype(jnp.float32)
+        if quantized:
+            kjf = kjf * jnp.take(k_scale, pages,
+                                 axis=0).astype(jnp.float32)[..., None]
+            vjf = vjf * jnp.take(v_scale, pages,
+                                 axis=0).astype(jnp.float32)[..., None]
+        s = jnp.einsum('bhd,bkhd->bhk', qf, kjf)       # (B, H, ps)
+        k_pos = j * ps + jnp.arange(ps)
+        s = jnp.where(k_pos[None, None, :] < lengths[:, None, None],
+                      s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum('bhk,bkhd->bhd',
+                                                  p, vjf)
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((b, h), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h), jnp.float32)
+    acc0 = jnp.zeros((b, h, d), jnp.float32)
+    (m, l, acc), _ = lax.scan(body, (m0, l0, acc0),
+                              jnp.arange(n_max))
+    l_safe = jnp.maximum(l, 1e-30)
+    return (acc / l_safe[..., None]).astype(q.dtype)
+
+
+def _decode_paged_kernel(table_ref, len_ref, q_ref, k_ref, v_ref,
+                         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref,
+                         *, scale, page_size, n_heads, quantized):
+    """One (batch*head, page) grid cell: a single query row's
+    online-softmax update against one PAGE of the pool.  The page
+    table and per-sequence lengths are scalar-prefetched (SMEM), so
+    the k/v block specs fetch ``page_tables[b, j]`` directly -- the
+    indirection lives in the DMA descriptor, not the compute."""
+    import jax.experimental.pallas as pl
+
+    bh = pl.program_id(0)
+    j = pl.program_id(1)
+    n_pages = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = len_ref[bh // n_heads]
+
+    # pages entirely beyond this sequence's fill level contribute
+    # nothing; their fetch was clamped to the live frontier (elided)
+    @pl.when(j * page_size < length)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32) * scale       # (1, D)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # (ps, D)
+        v = v_ref[0, :, 0, :].astype(jnp.float32)
+        if quantized:
+            k = k * ks_ref[0].astype(jnp.float32)
+            v = v * vs_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (1, ps)
+        k_pos = (j * page_size
+                 + lax.broadcasted_iota(jnp.int32, (1, page_size), 1))
+        s = jnp.where(k_pos < length, s, NEG_INF)
+        m_prev = m_ref[...]                            # (1, 128)
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev,
+                            jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, :1])
+        m_ref[...] = m_new
+        l_ref[...] = (l_prev * alpha
+                      + jnp.sum(p, axis=-1, keepdims=True))
+        acc_ref[...] = acc_ref[...] * alpha[:, :1] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_pages - 1)
+    def _finalize():
+        l_safe = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / l_safe[:, :1]).astype(o_ref.dtype)
+
+
+def _decode_paged_pallas(q, k, v, page_tables, lengths, scale,
+                         k_scale=None, v_scale=None):
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, h, d = q.shape
+    n_pool, ps = k.shape[0], k.shape[1]
+    n_max = page_tables.shape[1]
+    quantized = k_scale is not None
+    q3 = q.reshape(b * h, 1, d)
+
+    def page_at(i, j, table_ref, len_ref):
+        # clamp the fetched page at the live frontier: dead steps
+        # re-fetch the last live page, which Pallas elides
+        seq = i // h
+        last = jnp.maximum((len_ref[seq] - 1) // ps, 0)
+        return table_ref[seq, jnp.minimum(j, last)]
+
+    def kv_ix(i, j, table_ref, len_ref):
+        return (page_at(i, j, table_ref, len_ref), 0, i % h, 0)
+
+    def scale_ix(i, j, table_ref, len_ref):
+        return (page_at(i, j, table_ref, len_ref), 0, i % h)
+
+    def scale_ix0(i, j, table_ref, len_ref):
+        return (page_at(i, j, table_ref, len_ref), 0, 0)
+
+    if quantized:
+        ks, vs = k_scale, v_scale
+        ks_ix = vs_ix = scale_ix
+    else:
+        # zero-size-free placeholders keep one kernel signature; the
+        # quantized flag compiles the dequant multiply in or out
+        ks = jnp.zeros((n_pool, ps, 1), jnp.float32)
+        vs = ks
+        ks_ix = vs_ix = scale_ix0
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,       # page_tables, lengths
+        grid=(b * h, n_max),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda i, j, t, n: (i, 0, 0)),
+            pl.BlockSpec((1, ps, 1, d), kv_ix),
+            pl.BlockSpec((1, ps, 1, d), kv_ix),
+            pl.BlockSpec((1, ps, 1), ks_ix),
+            pl.BlockSpec((1, ps, 1), vs_ix),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, j, t, n: (i, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((1, 128), jnp.float32),         # m (replicated)
+            pltpu.VMEM((1, 128), jnp.float32),         # l (replicated)
+            pltpu.VMEM((1, d), jnp.float32),           # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_paged_kernel, scale=scale,
+                          page_size=ps, n_heads=h,
+                          quantized=quantized),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b * h, 1, d), q.dtype),
+        interpret=interpret_flag(),
+    )(page_tables, lengths, q3, k, v, ks, vs)
+    return out.reshape(b, h, d)
+
+
+def flash_attention_decode_paged(q, k, v, page_tables, lengths,
+                                 scale=None, k_scale=None,
+                                 v_scale=None):
+    """Single-token decode attention against a PAGED KV cache.
+
+    q: (B, H, D) -- one query row per sequence; k/v:
+    (P, page_size, H, D) -- the shared page pool;
+    page_tables: (B, n_max_pages) int32 -- each sequence's pages in
+    position order (token position ``p`` lives at page
+    ``page_tables[b, p // page_size]``, offset ``p % page_size``);
+    lengths: (B,) int32 -- live prefix per sequence.  Table entries at
+    or beyond ``ceil(lengths[b] / page_size)`` are never read, so a
+    host-side allocator can leave them pointing at its scratch page.
+
+    Arithmetic is IDENTICAL to :func:`flash_attention_decode` (same
+    online-softmax recurrence, key-block == page): paging only changes
+    where the blocks live.  The page table is scalar-prefetched into
+    SMEM so the kernel streams exactly the sequence's own pages in one
+    HBM pass -- memory traffic scales with LIVE tokens, not with pool
+    capacity, which is what lets N sequences sharing a prompt prefix
+    read one banked copy (``docs/serving.md``).
+
+    int8 KV pages: pass int8 ``k``/``v`` with per-(position, head)
+    scales ``k_scale``/``v_scale`` (P, page_size, H) from
+    :func:`chainermn_tpu.precision.quantize_kv`, dequantized per tile
+    in VMEM exactly like the slot-cache kernel.
+    """
+    b, h, d = q.shape
+    if k.ndim != 4:
+        raise ValueError('paged cache must be (P, page_size, H, D), '
+                         'got shape %r' % (k.shape,))
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError('int8 KV decode needs BOTH k_scale and '
+                         'v_scale (or neither)')
+    if scale is None:
+        scale = d ** -0.5
+    tables = page_tables.astype(jnp.int32)
+    lens = lengths.astype(jnp.int32)
+    if pallas_mode() == 'fallback':
+        return _decode_paged_blockwise_jnp(q, k, v, tables, lens,
+                                           scale, k_scale, v_scale)
+    return _decode_paged_pallas(q, k, v, tables, lens, scale,
+                                k_scale, v_scale)
+
+
+# ----------------------------------------------------------------------
+# chunked-prefill attention -- a CHUNK of C query rows against the
+# sequence's banked context plus itself.
+#
+# Chunked prefill (SARATHI-style) splits a long prompt into fixed-size
+# chunks interleaved with decode steps.  Chunk queries at absolute
+# positions ``ctx_len + [0, C)`` attend (a) every banked context
+# position ``< ctx_len`` and (b) causally within the chunk.  The two
+# parts are computed with the SAME blockwise online-softmax machinery
+# as the forward kernel and merged exactly via their logsumexps -- for
+# ``ctx_len == 0`` the merge is the identity, so a whole-prompt
+# "chunk" is bitwise the plain causal forward.
+# ----------------------------------------------------------------------
+
+def chunk_attention_reference(q, k_new, v_new, k_ctx, v_ctx, ctx_len,
+                              scale=None, k_scale=None, v_scale=None):
+    """Pure-jnp oracle for :func:`flash_attention_chunk`.
+
+    q/k_new/v_new: (B, C, H, D) -- the chunk's fresh Q/K/V at absolute
+    positions ``ctx_len + [0, C)``; k_ctx/v_ctx: (B, S, H, D) -- the
+    banked context (float, or int8 with (B, S, H) scales); ctx_len:
+    (B,) int32 dynamic context length (ctx positions ``>= ctx_len[b]``
+    are masked out).  Returns (B, C, H, D) in q's dtype.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    c = q.shape[1]
+    kcf = k_ctx.astype(jnp.float32)
+    vcf = v_ctx.astype(jnp.float32)
+    if k_scale is not None:
+        kcf = kcf * k_scale.astype(jnp.float32)[..., None]
+        vcf = vcf * v_scale.astype(jnp.float32)[..., None]
+    kf = jnp.concatenate([kcf, k_new.astype(jnp.float32)], axis=1)
+    vf = jnp.concatenate([vcf, v_new.astype(jnp.float32)], axis=1)
+    s = jnp.einsum('bqhd,bkhd->bhqk', q.astype(jnp.float32),
+                   kf) * scale
+    s_ctx = k_ctx.shape[1]
+    k_pos = jnp.arange(s_ctx + c)[None, None, None, :]
+    q_pos = jnp.arange(c)[None, None, :, None]
+    cl = ctx_len.astype(jnp.int32)[:, None, None, None]
+    in_ctx = jnp.logical_and(k_pos < s_ctx, k_pos < cl)
+    in_chunk = jnp.logical_and(k_pos >= s_ctx,
+                               k_pos - s_ctx <= q_pos)
+    s = jnp.where(jnp.logical_or(in_ctx, in_chunk), s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum('bhqk,bkhd->bqhd', p, vf).astype(q.dtype)
+
+
+def _ctx_blockwise_jnp(q, k, v, ctx_len, scale, block_k,
+                       k_scale=None, v_scale=None):
+    """Non-causal blockwise attention of C query rows against a
+    context masked by a DYNAMIC per-sequence length: the chunk's
+    context half.  Operands are (bh, ...)-merged like the forward
+    fallback; returns (out, lse)."""
+    bh, t_q, d = q.shape
+    t_kv = k.shape[1]
+    n_blocks = t_kv // block_k
+    qf = q.astype(jnp.float32) * scale
+    kb = jnp.swapaxes(k.reshape(bh, n_blocks, block_k, d), 0, 1)
+    vb = jnp.swapaxes(v.reshape(bh, n_blocks, block_k, d), 0, 1)
+    scan_over = [jnp.arange(n_blocks), kb, vb]
+    quantized = k_scale is not None
+    if quantized:
+        scan_over.append(jnp.swapaxes(
+            k_scale.reshape(bh, n_blocks, block_k), 0, 1))
+        scan_over.append(jnp.swapaxes(
+            v_scale.reshape(bh, n_blocks, block_k), 0, 1))
+
+    def body(carry, inp):
+        m, l, acc = carry
+        if quantized:
+            j, kj, vj, ksj, vsj = inp
+            kjf = kj.astype(jnp.float32) * ksj[..., None]
+            vjf = vj.astype(jnp.float32) * vsj[..., None]
+        else:
+            j, kj, vj = inp
+            kjf = kj.astype(jnp.float32)
+            vjf = vj.astype(jnp.float32)
+        s = jnp.einsum('bqd,bkd->bqk', qf, kjf)
+        k_pos = j * block_k + jnp.arange(block_k)
+        s = jnp.where(k_pos[None, None, :] < ctx_len[:, None, None],
+                      s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum('bqk,bkd->bqd',
+                                                  p, vjf)
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((bh, t_q), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, t_q), jnp.float32)
+    acc0 = jnp.zeros((bh, t_q, d), jnp.float32)
+    (m, l, acc), _ = lax.scan(body, (m0, l0, acc0),
+                              tuple(scan_over))
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (acc / l_safe[..., None]).astype(q.dtype)
+    return out, m + jnp.log(l_safe)
+
+
+def flash_attention_chunk(q, k_new, v_new, k_ctx, v_ctx, ctx_len,
+                          scale=None, k_scale=None, v_scale=None,
+                          block_q=None, block_k=None):
+    """Prefill-chunk attention: C fresh query rows at absolute
+    positions ``ctx_len + [0, C)`` against the banked context plus
+    causal self-attention within the chunk.
+
+    q/k_new/v_new: (B, C, H, D); k_ctx/v_ctx: (B, S, H, D) gathered
+    cache rows (int8 with ``k_scale``/``v_scale`` (B, S, H) in int8-KV
+    mode -- the CHUNK half always attends the fresh un-quantized K/V,
+    exactly like the whole-prompt prefill); ctx_len: (B,) int32
+    dynamic.  Context positions ``>= ctx_len[b]`` are masked out, so
+    a fixed-capacity gathered buffer (the page table's full span) is
+    safe to pass regardless of how much of it is banked.
+
+    Computed as two blockwise online-softmax passes -- the causal
+    in-chunk half through the SAME forward path as
+    :func:`flash_attention` (Pallas kernel or jnp fallback), the
+    context half through a dynamic-length jnp scan -- merged exactly
+    via their logsumexps.  With ``ctx_len == 0`` the merge is the
+    identity and the result is bitwise the plain causal forward,
+    which is what pins single-chunk (unchunked) paged prefill to the
+    slot engine's prefill (``tests/test_transformer.py``).
+    """
+    if block_q is None:
+        block_q = _env_block('CHAINERMN_TPU_FA_BLOCK_Q')
+    if block_k is None:
+        block_k = _env_block('CHAINERMN_TPU_FA_BLOCK_K')
+    b, c, h, d = q.shape
+    s_ctx = k_ctx.shape[1]
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError('int8 KV context needs BOTH k_scale and '
+                         'v_scale (or neither)')
+    if scale is None:
+        scale = d ** -0.5
+    block_q = min(block_q, max(c, 1))
+    block_ctx = min(block_k, max(s_ctx, 1))
+    block_k = min(block_k, max(c, 1))
+
+    def merge(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1], d)
+
+    def merge_scale(x):
+        return jnp.swapaxes(x, 1, 2).reshape(b * h, x.shape[1])
+
+    qm = merge(q)
+    km_new, vm_new = merge(k_new), merge(v_new)
+    pad_q = (-c) % block_q
+    pad_k = (-c) % block_k
+    qm_p = jnp.pad(qm, ((0, 0), (0, pad_q), (0, 0))) if pad_q else qm
+    if pad_k:
+        km_new = jnp.pad(km_new, ((0, 0), (0, pad_k), (0, 0)))
+        vm_new = jnp.pad(vm_new, ((0, 0), (0, pad_k), (0, 0)))
+    # in-chunk causal half: the forward kernel/fallback, with lse
+    if pallas_mode() == 'fallback':
+        out_c, lse_c = _fwd_blockwise_jnp(qm_p, km_new, vm_new, True,
+                                          scale, c, block_k)
+    else:
+        out_c, lse_c = _fwd_pallas(qm_p, km_new, vm_new, True, scale,
+                                   c, block_q, block_k)
+    out_c, lse_c = out_c[:, :c], lse_c[:, :c]
+
+    # context half: dynamic-length blockwise scan over banked rows
+    km_ctx, vm_ctx = merge(k_ctx), merge(v_ctx)
+    ksm = merge_scale(k_scale) if k_scale is not None else None
+    vsm = merge_scale(v_scale) if v_scale is not None else None
+    pad_ctx = (-s_ctx) % block_ctx
+    if pad_ctx:
+        km_ctx = jnp.pad(km_ctx, ((0, 0), (0, pad_ctx), (0, 0)))
+        vm_ctx = jnp.pad(vm_ctx, ((0, 0), (0, pad_ctx), (0, 0)))
+        if ksm is not None:
+            ksm = jnp.pad(ksm, ((0, 0), (0, pad_ctx)))
+            vsm = jnp.pad(vsm, ((0, 0), (0, pad_ctx)))
+    ctx_bh = jnp.repeat(ctx_len.astype(jnp.int32), h)
+    out_x, lse_x = _ctx_blockwise_jnp(qm, km_ctx, vm_ctx, ctx_bh,
+                                      scale, block_ctx, ksm, vsm)
+
+    # exact logsumexp merge; empty context (lse_x -> -inf) reduces to
+    # the chunk half bitwise (w_c = exp(0) = 1, w_x = 0)
+    m_tot = jnp.maximum(lse_c, lse_x)
+    w_c = jnp.exp(lse_c - m_tot)[..., None]
+    w_x = jnp.exp(lse_x - m_tot)[..., None]
+    out = (out_c.astype(jnp.float32) * w_c
+           + out_x.astype(jnp.float32) * w_x) / (w_c + w_x)
+    out = out.astype(q.dtype)
+    return jnp.swapaxes(out.reshape(b, h, c, d), 1, 2)
+
+
 def _env_block(name, default=128):
     """Validated env-sourced block size: a fleet-wide launcher knob
     must fail naming itself, not as an opaque int()/ZeroDivision deep

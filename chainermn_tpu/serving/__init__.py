@@ -27,7 +27,15 @@ Three cooperating layers (``docs/serving.md``):
   batching (a finished or cancelled sequence's slot refills from the
   queue at the next decode step), a prefill/decode AOT split (prefill
   bucketed by prompt length, decode by active-slot count), int8
-  KV-cache mode, and the same no-recompile signature guard;
+  KV-cache mode, and the same no-recompile signature guard -- plus
+  the PAGED mode: a pooled KV cache addressed through per-sequence
+  page tables, radix-trie prompt-prefix sharing with copy-on-write,
+  and SARATHI-style chunked prefill interleaved with decode ticks;
+- :mod:`~chainermn_tpu.serving.paged` -- the host-side page
+  accounting behind paged mode: a refcounted :class:`PagePool`
+  (page 0 reserved scratch), the :class:`RadixPrefixIndex` banking
+  completed prompts for cross-request reuse, and the
+  :func:`prefix_key` admission stamp;
 - :mod:`~chainermn_tpu.serving.fleet` -- train-to-serve CONTINUOUS
   DEPLOYMENT (ISSUE 13): a :class:`FleetController` running N engine
   replicas behind a canary-routing :class:`FleetFront`, watching the
@@ -52,4 +60,6 @@ from chainermn_tpu.serving.generate import (  # noqa: F401
     GenerationEngine, GenerationQueue, GenRequest)
 from chainermn_tpu.serving.loadgen import (  # noqa: F401
     open_loop, open_loop_generate)
+from chainermn_tpu.serving.paged import (  # noqa: F401
+    PagePool, RadixPrefixIndex, prefix_key)
 from chainermn_tpu.utils.failure import OverloadError  # noqa: F401

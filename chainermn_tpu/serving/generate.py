@@ -80,14 +80,21 @@ class GenRequest:
     token ids or a typed error.  ``request_id`` is the process-unique
     trace id (monotonic admission stamp in the suffix); ``t_trace0``
     is the admission instant on the telemetry recorder's clock (None
-    when telemetry was off) -- the t0 of the ``queue_wait`` stage."""
+    when telemetry was off) -- the t0 of the ``queue_wait`` stage.
+
+    ``prefix_key`` (stamped by a paged-engine queue at admission) is
+    a STABLE hash of the shareable prompt prefix
+    (:func:`chainermn_tpu.serving.paged.prefix_key`): a pure function
+    of the token ids, so arrival order can never change it -- the
+    scheduler uses it to co-admit shared-prefix requests."""
 
     __slots__ = ('prompt', 'max_new_tokens', 'deadline', 'seq',
                  't_submit', 'synthetic', 'request_id', 't_trace0',
-                 '_done', '_result', '_error')
+                 'prefix_key', '_done', '_result', '_error')
 
     def __init__(self, prompt, max_new_tokens, deadline=None, seq=0,
-                 t_submit=0.0, synthetic=False, request_id=None):
+                 t_submit=0.0, synthetic=False, request_id=None,
+                 prefix_key=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError('empty prompt')
@@ -99,6 +106,7 @@ class GenRequest:
         self.seq = seq
         self.t_submit = t_submit
         self.synthetic = synthetic
+        self.prefix_key = prefix_key
         self.request_id = request_id or next_request_id()
         rec = _telemetry.active()
         self.t_trace0 = rec.now() if rec is not None else None
@@ -136,12 +144,18 @@ class GenerationQueue:
     MOST as many requests as it has free cache slots each decode step
     (token-level admission).  The bounded-backlog / typed-shed /
     ``serve_burst`` contracts are identical to
-    :class:`~chainermn_tpu.serving.RequestQueue`."""
+    :class:`~chainermn_tpu.serving.RequestQueue`.
+
+    ``page_size`` (set when feeding a paged engine) stamps each
+    admitted request's :attr:`GenRequest.prefix_key` -- the stable
+    hash of its page-aligned prompt prefix -- and unlocks
+    ``pop(..., group_prefix=True)`` co-admission."""
 
     def __init__(self, max_prompt_len, max_queue=DEFAULT_MAX_QUEUE,
-                 clock=time.monotonic, label=None):
+                 clock=time.monotonic, label=None, page_size=None):
         self.label = label  # fleet replica name (shed forensics)
         self.max_prompt_len = int(max_prompt_len)
+        self.page_size = int(page_size) if page_size else None
         self.max_queue = int(max_queue)
         self._clock = clock
         self._lock = threading.Lock()
@@ -196,24 +210,44 @@ class GenerationQueue:
                 reason='queue_full', queue_depth=len(self._waiting))
         self._seq += 1
         self.submitted += 1
+        key = None
+        if self.page_size is not None:
+            from chainermn_tpu.serving.paged import prefix_key
+            key = prefix_key(prompt, self.page_size)
         req = GenRequest(prompt, max_new_tokens, deadline=deadline,
                          seq=self._seq, t_submit=self._clock(),
-                         synthetic=synthetic, request_id=request_id)
+                         synthetic=synthetic, request_id=request_id,
+                         prefix_key=key)
         self._waiting.append(req)
         return req
 
     def _shed_attrs(self):
         return {'replica': self.label} if self.label else {}
 
-    def pop(self, k):
+    def pop(self, k, group_prefix=False):
         """Up to ``k`` live requests in arrival order; requests whose
         deadline already expired while queued are shed typed here (the
-        queue-side twin of the engine's mid-generation expiry)."""
+        queue-side twin of the engine's mid-generation expiry).
+
+        ``group_prefix=True`` (the paged engine's admission): after
+        the head request is taken in arrival order, later waiters
+        sharing its ``prefix_key`` are pulled forward so
+        shared-prefix requests land in the SAME admission wave --
+        their suffix prefills all read the prefix banked by the first
+        completer.  Relative order within a key group is preserved,
+        and requests without a key are never reordered past each
+        other."""
         now = self._clock()
         out = []
         with self._lock:
+            head_key = None
             while self._waiting and len(out) < k:
-                req = self._waiting.pop(0)
+                idx = 0
+                if group_prefix and head_key is not None:
+                    idx = next(
+                        (j for j, r in enumerate(self._waiting)
+                         if r.prefix_key == head_key), 0)
+                req = self._waiting.pop(idx)
                 if req.deadline is not None and now > req.deadline:
                     self.shed_deadline += 1
                     record_shed('deadline',
@@ -227,6 +261,8 @@ class GenerationQueue:
                         % ((now - req.t_submit) * 1e3),
                         reason='deadline'))
                     continue
+                if not out and group_prefix:
+                    head_key = req.prefix_key
                 out.append(req)
         return out
 
@@ -256,10 +292,10 @@ class _Slot:
     """Host-side state of one cache slot."""
 
     __slots__ = ('request', 'position', 'remaining', 'generated',
-                 't_last_token', 't_stage_end')
+                 't_last_token', 't_stage_end', 'pages')
 
     def __init__(self, request, position, remaining, first_token,
-                 t_now, t_stage_end=None):
+                 t_now, t_stage_end=None, pages=None):
         self.request = request
         self.position = position          # next token's position
         self.remaining = remaining        # tokens still to generate
@@ -268,6 +304,29 @@ class _Slot:
         # telemetry-clock end of this request's newest recorded trace
         # stage: each decode stage span starts here, so the stages
         # tile the request's lifetime gap-free (None: telemetry off)
+        self.t_stage_end = t_stage_end
+        # paged engine: this sequence's page table (one pool ref per
+        # entry, released on completion/cancel); None on slot engines
+        self.pages = pages
+
+
+class _PrefillState:
+    """Host-side state of one sequence whose prompt is still being
+    prefilled (paged engine only): chunked prefill runs one chunk per
+    scheduler tick, so a long prompt spends several ticks here before
+    graduating to a :class:`_Slot`."""
+
+    __slots__ = ('request', 'pages', 'pos', 'matched', 'chunks',
+                 't_pop', 't_stage_end')
+
+    def __init__(self, request, pages, pos, matched, t_pop=None,
+                 t_stage_end=None):
+        self.request = request
+        self.pages = pages       # page table so far (refs held)
+        self.pos = pos           # next absolute position to prefill
+        self.matched = matched   # prefix tokens reused from the index
+        self.chunks = 0          # chunks dispatched so far
+        self.t_pop = t_pop
         self.t_stage_end = t_stage_end
 
 
@@ -294,6 +353,27 @@ class GenerationEngine:
         batch engine).
       int8_kv: store the KV cache int8 with per-(position, head)
         scales -- half the decode-bound HBM bytes of bf16.
+      paged: replace the private per-slot cache slabs with a PAGED
+        pool (:func:`chainermn_tpu.models.init_paged_kv_cache`):
+        ``n_pages`` pages of ``page_size`` tokens shared by all
+        sequences through per-sequence page tables, with refcounted
+        prefix sharing (a radix index over completed prompts -- N
+        requests with one system prompt read ONE banked copy),
+        copy-on-write at divergence, and LRU eviction of banked
+        prefixes when the pool runs dry.  Greedy outputs are
+        IDENTICAL to the slot engine (tests/test_serving.py).
+      page_size / n_pages: paged-mode geometry.  ``n_pages`` defaults
+        to ``1 + n_slots * ceil(max_len / page_size)`` -- the slot
+        engine's capacity plus the scratch page; LOWER it to
+        oversubscribe (prefix sharing is what makes that safe).
+      prefill_chunk: paged mode only -- split prompts into chunks of
+        this many tokens, ONE chunk per scheduler tick interleaved
+        with decode steps (SARATHI-style), so a long-prompt burst
+        cannot freeze inter-token latency (the ``serve_longprompt``
+        chaos site is the acceptance driver).  ``None`` prefills each
+        prompt in one tick.
+      prefix_sharing: disable the radix index (pages still pool, no
+        cross-request reuse) -- an ablation knob for the bench.
       plan / param_specs: MeshPlan tensor-parallel serving (the cache
         shards its head dim over ``plan.model_axis``).
       cache_dir / aot: the engine's persistent-compilation-cache and
@@ -311,11 +391,17 @@ class GenerationEngine:
 
     def __init__(self, model, params, n_slots=8, max_prompt_len=64,
                  max_len=None, eos_id=None, policy=None,
-                 int8_kv=False, plan=None, param_specs=None,
-                 cache_dir=None, aot=True, label=None, version=0):
+                 int8_kv=False, paged=False, page_size=16,
+                 n_pages=None, prefill_chunk=None, prefix_sharing=True,
+                 plan=None, param_specs=None, cache_dir=None, aot=True,
+                 label=None, version=0):
         import os
 
-        from chainermn_tpu.models import init_kv_cache, kv_cache_specs
+        from chainermn_tpu.models import (init_kv_cache,
+                                          init_paged_kv_cache,
+                                          kv_cache_specs)
+        from chainermn_tpu.serving.paged import (PagePool,
+                                                 RadixPrefixIndex)
 
         self.model = model
         self.label = label
@@ -365,24 +451,64 @@ class GenerationEngine:
         self.params = self._place_params(params)
 
         self.int8_kv = bool(int8_kv)
+        self.paged = bool(paged)
+        self.page_size = int(page_size)
+        self.prefill_chunk = (int(prefill_chunk) if prefill_chunk
+                              else None)
+        if self.prefill_chunk is not None and not self.paged:
+            raise ValueError('prefill_chunk requires paged=True (the '
+                             'slot cache prefills whole prompts)')
+        if self.prefill_chunk is not None \
+                and self.prefill_chunk > self.max_prompt_len:
+            raise ValueError('prefill_chunk %d exceeds max_prompt_len '
+                             '%d' % (self.prefill_chunk,
+                                     self.max_prompt_len))
         tp = plan.model_size if plan is not None else 1
         del tp  # the GLOBAL cache is built unsharded; specs shard it
-        cache = init_kv_cache(model, self.n_slots, self.max_len,
-                              int8_kv=self.int8_kv, tp=1)
+        if self.paged:
+            self.pages_per_seq = -(-self.max_len // self.page_size)
+            self.n_pages = int(
+                n_pages or 1 + self.n_slots * self.pages_per_seq)
+            self.pool = PagePool(self.n_pages, self.page_size)
+            self._prefix_index = (RadixPrefixIndex(self.pool)
+                                  if prefix_sharing else None)
+            cache = init_paged_kv_cache(model, self.n_pages,
+                                        self.page_size,
+                                        int8_kv=self.int8_kv, tp=1)
+        else:
+            if n_pages is not None:
+                raise ValueError('n_pages requires paged=True')
+            self.pages_per_seq = None
+            self.n_pages = None
+            self.pool = None
+            self._prefix_index = None
+            cache = init_kv_cache(model, self.n_slots, self.max_len,
+                                  int8_kv=self.int8_kv, tp=1)
         self._cache_specs = (kv_cache_specs(cache, plan.model_axis)
                              if plan is not None else None)
         self._cache = jax.device_put(cache, self._cache_sharding())
 
-        self._slots = {}      # slot id -> _Slot (active only)
+        # prefill executable widths: chunked paged mode compiles ONE
+        # fixed-width chunk executable; otherwise one per prompt bucket
+        self._prefill_widths = (
+            (self.prefill_chunk,) if self.prefill_chunk is not None
+            else tuple(self.prefill_edges))
+
+        self._slots = {}      # slot id -> _Slot (decode phase)
+        self._prefilling = {} # slot id -> _PrefillState (paged only)
         self._free = list(range(self.n_slots))
-        self._prefill = {}    # prompt bucket -> callable
+        self._prefill = {}    # prompt/chunk bucket -> callable
         self._decode = {}     # slot bucket -> callable
+        self._copy = None     # paged CoW page-copy executable
         self._signatures = set()
         self._lock = threading.Lock()
         self.prefill_trace_count = 0
         self.decode_trace_count = 0
+        self.copy_trace_count = 0
         self.compile_count = 0
         self.prefills = 0
+        self.prefill_chunks = 0
+        self.cow_copies = 0
         self.decode_steps = 0
         self.tokens_generated = 0
         self.cancelled = 0
@@ -432,20 +558,23 @@ class GenerationEngine:
         checks the sampled tokens materialize; only then is
         ``self.params`` cut over and the old buffer freed."""
         from chainermn_tpu.utils.failure import WeightSwapError
-        if self._slots:
+        if self._slots or self._prefilling:
             raise WeightSwapError(
                 'swap requires a drained replica: %d sequence(s) '
                 'still in flight hold KV state banked under the '
-                'incumbent weights' % len(self._slots),
+                'incumbent weights'
+                % (len(self._slots) + len(self._prefilling)),
                 version=version)
         new = self._place_params(params)
         if validate and self.n_slots in self._decode:
             exe = self._decode[self.n_slots][0]
+            val_args = [jnp.zeros((self.n_slots,), jnp.int32),
+                        jnp.zeros((self.n_slots,), jnp.int32)]
+            if self.paged:
+                val_args.append(jnp.zeros(
+                    (self.n_slots, self.pages_per_seq), jnp.int32))
             try:
-                tok, cache = exe(
-                    new, self._cache,
-                    jnp.zeros((self.n_slots,), jnp.int32),
-                    jnp.zeros((self.n_slots,), jnp.int32))
+                tok, cache = exe(new, self._cache, *val_args)
                 tok = jax.block_until_ready(tok)
             except Exception as e:
                 raise WeightSwapError(
@@ -499,6 +628,34 @@ class GenerationEngine:
             positions, slots=slots)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
+    def _prefill_body_paged(self, params, cache, tokens, length, pos0,
+                            table):
+        from chainermn_tpu.models import prefill_paged
+        self.prefill_trace_count += 1  # trace-time counter
+        logits, cache = prefill_paged(
+            self.model, self._prepare_params(params), cache, tokens,
+            length, table, pos0)
+        return jnp.argmax(logits).astype(jnp.int32), cache
+
+    def _decode_body_paged(self, params, cache, tokens, positions,
+                           tables):
+        from chainermn_tpu.models import decode_step_paged
+        self.decode_trace_count += 1   # trace-time counter
+        logits, cache = decode_step_paged(
+            self.model, self._prepare_params(params), cache, tokens,
+            positions, tables)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    def _copy_body(self, params, cache, src, dst):
+        """Copy-on-write page duplication: every leaf's page ``src``
+        row copied to page ``dst`` in one donated pass.  ``params``
+        rides along unused to keep the shared ``_compile`` calling
+        convention (one signature family, cache donated at arg 1)."""
+        del params
+        self.copy_trace_count += 1     # trace-time counter
+        return {key: leaf.at[:, dst].set(leaf[:, src])
+                for key, leaf in cache.items()}
+
     def _mapped(self, body, n_extra):
         """Wrap a traced body in the plan's shard_map (params sharded
         per spec, cache per its spec, small int operands replicated)."""
@@ -528,12 +685,25 @@ class GenerationEngine:
 
     def _token_structs(self, bucket):
         i32 = jnp.int32
+        if self.paged:
+            # (tokens, length, pos0, page_table)
+            return (jax.ShapeDtypeStruct((1, bucket), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((), i32),
+                    jax.ShapeDtypeStruct((self.pages_per_seq,), i32))
         return (jax.ShapeDtypeStruct((1, bucket), i32),
                 jax.ShapeDtypeStruct((), i32),
                 jax.ShapeDtypeStruct((), i32))
 
     def _decode_structs(self, bucket):
         i32 = jnp.int32
+        if self.paged:
+            # (tokens, positions, page_tables) -- every bucket reads
+            # through tables, so there is no full-vs-compacted split
+            return (jax.ShapeDtypeStruct((bucket,), i32),
+                    jax.ShapeDtypeStruct((bucket,), i32),
+                    jax.ShapeDtypeStruct((bucket, self.pages_per_seq),
+                                         i32))
         if bucket == self.n_slots:
             return (jax.ShapeDtypeStruct((bucket,), i32),
                     jax.ShapeDtypeStruct((bucket,), i32))
@@ -554,22 +724,74 @@ class GenerationEngine:
             hit = self._prefill.get(bucket)
             if hit is not None:
                 return hit[0]
-            if bucket not in self.prefill_edges:
+            if bucket not in self._prefill_widths:
                 raise RuntimeError(
                     'prompt bucket %d is not an edge %r'
-                    % (bucket, list(self.prefill_edges)))
+                    % (bucket, list(self._prefill_widths)))
+            body = (self._mapped(self._prefill_body_paged, 4)
+                    if self.paged
+                    else self._mapped(self._prefill_body, 3))
             exe, _ = self._compile(
-                self._mapped(self._prefill_body, 3),
+                body,
                 (self._cache_struct(),) + self._token_structs(bucket),
                 self._prefill, bucket)
             return exe
 
+    def _get_copy(self):
+        """The CoW page-copy executable (paged only): compiled once,
+        shape-keyed like every bucket executable, so admission-time
+        copies never retrace."""
+        if self._copy is not None:
+            return self._copy[0]
+        with self._lock:
+            if self._copy is not None:
+                return self._copy[0]
+            body = self._copy_mapped()
+            table = {}
+            exe, aot = self._compile(
+                body,
+                (self._cache_struct(),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32)),
+                table, 'copy')
+            self._copy = table['copy']
+            return exe
+
+    def _copy_mapped(self):
+        if self.plan is None:
+            return self._copy_body
+        from jax.sharding import PartitionSpec as P
+        pspecs = (self.param_specs if self.param_specs is not None
+                  else P())
+        return jax.shard_map(
+            self._copy_body, mesh=self.plan.mesh,
+            in_specs=(pspecs, self._cache_specs, P(), P()),
+            out_specs=self._cache_specs, check_vma=False)
+
+    def _copy_page(self, src, dst):
+        """Duplicate pool page ``src`` into the private page ``dst``
+        (already allocated by the caller)."""
+        exe = self._get_copy()
+        self._cache = exe(self.params, self._cache,
+                          jnp.asarray(src, jnp.int32),
+                          jnp.asarray(dst, jnp.int32))
+        self.cow_copies += 1
+        reg = _telemetry.registry()
+        if reg is not None:
+            reg.counter('serve_kv_cow_total',
+                        help='copy-on-write page duplications at '
+                             'prefix divergence').inc()
+
     def _decode_mapped(self, bucket):
         """The decode callable for one slot-count bucket -- what gets
         AOT-compiled, and what ``traceable_decode`` hands shardlint."""
+        if self.paged:
+            # paged operand order: (tokens, positions, page_tables);
+            # the cache is read THROUGH the tables for every bucket
+            return self._mapped(self._decode_body_paged, 3)
         if bucket == self.n_slots:
             # full bucket: every slot decodes, the cache is read IN
-            # PLACE (no gather); rows are slots in order
+            # PLACE (no slots operand); rows are slots in order
             return self._mapped(
                 lambda p, c, t, pos: self._decode_body(p, c, t, pos),
                 2)
@@ -607,6 +829,11 @@ class GenerationEngine:
         fn = self._decode_mapped(bucket)
         args = [self.params, self._cache,
                 jnp.zeros((bucket,), jnp.int32)]
+        if self.paged:
+            args.append(jnp.zeros((bucket,), jnp.int32))
+            args.append(jnp.zeros((bucket, self.pages_per_seq),
+                                  jnp.int32))
+            return fn, tuple(args)
         if bucket != self.n_slots:
             args.append(jnp.arange(bucket, dtype=jnp.int32))
         args.append(jnp.zeros((bucket,), jnp.int32))
@@ -619,16 +846,20 @@ class GenerationEngine:
         cache -- slots are all free, so the garbage they write is
         never attended (reads mask by live length).  Returns
         ``{'prefill': {bucket: aot}, 'decode': {bucket: aot}}``."""
-        for bucket in sorted(self.prefill_edges, reverse=True):
+        for bucket in sorted(self._prefill_widths, reverse=True):
             with _telemetry.span('serve_warmup', kind='serve',
                                  phase='prefill', bucket=bucket):
                 exe = self._get_prefill(bucket)
                 if not self._prefill[bucket][1]:
-                    tok, cache = exe(
-                        self.params, self._cache,
-                        jnp.zeros((1, bucket), jnp.int32),
-                        jnp.asarray(1, jnp.int32),
-                        jnp.asarray(0, jnp.int32))
+                    args = [jnp.zeros((1, bucket), jnp.int32),
+                            jnp.asarray(1, jnp.int32),
+                            jnp.asarray(0, jnp.int32)]
+                    if self.paged:
+                        # zero table: warmup garbage lands on the
+                        # scratch page, never in a live table
+                        args.append(jnp.zeros((self.pages_per_seq,),
+                                              jnp.int32))
+                    tok, cache = exe(self.params, self._cache, *args)
                     jax.block_until_ready(tok)
                     self._cache = cache
         for bucket in sorted(self.decode_edges, reverse=True):
@@ -636,15 +867,30 @@ class GenerationEngine:
                                  phase='decode', bucket=bucket):
                 exe = self._get_decode(bucket)
                 if not self._decode[bucket][1]:
-                    args = [jnp.zeros((bucket,), jnp.int32),
-                            jnp.zeros((bucket,), jnp.int32)]
-                    if bucket != self.n_slots:
-                        args.insert(1, jnp.arange(bucket,
-                                                  dtype=jnp.int32))
+                    if self.paged:
+                        args = [jnp.zeros((bucket,), jnp.int32),
+                                jnp.zeros((bucket,), jnp.int32),
+                                jnp.zeros((bucket,
+                                           self.pages_per_seq),
+                                          jnp.int32)]
+                    else:
+                        args = [jnp.zeros((bucket,), jnp.int32),
+                                jnp.zeros((bucket,), jnp.int32)]
+                        if bucket != self.n_slots:
+                            args.insert(1, jnp.arange(
+                                bucket, dtype=jnp.int32))
                     tok, cache = exe(self.params, self._cache,
                                      args[0], *args[1:])
                     jax.block_until_ready(tok)
                     self._cache = cache
+        if self.paged:
+            with _telemetry.span('serve_warmup', kind='serve',
+                                 phase='copy_page'):
+                exe = self._get_copy()
+                if not self._copy[1]:
+                    zero = jnp.asarray(0, jnp.int32)
+                    self._cache = exe(self.params, self._cache,
+                                      zero, zero)
         return {'prefill': {b: a for b, (_, a)
                             in sorted(self._prefill.items())},
                 'decode': {b: a for b, (_, a)
@@ -683,6 +929,7 @@ class GenerationEngine:
                 doomed.append(sid)
         for sid in doomed:
             slot = self._slots.pop(sid)
+            self._release_pages(slot.pages)
             self._free.append(sid)
             self.cancelled += 1
             slot.request.set_error(OverloadError(
@@ -695,7 +942,62 @@ class GenerationEngine:
                         queue_depth=self._last_queue_depth,
                         slot=sid, tokens=len(slot.generated),
                         **self._ident())
+        # mid-prefill expiry (paged): a chunked prompt can outlive its
+        # deadline between chunks
+        for sid in [s for s, st in self._prefilling.items()
+                    if st.request.deadline is not None
+                    and now > st.request.deadline]:
+            state = self._prefilling.pop(sid)
+            self._release_pages(state.pages)
+            self._free.append(sid)
+            self.cancelled += 1
+            doomed.append(sid)
+            state.request.set_error(OverloadError(
+                'deadline expired mid-prefill at position %d'
+                % state.pos, reason='deadline'))
+            _telemetry.event('serve_cancel', kind='serve', slot=sid,
+                             tokens=0)
+            record_shed('deadline',
+                        request_id=state.request.request_id,
+                        queue_depth=self._last_queue_depth,
+                        slot=sid, position=state.pos, **self._ident())
         return len(doomed)
+
+    # -- paged-mode page accounting ------------------------------------
+    def _release_pages(self, pages):
+        if pages:
+            for page in pages:
+                self.pool.release(page)
+
+    def _alloc_page(self):
+        """One free page, LRU-evicting banked prefixes when the pool
+        is dry; ``None`` only when nothing is evictable either (the
+        caller sheds typed)."""
+        page = self.pool.alloc()
+        while page is None and self._prefix_index is not None \
+                and self._prefix_index.evict(1):
+            page = self.pool.alloc()
+        return page
+
+    def _table_array(self, pages):
+        table = np.zeros((self.pages_per_seq,), np.int32)
+        table[:len(pages)] = pages
+        return table
+
+    def _shed_paged(self, req, pages, where):
+        """Typed shed when the page pool is exhausted (the paged twin
+        of queue_full): pages retained so far go back, the client
+        gets ``OverloadError(reason='kv_pages')``."""
+        self._release_pages(pages)
+        self.cancelled += 1
+        record_shed('kv_pages', request_id=req.request_id,
+                    queue_depth=self._last_queue_depth, where=where,
+                    **self._ident())
+        req.set_error(OverloadError(
+            'KV page pool exhausted (%d/%d pages live, nothing '
+            'evictable) during %s; retry with backoff'
+            % (self.pool.in_use(), self.pool.n_pages, where),
+            reason='kv_pages'))
 
     def _admit(self, queue, now, clock):
         """Refill free slots from the queue: one PREFILL per request
@@ -705,6 +1007,9 @@ class GenerationEngine:
         pop), ``bucket_pack`` (pop -> prefill dispatch, carrying the
         prompt bucket + pad fraction) and ``prefill`` (-> first
         token), each starting where the previous ended."""
+        if self.paged:
+            self._admit_paged(queue, now, clock)
+            return
         rec = _telemetry.active()
         reg = _telemetry.registry()
         ident = self._ident()
@@ -775,14 +1080,223 @@ class GenerationEngine:
                                      t_first,
                                      t_stage_end=t_first_tele)
 
+    def _admit_paged(self, queue, now, clock):
+        """Paged admission: claim a slot id, walk the prefix index for
+        the longest banked prefix (retaining shared FULL pages; a
+        partially-covered boundary page is copy-on-write-duplicated
+        ONCE, here), and park the request in ``self._prefilling`` --
+        the actual prefill work happens chunk-by-chunk in
+        :meth:`_prefill_tick`, interleaved with decode steps."""
+        rec = _telemetry.active()
+        reg = _telemetry.registry()
+        ident = self._ident()
+        group = self._prefix_index is not None
+        for req in queue.pop(len(self._free), group_prefix=group):
+            sid = self._free.pop(0)
+            prompt = req.prompt
+            t_pop = rec.now() if rec is not None else None
+            if rec is not None:
+                t0 = req.t_trace0
+                if t0 is None:   # telemetry enabled mid-flight
+                    t0 = t_pop - (clock() - req.t_submit)
+                rec.child_span(req.request_id, 'queue_wait', t0,
+                               t_pop, seq=req.seq, **ident)
+            pages, matched = [], 0
+            if self._prefix_index is not None:
+                shared, tail_page, tail_len = \
+                    self._prefix_index.lookup(prompt)
+                # always recompute >= 1 prompt token: the final chunk
+                # must produce first-token logits, so cap the match at
+                # size-1 and demote an over-covering full page to a
+                # copy-on-write tail candidate
+                max_match = prompt.size - 1
+                dropped = None
+                while len(shared) * self.page_size > max_match:
+                    dropped = shared.pop()
+                for page in shared:
+                    self.pool.retain(page)
+                    pages.append(page)
+                matched = len(shared) * self.page_size
+                if dropped is not None:
+                    tail_page, tail_len = dropped, self.page_size
+                tail_use = (min(tail_len, max_match - matched)
+                            if tail_page is not None else 0)
+                if tail_use > 0:
+                    dst = self._alloc_page()
+                    if dst is None:
+                        self._shed_paged(req, pages, 'admission')
+                        self._free.append(sid)
+                        continue
+                    self._copy_page(tail_page, dst)
+                    pages.append(dst)
+                    matched += tail_use
+                if reg is not None and matched:
+                    reg.counter(
+                        'serve_prefix_hits_total',
+                        help='admissions that reused a banked '
+                             'prompt prefix').inc()
+                    reg.counter(
+                        'serve_prefix_tokens_total',
+                        help='prompt tokens served from banked '
+                             'prefix pages').inc(matched)
+            self._prefilling[sid] = _PrefillState(
+                req, pages, matched, matched, t_pop=t_pop,
+                t_stage_end=t_pop)
+
+    def _prefill_tick(self, clock):
+        """Advance every mid-prefill sequence by ONE chunk (SARATHI
+        schedule: chunks interleave with decode ticks so a long
+        prompt's compute cannot monopolize the device and blow up
+        inter-token latency for live sequences).  Without
+        ``prefill_chunk`` configured the whole remaining prompt runs
+        as a single chunk (bucketed like slot-mode prefill).
+
+        The final chunk -- the only one producing first-token logits
+        -- emits the ``prefill`` trace stage (so TTFT accounting is
+        unchanged); intermediate chunks emit ``prefill_chunk`` spans
+        the SLO monitor ignores.  A finished prompt's pages are banked
+        into the prefix index before the sequence moves to decode."""
+        rec = _telemetry.active()
+        reg = _telemetry.registry()
+        ident = self._ident()
+        worked = False
+        for sid in sorted(self._prefilling):
+            st = self._prefilling[sid]
+            req = st.request
+            prompt = req.prompt
+            remaining = prompt.size - st.pos
+            if self.prefill_chunk is not None:
+                width = self.prefill_chunk
+            else:
+                width = bucket_of(remaining, self.prefill_edges)
+            n = min(width, remaining)
+            last_page = (st.pos + n - 1) // self.page_size
+            dry = False
+            while len(st.pages) <= last_page:
+                page = self._alloc_page()
+                if page is None:
+                    dry = True
+                    break
+                st.pages.append(page)
+            if dry:
+                del self._prefilling[sid]
+                self._shed_paged(req, st.pages, 'prefill')
+                self._free.append(sid)
+                continue
+            worked = True
+            tokens = np.zeros((1, width), np.int32)
+            tokens[0, :n] = prompt[st.pos:st.pos + n]
+            exe = self._get_prefill(width)
+            args = (jnp.asarray(tokens),
+                    jnp.asarray(n, jnp.int32),
+                    jnp.asarray(st.pos, jnp.int32),
+                    jnp.asarray(self._table_array(st.pages)))
+            self.guard_signature((self._cache_struct(),) + tuple(
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in args))
+            if rec is not None and st.chunks == 0:
+                t_c0 = rec.now()
+                rec.child_span(
+                    req.request_id, 'bucket_pack', st.t_stage_end,
+                    t_c0, bucket=width,
+                    pad_fraction=round((width - n) / float(width), 4),
+                    prefix_tokens=st.matched, **ident)
+                st.t_stage_end = t_c0
+            if _chaos._active is not None:
+                _chaos.on_serve_slow(
+                    self.param_version != self._boot_version)
+            with _telemetry.span('serve_prefill', kind='serve',
+                                 bucket=width, slot=sid,
+                                 chunk=st.chunks, pos=st.pos,
+                                 iteration=self._step_index, **ident):
+                tok, cache = exe(self.params, self._cache, *args)
+                tok = jax.block_until_ready(tok)
+            self._cache = cache
+            st.pos += n
+            st.chunks += 1
+            self.prefill_chunks += 1
+            if st.pos < prompt.size:
+                if rec is not None:
+                    now_tele = rec.now()
+                    rec.child_span(req.request_id, 'prefill_chunk',
+                                   st.t_stage_end, now_tele,
+                                   bucket=width, slot=sid,
+                                   chunk=st.chunks - 1, pos=st.pos,
+                                   **ident)
+                    st.t_stage_end = now_tele
+                continue
+            tok = int(tok)
+            del self._prefilling[sid]
+            self.prefills += 1
+            self.tokens_generated += 1
+            t_first = clock()
+            t_first_tele = None
+            if rec is not None:
+                t_first_tele = rec.now()
+                rec.child_span(req.request_id, 'prefill',
+                               st.t_stage_end, t_first_tele,
+                               bucket=width, slot=sid,
+                               prompt_tokens=int(prompt.size),
+                               chunks=st.chunks,
+                               prefix_tokens=st.matched, **ident)
+            if reg is not None:
+                reg.histogram(
+                    'serve_ttft_seconds',
+                    help='submit-to-first-token latency (s)'
+                ).observe(t_first - req.t_submit)
+                reg.counter('serve_tokens_total',
+                            help='generated tokens').inc()
+            if self._prefix_index is not None:
+                n_cover = -(-prompt.size // self.page_size)
+                self._prefix_index.insert(prompt,
+                                          st.pages[:n_cover])
+            if self.eos_id is not None and tok == self.eos_id \
+                    or req.max_new_tokens == 1:
+                req.set_result([tok])
+                self._release_pages(st.pages)
+                self._free.append(sid)
+                if rec is not None:
+                    rec.event('complete', kind='request',
+                              request_id=req.request_id, tokens=1,
+                              slot=sid, **ident)
+                continue
+            self._slots[sid] = _Slot(req, prompt.size,
+                                     req.max_new_tokens - 1, tok,
+                                     t_first,
+                                     t_stage_end=t_first_tele,
+                                     pages=st.pages)
+        return worked
+
     def _decode_once(self, clock):
         """One decode step over every active slot, compacted to the
         smallest slot-count bucket; finished sequences resolve and
         free their slots (refilled at the NEXT step)."""
+        if self.paged:
+            # grow page tables across page boundaries BEFORE dispatch
+            # (a sequence whose next token starts a new page gets one
+            # allocated now; a dry pool sheds typed)
+            for sid in sorted(self._slots):
+                slot = self._slots[sid]
+                need = slot.position // self.page_size
+                while len(slot.pages) <= need:
+                    page = self._alloc_page()
+                    if page is None:
+                        del self._slots[sid]
+                        self._shed_paged(slot.request, slot.pages,
+                                         'decode')
+                        self._free.append(sid)
+                        break
+                    slot.pages.append(page)
+            if not self._slots:
+                return
         active = sorted(self._slots)
         k = len(active)
         bucket = bucket_of(k, self.decode_edges)
-        if bucket == self.n_slots:
+        if self.paged:
+            # paged rows are positional (the page table IS the
+            # addressing); pad rows carry all-zero tables, so their
+            # garbage token lands on the scratch page
+            rows = active + [None] * (bucket - k)
+        elif bucket == self.n_slots:
             # the full-slot executable reads the cache IN PLACE (no
             # slots operand): row i IS slot i, so rows must be every
             # slot in id order even when k < n_slots -- an inactive
@@ -801,7 +1315,15 @@ class GenerationEngine:
             [self._slots[s].position if s in self._slots else 0
              for s in rows], np.int32)
         exe = self._get_decode(bucket)
-        if bucket == self.n_slots:
+        if self.paged:
+            tables = np.zeros((bucket, self.pages_per_seq), np.int32)
+            for i, sid in enumerate(rows):
+                if sid is not None:
+                    pages = self._slots[sid].pages
+                    tables[i, :len(pages)] = pages
+            args = (jnp.asarray(tokens), jnp.asarray(positions),
+                    jnp.asarray(tables))
+        elif bucket == self.n_slots:
             args = (jnp.asarray(tokens), jnp.asarray(positions))
         else:
             args = (jnp.asarray(tokens),
@@ -876,6 +1398,7 @@ class GenerationEngine:
                               request_id=slot.request.request_id,
                               tokens=len(slot.generated), slot=sid,
                               **ident)
+                self._release_pages(slot.pages)
                 del self._slots[sid]
                 self._free.append(sid)
         self.decode_steps += 1
@@ -887,6 +1410,17 @@ class GenerationEngine:
         in which slot, at which stage, with how many tokens emitted --
         so a crash mid-generation names which requests died where."""
         active = []
+        for sid in sorted(self._prefilling):
+            try:
+                st = self._prefilling[sid]
+            except KeyError:
+                continue   # racing refill on the dying process
+            active.append({'slot': sid,
+                           'request_id': st.request.request_id,
+                           'stage': 'prefill',
+                           'tokens': 0,
+                           'position': st.pos,
+                           'remaining': st.request.max_new_tokens})
         for sid in sorted(self._slots):
             try:
                 slot = self._slots[sid]
@@ -928,18 +1462,32 @@ class GenerationEngine:
                            'queue at the scheduler tick').set(depth)
             reg.gauge('serve_prefill_backlog',
                       help='queued requests still needing their '
-                           'prefill pass').set(depth)
+                           'prefill pass (queued + mid-prefill)'
+                      ).set(depth + len(self._prefilling))
             reg.gauge('serve_decode_backlog',
                       help='live slots still generating at the '
                            'scheduler tick').set(len(self._slots))
+            if self.paged:
+                reg.gauge('serve_kv_pages_in_use',
+                          help='allocated KV pages (live sequences '
+                               '+ banked prefixes) at the tick'
+                          ).set(self.pool.in_use())
+                reg.gauge('serve_kv_pages_free',
+                          help='free KV pages at the tick'
+                          ).set(self.pool.available())
         now = clock()
         force = (_chaos.on_serve_cancel()
                  if _chaos._active is not None else 0)
         self._expire(now, force=force)
         self._admit(queue, now, clock)
-        if not self._slots:
+        worked = False
+        if self.paged and self._prefilling:
+            worked = self._prefill_tick(clock)
+        if self._slots:
+            self._decode_once(clock)
+            worked = True
+        if not worked:
             return False
-        self._decode_once(clock)
         self._step_index += 1
         return True
 
@@ -950,12 +1498,36 @@ class GenerationEngine:
             worked = self.step(queue)
             if not worked:
                 if stop is not None and stop.is_set() \
-                        and queue.depth() == 0 and not self._slots:
+                        and queue.depth() == 0 and not self._slots \
+                        and not self._prefilling:
                     return
                 time.sleep(idle_sleep)
 
     def stats(self):
-        return {
+        paged = {}
+        if self.paged:
+            paged = {
+                'paged': True,
+                'page_size': self.page_size,
+                'n_pages': self.n_pages,
+                'pages_per_seq': self.pages_per_seq,
+                'pages_in_use': self.pool.in_use(),
+                'pages_free': self.pool.available(),
+                'peak_pages_in_use': self.pool.peak_in_use,
+                'prefill_chunk': self.prefill_chunk,
+                'prefill_chunks': self.prefill_chunks,
+                'cow_copies': self.cow_copies,
+                'copy_trace_count': self.copy_trace_count,
+                'prefilling': len(self._prefilling),
+            }
+            if self._prefix_index is not None:
+                paged.update(
+                    prefix_lookups=self._prefix_index.lookups,
+                    prefix_hits=self._prefix_index.hits,
+                    prefix_hit_rate=self._prefix_index.hit_rate(),
+                    prefix_tokens_reused=(
+                        self._prefix_index.tokens_reused))
+        base = {
             'prefill_buckets': sorted(self._prefill),
             'decode_buckets': sorted(self._decode),
             'label': self.label,
@@ -980,6 +1552,8 @@ class GenerationEngine:
             'cancelled': self.cancelled,
             'active_slots': len(self._slots),
         }
+        base.update(paged)
+        return base
 
     # -- constructors --------------------------------------------------
     @classmethod

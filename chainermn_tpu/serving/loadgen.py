@@ -26,6 +26,7 @@ import time
 import numpy as np
 
 from chainermn_tpu import telemetry as _telemetry
+from chainermn_tpu.utils import chaos as _chaos
 from chainermn_tpu.utils.failure import OverloadError
 
 
@@ -111,11 +112,32 @@ def open_loop_generate(engine, queue, rate, n_requests, seed=0,
     try:
         admitted, shed_submit = [], 0
         t0 = clock()
+        longprompt_injected = 0
         for i, prompt in enumerate(prompts):
             target = t0 + i / float(rate)
             delay = target - clock()
             if delay > 0:
                 time.sleep(delay)
+            # serve_longprompt chaos: a burst of worst-case prefill
+            # work (max-length prompts) landing at this arrival point
+            # -- injected THROUGH the normal bounded submission path,
+            # so the engine's prefill scheduling (monolithic vs
+            # chunked) is what decides whether live sequences' inter-
+            # token SLO survives the burst
+            n_long = (_chaos.on_serve_longprompt()
+                      if _chaos._active is not None else 0)
+            for j in range(n_long):
+                long_prompt = rng.randint(
+                    0, vocab,
+                    size=engine.max_prompt_len).astype(np.int32)
+                try:
+                    admitted.append(queue.submit(
+                        long_prompt, max_new_tokens,
+                        deadline=(None if deadline_s is None
+                                  else clock() + deadline_s)))
+                    longprompt_injected += 1
+                except OverloadError:
+                    shed_submit += 1
             try:
                 admitted.append(queue.submit(
                     prompt, max_new_tokens,
@@ -156,10 +178,11 @@ def open_loop_generate(engine, queue, rate, n_requests, seed=0,
     dstep = _hist_summary(reg, 'serve_decode_seconds')
     st = engine.stats()
     wall = max(t1 - t0, 1e-9)
-    offered = int(n_requests)
+    offered = int(n_requests) + longprompt_injected
     shed = shed_submit + shed_deadline
     return {
         'offered': offered,
+        'longprompt_injected': longprompt_injected,
         'offered_rate': float(rate),
         'admitted': len(admitted),
         'served': served,
@@ -193,6 +216,12 @@ def open_loop_generate(engine, queue, rate, n_requests, seed=0,
         'int8_kv': st['int8_kv'],
         'quantized': st['quantized'],
         'n_slots': st['n_slots'],
+        'paged': ({k: st.get(k) for k in (
+            'page_size', 'n_pages', 'pages_in_use', 'pages_free',
+            'peak_pages_in_use', 'prefill_chunk', 'prefill_chunks',
+            'cow_copies', 'copy_trace_count', 'prefix_lookups',
+            'prefix_hits', 'prefix_hit_rate',
+            'prefix_tokens_reused')} if st.get('paged') else None),
         'worst_request': worst,
         'slo': (slo_monitor.evaluate() if slo_monitor is not None
                 else None),

@@ -111,6 +111,16 @@ Sites (the action is part of the site name):
                     restores full speed.  The canary gate's
                     breach-then-rollback scenario is driven by
                     exactly this site
+``serve_longprompt``  inject ARG (default 3) EXTRA max-length prompts
+                    into the open-loop generation arrival stream at
+                    one arrival point -- a burst of worst-case
+                    prefill work landing mid-window: a monolithic
+                    prefill engine stalls every live sequence's next
+                    token behind the long prompts' compute (windowed
+                    inter-token SLO burn), while chunked prefill
+                    (``prefill_chunk``) interleaves the same work
+                    with decode ticks and holds the SLO
+                    (``chainermn_tpu/serving/loadgen.py``)
 ``data_stall``      sleep ARG (default 0.05) seconds before a shard
                     record read (``chainermn_tpu/data/recordio.py``)
                     -- a slow/contended filesystem; the loader's
@@ -156,7 +166,8 @@ SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
          'nan_batch', 'sigterm_step', 'kill_step', 'hang_step',
          'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip',
          'serve_burst', 'serve_cancel', 'swap_kill', 'serve_slow',
-         'data_stall', 'data_corrupt', 'extra_collective')
+         'data_stall', 'data_corrupt', 'extra_collective',
+         'serve_longprompt')
 
 
 class InjectedFault(RuntimeError):
@@ -538,6 +549,23 @@ def on_serve_slow(swapped):
     r = inj.fires('serve_slow')
     if r is not None:
         time.sleep(r.arg if r.arg is not None else 0.05)
+
+
+def on_serve_longprompt():
+    """``serve_longprompt``: the number of EXTRA max-length synthetic
+    prompts the open-loop generator should inject at this arrival
+    point (0 = none).  The burst arrives through the queue's normal
+    bounded admission, so what it really tests is the ENGINE's
+    prefill scheduling: monolithic prefill serializes the long
+    prompts' compute ahead of every live sequence's next token
+    (inter-token SLO burn), chunked prefill interleaves it."""
+    inj = _active
+    if inj is None:
+        return 0
+    r = inj.fires('serve_longprompt')
+    if r is None:
+        return 0
+    return max(1, int(r.arg) if r.arg is not None else 3)
 
 
 def on_serve_cancel():

@@ -141,9 +141,13 @@ rm -rf "${TELEMETRY_DIR}"
 # summing to the end-to-end latency (+-1 ms) -- the ISSUE 12
 # acceptance observable, end to end over real executables.
 echo "=== serving slo smoke: generate capture -> slo verdict + request timeline ==="
+# the smoke window runs the PAGED engine with chunked prefill
+# (ISSUE 17): the capture must still tile every request's stage
+# spans (queue_wait -> bucket_pack -> prefill_chunk* -> prefill ->
+# decode) and the paged sidecars must land on the bench row.
 SLO_DIR=$(mktemp -d /tmp/slo_smoke.XXXXXX)
-python bench.py --serve --generate --quick --cpu \
-  --serve-requests 24 --capture "${SLO_DIR}" \
+python bench.py --serve --generate --quick --cpu --paged \
+  --prefill-chunk 8 --serve-requests 24 --capture "${SLO_DIR}" \
   > "${SLO_DIR}/bench_row.json"
 python -m chainermn_tpu.telemetry slo "${SLO_DIR}"
 python -m chainermn_tpu.telemetry report "${SLO_DIR}" > /dev/null
@@ -167,6 +171,10 @@ assert abs(worst['stage_sum_ms'] - worst['e2e_ms']) <= 1.0, worst
 row = json.load(open(d + '/bench_row.json'))
 assert row.get('slo_verdict') in ('ok', 'warn', 'breach'), \
     row.get('slo_verdict')
+assert row.get('paged') is True and row.get('paged_kv'), 'paged row'
+assert row['paged_kv']['prefill_chunks'] > 0, row['paged_kv']
+assert row.get('kv_bytes_per_token'), 'kv_bytes_per_token sidecar'
+assert row.get('pages_per_request') is not None, 'pages sidecar'
 print('slo smoke OK: verdict=%s (row %s), %d requests traced, worst '
       '%s e2e %.3f ms (stage sum %.3f ms)'
       % (v, row['slo_verdict'], reqs['count'], worst['request_id'],

@@ -336,6 +336,16 @@ run bench_serve_resnet50_int8 $QT python bench.py --serve --quick --int8
 run bench_serve_generate $QT python bench.py --serve --generate --quick
 run bench_serve_generate_int8kv $QT python bench.py --serve --generate --quick --int8-kv
 
+# paged KV cache + chunked prefill (ISSUE 17): the serving
+# memory-economy A/B against the slot-cache rows above -- same
+# model, same offered load, but the KV lives in a shared page pool
+# behind a radix prefix index.  The rows carry prefix_hit_rate /
+# pages_per_request / kv_bytes_per_token sidecars; the slot rows
+# carry the same columns (None for the page-economy pair) so the
+# diff is column-wise.
+run bench_serve_generate_paged $QT python bench.py --serve --generate --quick --paged --prefill-chunk 8
+run bench_serve_generate_paged_int8kv $QT python bench.py --serve --generate --quick --paged --prefill-chunk 8 --int8-kv
+
 # continuous deployment (ISSUE 13): how fast weights roll through a
 # 2-replica serving fleet under live traffic -- rolls/minute with
 # the contract sidecars (dropped_during_swap MUST be 0, per-replica

@@ -808,6 +808,55 @@ def test_new_sites_in_spec_grammar():
                              ['swap_kill']) == 'serve_slow=*:0.1'
 
 
+def test_serve_longprompt_site_fires_with_count():
+    """``serve_longprompt`` (the chunked-prefill burst site): fires
+    at its occurrence with the spec'd burst size, default 3, and is
+    in the spec grammar beside the other serve sites."""
+    seed, rank, rules = chaos.parse_spec('serve_longprompt=@1:2')
+    assert rules['serve_longprompt'].at == frozenset({1})
+    assert rules['serve_longprompt'].arg == 2
+    chaos.install(chaos.FaultInjector('serve_longprompt=@1:2'))
+    try:
+        assert chaos.on_serve_longprompt() == 0   # occurrence 0
+        assert chaos.on_serve_longprompt() == 2   # occurrence 1 fires
+        assert chaos.on_serve_longprompt() == 0   # one-shot
+    finally:
+        chaos.uninstall()
+    chaos.install(chaos.FaultInjector('serve_longprompt=@0'))
+    try:
+        assert chaos.on_serve_longprompt() == 3   # default burst
+    finally:
+        chaos.uninstall()
+    assert chaos.on_serve_longprompt() == 0       # uninstalled: quiet
+
+
+def test_serve_longprompt_injects_max_length_prompts():
+    """The loadgen end-to-end: a fired site submits max-length
+    prompts through the queue's NORMAL bounded admission -- they show
+    up in the report's ``longprompt_injected`` count and are served
+    like any other request."""
+    import jax.numpy as jnp
+    from chainermn_tpu.models import TransformerLM
+    from chainermn_tpu import serving
+    model = TransformerLM(vocab_size=32, d_model=32, n_heads=4,
+                          n_layers=1, d_ff=32, max_len=64)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 4), jnp.int32))['params']
+    eng = serving.GenerationEngine(model, params, n_slots=2,
+                                   max_prompt_len=16, aot=False)
+    q = serving.GenerationQueue(max_prompt_len=16)
+    chaos.install(chaos.FaultInjector('serve_longprompt=@1:2'))
+    try:
+        rep = serving.open_loop_generate(
+            eng, q, rate=200.0, n_requests=3, seed=0,
+            prompt_len_range=(1, 3), max_new_tokens=2)
+    finally:
+        chaos.uninstall()
+    assert rep['longprompt_injected'] == 2
+    assert rep['offered'] == 5
+    assert rep['served'] == 5 and rep['errored'] == 0
+
+
 def test_chaos_data_corruption_site_detected_typed(tmp_path):
     """``data_corrupt`` flips record-payload bytes BEFORE the shard
     reader's crc check (the input-data twin of the ckpt_flip test

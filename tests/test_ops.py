@@ -210,6 +210,209 @@ class TestDecodeAttention:
         walk(jaxpr.jaxpr)
 
 
+class TestDecodePagedAttention:
+    """Paged decode (this PR's tentpole kernel): the cache is a POOL
+    of fixed-size pages read through per-sequence int32 page tables
+    -- oracle parity (fallback AND interpret), equivalence with the
+    contiguous decode oracle on a gathered cache, int8-KV page
+    dequant, stale-page safety, and the one-pool-read jaxpr pin."""
+
+    def _pool(self, b=3, n_pages=14, ps=8, n_max=4, h=2, d=16):
+        q = _rand((b, h, d), 20)
+        k = _rand((n_pages, ps, h, d), 21)
+        v = _rand((n_pages, ps, h, d), 22)
+        # distinct non-scratch pages, deliberately NON-contiguous and
+        # shared-free so the contiguous-gather oracle is well defined
+        rng = np.random.RandomState(0)
+        perm = 1 + rng.permutation(n_pages - 1)[:b * n_max]
+        tables = jnp.asarray(perm.reshape(b, n_max), jnp.int32)
+        lengths = jnp.asarray([5, n_max * ps, ps + 3], jnp.int32)[:b]
+        return q, k, v, tables, lengths
+
+    def test_matches_reference(self, mode):
+        q, k, v, tables, lengths = self._pool()
+        out = ops.flash_attention_decode_paged(q, k, v, tables,
+                                               lengths)
+        ref = ops.decode_attention_paged_reference(q, k, v, tables,
+                                                   lengths)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_matches_contiguous_decode_oracle(self, mode):
+        """Cross-oracle pin: gathering the table rows into a private
+        contiguous cache and running the NON-paged decode oracle must
+        give the same answer -- paging is pure addressing."""
+        q, k, v, tables, lengths = self._pool()
+        b, n_max = tables.shape
+        ps = k.shape[1]
+        kc = jnp.take(k, tables.reshape(-1), axis=0).reshape(
+            (b, n_max * ps) + k.shape[2:])
+        vc = jnp.take(v, tables.reshape(-1), axis=0).reshape(
+            (b, n_max * ps) + v.shape[2:])
+        out = ops.flash_attention_decode_paged(q, k, v, tables,
+                                               lengths)
+        ref = ops.decode_attention_reference(q, kc, vc, lengths)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_stale_pages_beyond_length_ignored(self, mode):
+        """Page-reuse safety: a table may still name pages past the
+        sequence's live frontier (reclaimed, or the scratch page);
+        their contents must get no probability mass."""
+        q, k, v, tables, lengths = self._pool()
+        ps = k.shape[1]
+        lengths = jnp.minimum(lengths, ps + 1)   # <= 2 live pages
+        dirty = np.asarray(tables)[:, 2:].reshape(-1)   # dead entries
+        k_dirty = k.at[dirty].set(100.0).at[0].set(100.0)
+        v_dirty = v.at[dirty].set(-100.0).at[0].set(-100.0)
+        out = ops.flash_attention_decode_paged(q, k_dirty, v_dirty,
+                                               tables, lengths)
+        ref = ops.decode_attention_paged_reference(q, k, v, tables,
+                                                   lengths)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_int8_kv(self, mode):
+        from chainermn_tpu.precision import quantize_kv
+        q, k, v, tables, lengths = self._pool()
+        kq, ks = quantize_kv(k)
+        vq, vs = quantize_kv(v)
+        ref_f32 = ops.decode_attention_paged_reference(
+            q, k, v, tables, lengths)
+        ref_i8 = ops.decode_attention_paged_reference(
+            q, kq, vq, tables, lengths, k_scale=ks, v_scale=vs)
+        out = ops.flash_attention_decode_paged(
+            q, kq, vq, tables, lengths, k_scale=ks, v_scale=vs)
+        # kernel matches its own int8 oracle tightly...
+        np.testing.assert_allclose(out, ref_i8, atol=2e-5, rtol=2e-5)
+        # ...and the f32 answer within the documented 5e-2
+        np.testing.assert_allclose(out, ref_f32, atol=5e-2,
+                                   rtol=5e-2)
+
+    def test_scale_args_must_pair(self, mode):
+        from chainermn_tpu.precision import quantize_kv
+        q, k, v, tables, lengths = self._pool()
+        kq, ks = quantize_kv(k)
+        with pytest.raises(ValueError, match='BOTH'):
+            ops.flash_attention_decode_paged(q, kq, v, tables,
+                                             lengths, k_scale=ks)
+
+    def test_jaxpr_one_pool_read_no_full_materialization(self):
+        """The paged twin of the decode jaxpr pin: each pool operand
+        is consumed ONCE at the top level (one streamed pass over the
+        table-named pages) and no f32 score/probability row spanning
+        the whole table extent is ever materialized."""
+        b, n_pages, ps, n_max, h, d = 2, 16, 8, 4, 2, 16
+        s_virt = n_max * ps
+
+        def step(q, k, v, tables, lengths):
+            return ops.flash_attention_decode_paged(q, k, v, tables,
+                                                    lengths)
+
+        jaxpr = jax.make_jaxpr(step)(
+            jnp.zeros((b, h, d)), jnp.zeros((n_pages, ps, h, d)),
+            jnp.zeros((n_pages, ps, h, d)),
+            jnp.zeros((b, n_max), jnp.int32),
+            jnp.zeros((b,), jnp.int32))
+        _, k_var, v_var, _, _ = jaxpr.jaxpr.invars
+        for var in (k_var, v_var):
+            readers = [e for e in jaxpr.jaxpr.eqns
+                       if var in e.invars]
+            assert len(readers) == 1, (
+                'pool operand consumed %d times' % len(readers))
+
+        def walk(jx):
+            for e in jx.eqns:
+                for ov in e.outvars:
+                    shape = getattr(ov.aval, 'shape', ())
+                    dtype = getattr(ov.aval, 'dtype', None)
+                    if (len(shape) >= 2 and shape[-1] == s_virt
+                            and str(dtype) == 'float32'):
+                        raise AssertionError(
+                            'full-extent f32 row materialized: '
+                            '%s %r' % (e.primitive, shape))
+                for sub in jax.core.jaxprs_in_params(e.params):
+                    walk(sub)
+
+        walk(jaxpr.jaxpr)
+
+
+class TestChunkAttention:
+    """Chunked prefill's attention: a C-token chunk attends causally
+    within itself AND to ``ctx_len`` banked context tokens, merged
+    exactly via logsumexps -- oracle parity, the rows-of-full-causal
+    pin, the bitwise ctx=0 degeneration, and int8 context pages."""
+
+    def _operands(self, b=2, c=16, s_ctx=24, h=2, d=16):
+        q = _rand((b, c, h, d), 30)
+        k_new = _rand((b, c, h, d), 31)
+        v_new = _rand((b, c, h, d), 32)
+        k_ctx = _rand((b, s_ctx, h, d), 33)
+        v_ctx = _rand((b, s_ctx, h, d), 34)
+        ctx_len = jnp.asarray([s_ctx, s_ctx // 2 + 1], jnp.int32)[:b]
+        return q, k_new, v_new, k_ctx, v_ctx, ctx_len
+
+    def test_matches_reference(self, mode):
+        q, k_new, v_new, k_ctx, v_ctx, ctx_len = self._operands()
+        out = ops.flash_attention_chunk(q, k_new, v_new, k_ctx,
+                                        v_ctx, ctx_len,
+                                        block_q=8, block_k=8)
+        ref = ops.chunk_attention_reference(q, k_new, v_new, k_ctx,
+                                            v_ctx, ctx_len)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_matches_full_causal_rows(self, mode):
+        """The strong pin: chunk attention over (banked ctx, chunk)
+        equals rows [ctx_len:ctx_len+C] of FULL causal attention on
+        the concatenated sequence -- chunking is a schedule, not an
+        approximation."""
+        b, c, s_ctx, h, d = 1, 8, 16, 2, 8
+        q_full = _rand((b, s_ctx + c, h, d), 40)
+        k_full = _rand((b, s_ctx + c, h, d), 41)
+        v_full = _rand((b, s_ctx + c, h, d), 42)
+        full = ops.mha_reference(q_full, k_full, v_full, causal=True)
+        out = ops.flash_attention_chunk(
+            q_full[:, s_ctx:], k_full[:, s_ctx:], v_full[:, s_ctx:],
+            k_full[:, :s_ctx], v_full[:, :s_ctx],
+            jnp.full((b,), s_ctx, jnp.int32), block_q=8, block_k=8)
+        np.testing.assert_allclose(out, full[:, s_ctx:], atol=2e-5,
+                                   rtol=2e-5)
+
+    def test_ctx_zero_bitwise_equals_causal(self, mode):
+        """The first chunk of a prompt (no banked context yet) must
+        degenerate to plain causal attention BITWISE: the merge
+        weight of an all-masked context half is exactly 0.0."""
+        q, k_new, v_new, k_ctx, v_ctx, _ = self._operands()
+        ctx0 = jnp.zeros((q.shape[0],), jnp.int32)
+        out = ops.flash_attention_chunk(q, k_new, v_new, k_ctx,
+                                        v_ctx, ctx0,
+                                        block_q=8, block_k=8)
+        base = ops.flash_attention(q, k_new, v_new, causal=True,
+                                   block_q=8, block_k=8)
+        assert np.array_equal(np.asarray(out), np.asarray(base))
+
+    def test_int8_ctx(self, mode):
+        from chainermn_tpu.precision import quantize_kv
+        q, k_new, v_new, k_ctx, v_ctx, ctx_len = self._operands()
+        kq, ks = quantize_kv(k_ctx)
+        vq, vs = quantize_kv(v_ctx)
+        ref_f32 = ops.chunk_attention_reference(
+            q, k_new, v_new, k_ctx, v_ctx, ctx_len)
+        ref_i8 = ops.chunk_attention_reference(
+            q, k_new, v_new, kq, vq, ctx_len, k_scale=ks, v_scale=vs)
+        out = ops.flash_attention_chunk(
+            q, k_new, v_new, kq, vq, ctx_len, k_scale=ks,
+            v_scale=vs, block_q=8, block_k=8)
+        np.testing.assert_allclose(out, ref_i8, atol=2e-5, rtol=2e-5)
+        np.testing.assert_allclose(out, ref_f32, atol=5e-2,
+                                   rtol=5e-2)
+
+    def test_scale_args_must_pair(self, mode):
+        from chainermn_tpu.precision import quantize_kv
+        q, k_new, v_new, k_ctx, v_ctx, ctx_len = self._operands()
+        kq, ks = quantize_kv(k_ctx)
+        with pytest.raises(ValueError, match='BOTH'):
+            ops.flash_attention_chunk(q, k_new, v_new, kq, v_ctx,
+                                      ctx_len, k_scale=ks)
+
+
 class TestCrossEntropy:
     def test_matches_reference(self, mode):
         logits = _rand((20, 33), 0)

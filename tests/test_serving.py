@@ -838,6 +838,285 @@ class TestOpenLoopGenerate:
         assert rep['int8_kv'] is True
 
 
+# ---------------------------------------------------------------------
+# paged KV cache + radix prefix sharing + chunked prefill (ISSUE 17)
+
+class TestPagedGeneration:
+    """The serving-level acceptance pins for the paged KV cache:
+    greedy parity with the slot engine (including across slot refill
+    and CoW divergence), the prefix-sharing capacity win measured on
+    the ``serve_kv_pages_in_use`` gauge, flat trace counts across
+    page reclaim, and arrival-order-invariant prefix keys."""
+
+    PS = 8
+
+    def _engine(self, model, params, paged, **kw):
+        base = dict(n_slots=2, max_prompt_len=16, max_len=32)
+        base.update(kw)
+        if paged:
+            base.update(paged=True, page_size=self.PS)
+        return serving.GenerationEngine(model, params, **base)
+
+    def _queue(self, eng, **kw):
+        return serving.GenerationQueue(
+            max_prompt_len=eng.max_prompt_len,
+            page_size=self.PS if eng.paged else None, **kw)
+
+    def _drain(self, eng, q, reqs, max_steps=400):
+        for _ in range(max_steps):
+            if all(r.done() for r in reqs):
+                break
+            eng.step(q)
+        return [np.asarray(r.result(timeout=0)) for r in reqs]
+
+    @pytest.mark.parametrize('int8_kv', [False, True])
+    def test_greedy_parity_with_slot_engine_across_refill(self,
+                                                          int8_kv):
+        """Paged greedy outputs are token-identical to the slot
+        engine's, with 6 requests flowing through 2 slots (several
+        refill generations and page reclaim cycles)."""
+        model, params = _tiny_lm()
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(1, 32, size=n).tolist()
+                   for n in (3, 7, 12, 5, 14, 9)]
+        outs = {}
+        for paged in (False, True):
+            eng = self._engine(model, params, paged, int8_kv=int8_kv)
+            eng.warmup()
+            q = self._queue(eng, max_queue=16)
+            reqs = [q.submit(p, 4) for p in prompts]
+            outs[paged] = self._drain(eng, q, reqs)
+        for slot_out, paged_out in zip(outs[False], outs[True]):
+            assert np.array_equal(slot_out, paged_out)
+
+    def test_chunked_prefill_same_tokens_as_monolithic(self):
+        """SARATHI-style chunking is a latency schedule, not a model
+        change: chunk-width-4 prefill emits the same greedy tokens as
+        one-shot prefill."""
+        model, params = _tiny_lm()
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(1, 32, size=n).tolist()
+                   for n in (2, 11, 16, 7)]
+        outs = {}
+        for chunk in (None, 4):
+            eng = self._engine(model, params, True,
+                               prefill_chunk=chunk)
+            eng.warmup()
+            q = self._queue(eng, max_queue=8)
+            reqs = [q.submit(p, 4) for p in prompts]
+            outs[chunk] = self._drain(eng, q, reqs)
+            if chunk:
+                assert eng.stats()['prefill_chunks'] > len(prompts)
+        for mono, chunked in zip(outs[None], outs[4]):
+            assert np.array_equal(mono, chunked)
+
+    def test_prefix_sharing_capacity_win_on_pages_gauge(self,
+                                                        tmp_path):
+        """THE capacity acceptance pin: 8 shared-prefix requests run
+        concurrently in a pool that is strictly smaller than the slot
+        engine's slab requirement, because the prompt's full pages
+        are banked once and read by everyone.  Machine-checked on the
+        ``serve_kv_pages_in_use`` gauge."""
+        from chainermn_tpu import telemetry
+        model, params = _tiny_lm()
+        # slab requirement: n_slots * pages_per_seq = 8 * 4 = 32
+        # usable pages; this pool has 20 (+1 scratch).
+        eng = serving.GenerationEngine(
+            model, params, n_slots=8, max_prompt_len=24, max_len=32,
+            paged=True, page_size=self.PS, n_pages=21)
+        eng.warmup()
+        prompt = np.random.RandomState(2).randint(
+            1, 32, size=24).tolist()
+        rec = telemetry.enable(str(tmp_path / 'cap'))
+        try:
+            gauge = telemetry.registry().gauge('serve_kv_pages_in_use')
+            q = self._queue(eng, max_queue=16)
+            first = q.submit(prompt, 4)
+            self._drain(eng, q, [first])
+            # the completed prefill banked its 3 full prompt pages
+            assert eng.pool.in_use() == 3
+            followers = [q.submit(prompt, 4) for _ in range(7)]
+            samples = []
+            for _ in range(64):
+                if all(r.done() for r in followers):
+                    break
+                eng.step(q)
+                samples.append(gauge.value)
+            outs = [np.asarray(r.result(timeout=0))
+                    for r in followers]
+            rec.flush()
+        finally:
+            telemetry.disable()
+        ref = np.asarray(first.result(timeout=0))
+        assert all(np.array_equal(o, ref) for o in outs)
+        st = eng.stats()
+        assert st['prefix_hits'] == 7
+        assert st['prefix_tokens_reused'] == 7 * 24
+        assert st['cow_copies'] == 7
+        # 3 banked prefix pages + 7 x (1 CoW boundary + 1 decode
+        # page): far under the 32-page slab a private-slab engine
+        # would pin for the same concurrency.
+        assert max(samples) <= 17 < eng.n_slots * eng.pages_per_seq
+        assert st['peak_pages_in_use'] <= 17
+        assert st['pages_in_use'] == 3   # only the bank survives
+
+    def test_cow_divergence_parity_vs_slot_engine(self):
+        """Greedy parity across the copy-on-write boundary: B shares
+        A's banked prefix and diverges INSIDE the tail page; C
+        re-runs A exactly (full-page over-coverage demotes the last
+        banked page to a CoW tail).  Both must match the slot
+        engine token for token."""
+        model, params = _tiny_lm()
+        rng = np.random.RandomState(3)
+        a = rng.randint(1, 32, size=12).tolist()
+        b = a + rng.randint(1, 32, size=6).tolist()
+        outs = {}
+        for paged in (False, True):
+            eng = self._engine(model, params, paged,
+                               max_prompt_len=18)
+            eng.warmup()
+            q = self._queue(eng)
+            got = []
+            for p in (a, b, list(a)):     # sequential: A banks first
+                got.extend(self._drain(eng, q, [q.submit(p, 4)]))
+            outs[paged] = got
+            if paged:
+                st = eng.stats()
+                assert st['prefix_hits'] == 2
+                assert st['cow_copies'] >= 2
+        for slot_out, paged_out in zip(outs[False], outs[True]):
+            assert np.array_equal(slot_out, paged_out)
+
+    def test_no_retrace_across_refill_and_page_reclaim(self):
+        """The SL007 twin for paged serving: after warmup, admits,
+        CoW copies, slot refills and page reclaims never trace or
+        compile again."""
+        model, params = _tiny_lm()
+        # a roomy pool so the banked duplicate prefix is never
+        # LRU-evicted under load -- its CoW reuse is the point here
+        eng = self._engine(model, params, True, n_pages=33)
+        eng.warmup()
+        base = {k: eng.stats()[k]
+                for k in ('prefill_trace_count', 'decode_trace_count',
+                          'copy_trace_count', 'compile_count')}
+        q = self._queue(eng, max_queue=16)
+        rng = np.random.RandomState(4)
+        dup = rng.randint(1, 32, size=12).tolist()
+        # bank the duplicate's prefix first, then push 5 more through
+        # 2 slots -- the second dup takes the CoW path on the warmed
+        # copy executable
+        self._drain(eng, q, [q.submit(dup, 3)])
+        prompts = [rng.randint(1, 32, size=n).tolist()
+                   for n in (5, 9, 16, 2)] + [dup]
+        self._drain(eng, q, [q.submit(p, 3) for p in prompts])
+        st = eng.stats()
+        assert st['prefix_hits'] >= 1 and st['cow_copies'] >= 1
+        for key, value in base.items():
+            assert st[key] == value, key
+
+    def test_prefix_key_invariant_under_arrival_order(self):
+        """The admission satellite pin: a request's ``prefix_key`` is
+        a pure function of its token ids -- submission order across
+        two queues never changes it."""
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(1, 32, size=n).tolist()
+                   for n in (3, 9, 17, 8, 24)]
+
+        def keys(order):
+            q = serving.GenerationQueue(max_prompt_len=32,
+                                        max_queue=16,
+                                        page_size=self.PS)
+            return {i: q.submit(prompts[i], 2).prefix_key
+                    for i in order}
+
+        first = keys(range(5))
+        shuffled = keys([4, 2, 0, 3, 1])
+        assert first == shuffled
+        for i, p in enumerate(prompts):
+            assert first[i] == serving.prefix_key(p, self.PS)
+            # the key hashes the page-aligned prefix: tokens past the
+            # aligned cut cannot change it
+            aligned = (len(p) // self.PS) * self.PS
+            if aligned >= self.PS:
+                assert serving.prefix_key(p[:aligned] + [31], self.PS)\
+                    == serving.prefix_key(p[:aligned], self.PS)
+
+    def test_chunked_prefill_holds_intertoken_slo_under_longprompt(
+            self, tmp_path):
+        """THE chunked-prefill acceptance pin, A/B under the
+        ``serve_longprompt`` chaos site: the same max-length-prompt
+        burst replayed into two paged engines.  Monolithic prefill
+        stalls every live decode stream for the whole 256-token
+        prompt and breaches the windowed inter-token burn-rate
+        verdict; SARATHI chunking interleaves 8-token chunks with
+        decode and holds it at ``ok``.  Both verdicts come from the
+        same deterministic ``evaluate_capture`` replay CI runs."""
+        from chainermn_tpu import telemetry
+        from chainermn_tpu.telemetry.slo import (default_slos,
+                                                 evaluate_capture)
+        from chainermn_tpu.models import TransformerLM
+        # big enough that a monolithic 256-token prefill dwarfs one
+        # decode step -- the regime chunked prefill exists for
+        model = TransformerLM(vocab_size=64, d_model=128, n_heads=4,
+                              n_layers=2, d_ff=256, max_len=288)
+        params = model.init(jax.random.PRNGKey(0),
+                            jnp.zeros((1, 4), jnp.int32))['params']
+        reports = {}
+        for chunk in (8, None):
+            eng = serving.GenerationEngine(
+                model, params, n_slots=4, max_prompt_len=256,
+                max_len=272, paged=True, page_size=16,
+                prefill_chunk=chunk)
+            eng.warmup()
+            q = serving.GenerationQueue(max_prompt_len=256,
+                                        max_queue=64, page_size=16)
+            cap = str(tmp_path / ('chunk' if chunk else 'mono'))
+            telemetry.enable(cap)
+            try:
+                chaos.install(chaos.FaultInjector(
+                    'seed=7;serve_longprompt=p0.4:2'))
+                try:
+                    rep = serving.open_loop_generate(
+                        eng, q, rate=150.0, n_requests=12, seed=11,
+                        prompt_len_range=(1, 8), max_new_tokens=8,
+                        capture_dir=cap)
+                finally:
+                    chaos.uninstall()
+            finally:
+                telemetry.disable()
+            rep['capture'] = cap
+            reports[chunk] = rep
+        chunked, mono = reports[8], reports[None]
+        # identical offered load: same arrival seed, same chaos draws
+        assert chunked['longprompt_injected'] \
+            == mono['longprompt_injected'] > 0
+        assert chunked['served'] == mono['served'] \
+            == chunked['offered']
+        assert chunked['paged']['prefill_chunks'] \
+            > 32 * chunked['longprompt_injected']  # 256/8 per burst
+        chunk_p99 = chunked['intertoken_p99_ms']
+        mono_p99 = mono['intertoken_p99_ms']
+        if mono_p99 < 2.0 * chunk_p99:
+            pytest.skip('no prefill-stall separation on this host '
+                        '(mono p99 %.1f ms vs chunked %.1f ms)'
+                        % (mono_p99, chunk_p99))
+        # adaptive target between the two arms' tails: clear of every
+        # chunked sample, inside the monolithic stall plateau
+        target_ms = max((chunk_p99 * mono_p99) ** 0.5,
+                        2.0 * chunk_p99)
+        slos = default_slos(ttft_s=1e3, intertoken_s=target_ms / 1e3,
+                            objective=0.995, max_shed_fraction=1.0,
+                            max_occupancy=1.1, fast_window_s=120.0,
+                            slow_window_s=120.0)
+        verdicts = {}
+        for name, rep in (('chunk', chunked), ('mono', mono)):
+            res = evaluate_capture(rep['capture'], slos=slos)
+            assert res['n_request_records'] > 0
+            verdicts[name] = res['slos']['intertoken_p99']['verdict']
+        assert verdicts['chunk'] == 'ok', verdicts
+        assert verdicts['mono'] == 'breach', verdicts
+
+
 class TestGenerateTelemetry:
     def _generate_capture(self, tmp_path):
         model, params = _tiny_lm()

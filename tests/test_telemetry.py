@@ -509,8 +509,16 @@ def test_disabled_overhead_under_2pct_on_mlp_step():
     path -- the disabled path does strictly less work (one attribute
     load + identity check per guard), so the pin bounds it too.  A
     large-ish mlp keeps the step in the tens-of-milliseconds range so
-    scheduler noise cannot fake a 2% delta; each arm takes the best
-    of three ``benchmark_op`` runs."""
+    scheduler noise cannot fake a 2% delta.
+
+    Flake control (the <2% CONTRACT is unchanged): each arm is the
+    MEDIAN of interleaved rounds -- min-of-rounds compares two
+    extreme order statistics, whose ratio is far noisier than the
+    medians' on a loaded CI box -- plus ONE load-aware retry: a
+    failing first trial reruns once with more rounds, and only the
+    retry's verdict counts.  Ambient load that spans one trial (a
+    neighboring test's compile burst) gets a second look; a real
+    regression fails both."""
     assert not telemetry.enabled()
     upd, batch = _mlp_updater(n_units=256, batch=1024, donate=False)
     arrays = upd.shard_batch(batch)
@@ -519,27 +527,35 @@ def test_disabled_overhead_under_2pct_on_mlp_step():
     def step():
         return upd.update_core(arrays)
 
-    # INTERLEAVED arms: off/on alternate within each round, so
-    # ambient machine load lands on both equally and the min-of-rounds
-    # compares like with like (a sequential A-then-B layout flakes
-    # whenever a background process spans only one arm)
-    t_off, t_on = [], []
-    try:
-        for _ in range(4):
+    def trial(rounds):
+        # INTERLEAVED arms: off/on alternate within each round, so
+        # ambient machine load lands on both arms equally (a
+        # sequential A-then-B layout flakes whenever a background
+        # process spans only one arm)
+        t_off, t_on = [], []
+        try:
+            for _ in range(rounds):
+                telemetry.disable()
+                t_off.append(profiling.benchmark_op(
+                    step, n_steps=8, warmup=1))
+                telemetry.enable()  # in-memory recorder, fences off
+                t_on.append(profiling.benchmark_op(
+                    step, n_steps=8, warmup=1))
+        finally:
             telemetry.disable()
-            t_off.append(profiling.benchmark_op(step, n_steps=8,
-                                                warmup=1))
-            telemetry.enable()  # in-memory recorder, fences off
-            t_on.append(profiling.benchmark_op(step, n_steps=8,
-                                               warmup=1))
-    finally:
-        telemetry.disable()
-    overhead = min(t_on) / min(t_off) - 1.0
+        off = float(np.median(t_off))
+        on = float(np.median(t_on))
+        return on / off - 1.0, off, on
+
+    overhead, off, on = trial(rounds=4)
+    if overhead >= 0.02:
+        # load-aware retry: one rerun with more rounds decides
+        overhead, off, on = trial(rounds=8)
     assert overhead < 0.02, (
         'telemetry-enabled update_core overhead %.2f%% (off %.3f ms, '
-        'on %.3f ms): the disabled-by-default path is bounded by '
-        'this and must stay unmeasurable'
-        % (overhead * 100, min(t_off) * 1e3, min(t_on) * 1e3))
+        'on %.3f ms, median-of-rounds, after retry): the disabled-'
+        'by-default path is bounded by this and must stay '
+        'unmeasurable' % (overhead * 100, off * 1e3, on * 1e3))
 
 
 # ---------------------------------------------------------------------
