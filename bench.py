@@ -1997,9 +1997,14 @@ def measure_recovery(argv):
     and resumes from the periodic checkpoint -- and reports the
     ledger's own recovery accounting: MTTR (failure detection to
     first post-resume progress) as the row value, with downtime,
-    cause, world sizes and resumed step as fields.  No accelerator
-    involved: this row prices the CONTROL loop, so it stays
-    measurable through TPU outage windows."""
+    cause, world sizes and resumed step as fields -- plus the
+    unified goodput decomposition
+    (:mod:`chainermn_tpu.telemetry.goodput`): ``goodput_fraction``
+    and the per-bucket wall-clock split are banked alongside MTTR so
+    the recovery row prices not just how fast the supervisor healed
+    but what the whole incident cost.  No accelerator involved: this
+    row prices the CONTROL loop, so it stays measurable through TPU
+    outage windows."""
     import shutil
     import tempfile
 
@@ -2053,6 +2058,14 @@ def measure_recovery(argv):
             'quick': quick,
             'backend': 'cpu-subprocess',
         }
+        from chainermn_tpu.telemetry import goodput as _goodput
+        gp = _goodput.build_goodput(out)
+        if gp.get('wall_s') is not None:
+            result['goodput_fraction'] = gp['goodput_fraction']
+            result['goodput_wall_s'] = gp['wall_s']
+            result['goodput_buckets_s'] = gp['buckets_s']
+            result['restart_downtime_s'] = \
+                gp['buckets_s']['restart_downtime']
         if rc != 0 or mttr is None:
             result['error'] = 'recovery_incomplete'
         emit(result, rc=0 if rc == 0 and mttr is not None else 1)

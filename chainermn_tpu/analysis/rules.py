@@ -70,7 +70,8 @@ class RuleContext:
                  trace_error=None, declared_dtypes=None,
                  compute_dtype=None, overlap_check=False,
                  plan_axes=None, rank_addressed=None,
-                 rank_streams=None, p2p_streams=None):
+                 rank_streams=None, p2p_streams=None,
+                 staged_axes=None):
         self.target_name = target_name
         self.jaxpr = jaxpr
         self.mesh_axes = dict(mesh_axes or {})
@@ -80,6 +81,8 @@ class RuleContext:
         self.overlap_check = overlap_check
         self.plan_axes = (tuple(plan_axes) if plan_axes is not None
                           else None)
+        self.staged_axes = (frozenset(staged_axes)
+                            if staged_axes is not None else frozenset())
         self.rank_addressed = (tuple(rank_addressed)
                                if rank_addressed else ())
         self.rank_streams = rank_streams
@@ -608,7 +611,13 @@ def rule_plan_axis_coverage(ctx):
 # group) instead of two serialized launches.  Scoped to plan targets:
 # the hierarchical/two_dimensional strategies STAGE their reductions
 # across axes on purpose (reduce-scatter within, allreduce across)
-# and declare no plan.
+# and declare no plan.  A PLAN target that stages deliberately -- the
+# multi-slice plan's in-slice psum feeding the cross-slice DCN psum --
+# declares the staging axes (``staged_axes``, e.g. ``('slice',)``):
+# a disjoint chain whose either stage reduces purely over declared
+# staging axes is the intended ICI/DCN split, not waste (crossing the
+# DCN once with pre-reduced partials IS the optimization a flat
+# psum over the union would undo).
 def rule_cross_axis_chain(ctx):
     out = []
     if ctx.jaxpr is None or ctx.plan_axes is None:
@@ -631,6 +640,9 @@ def rule_cross_axis_chain(ctx):
                 paxes = set(walker.eqn_axes(prev))
                 if not paxes or axes & paxes:
                     continue  # overlap is SL003's finding
+                if ctx.staged_axes and (axes <= ctx.staged_axes
+                                        or paxes <= ctx.staged_axes):
+                    continue  # declared hierarchical staging
                 out.append(ctx.finding(
                     'SL011', SEV_WARNING,
                     '%s over %s directly consumes %s over %s: '

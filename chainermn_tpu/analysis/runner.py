@@ -49,6 +49,7 @@ def lint_target(target, only=None):
         compute_dtype=getattr(target, 'compute_dtype', None),
         overlap_check=getattr(target, 'overlap_check', False),
         plan_axes=getattr(target, 'plan_axes', None),
+        staged_axes=getattr(target, 'staged_axes', None),
         rank_addressed=getattr(target, 'rank_addressed', None),
         rank_streams=rank_streams,
         signatures=signatures, trace_error=err)

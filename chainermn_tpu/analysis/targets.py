@@ -45,12 +45,17 @@ class LintTarget:
         (a root-addressed broadcast, a deliberate per-rank leg);
         SL013's cross-rank stream comparison and SL015's
         rank-dependent-control-flow audit exempt exactly these ops.
+      staged_axes: axes over which this target STAGES its reductions
+        on purpose (the multi-slice plan's cross-slice DCN leg);
+        SL011's disjoint-chain rule exempts chains whose stage
+        reduces purely over these axes.
     """
 
     def __init__(self, name, fn, args, mesh_axes, reduction_axes=None,
                  make_args=None, declared_dtypes=None,
                  compute_dtype=None, items=None, overlap_check=False,
-                 plan_axes=None, rank_addressed=None):
+                 plan_axes=None, rank_addressed=None,
+                 staged_axes=None):
         self.name = name
         self.fn = fn
         self.args = tuple(args)
@@ -63,6 +68,8 @@ class LintTarget:
         self.overlap_check = overlap_check
         self.plan_axes = (tuple(plan_axes) if plan_axes is not None
                           else None)
+        self.staged_axes = (tuple(staged_axes)
+                            if staged_axes is not None else None)
         self.rank_addressed = (tuple(rank_addressed)
                                if rank_addressed else ())
         self.make_args = make_args
@@ -150,14 +157,15 @@ def _data_comm():
 
 
 def _updater_target(name, updater, batch, mesh_axes,
-                    compute_dtype=None, items=None, plan_axes=None):
+                    compute_dtype=None, items=None, plan_axes=None,
+                    staged_axes=None):
     fn, args = updater.traceable_step(batch, iteration=1)
     declared = getattr(updater, 'declared_reduce_dtypes',
                        lambda: None)()
     return LintTarget(
         name, fn, args, mesh_axes, declared_dtypes=declared,
         compute_dtype=compute_dtype, items=items, overlap_check=True,
-        plan_axes=plan_axes,
+        plan_axes=plan_axes, staged_axes=staged_axes,
         make_args=lambda it: updater.traceable_step(
             batch, iteration=it)[1])
 
@@ -460,6 +468,50 @@ def transformer_tp_pp_step_target(policy=None, tp=2, pp=2):
                            plan_axes=tuple(plan.mesh.axis_names))
 
 
+def mlp_slice_step_target(policy=None, slices=2):
+    """The multi-slice data-parallel step (``docs/fault_tolerance.md``
+    "slice-level failure domains"): the mnist-shaped step on a
+    ``MeshPlan.create(slices=N)`` plan whose gradient reduction is
+    the DELIBERATE two-stage hierarchy -- psum inside each slice
+    (ICI), psum of the partials across slices (DCN).  That chain is
+    exactly the disjoint-axis shape SL011 flags as waste on flat
+    plans, so this target declares ``staged_axes=(slice,)``: the
+    exemption that keeps the staged DCN reduce lintable without
+    silencing the rule anywhere else.  ``ci/run_staticcheck.sh``'s
+    clean-state pin covers it via the default sweep."""
+    import optax
+    import chainermn_tpu
+    from chainermn_tpu import training
+    from chainermn_tpu.models import MLP, Classifier
+    from chainermn_tpu.parallel.meshplan import MeshPlan
+
+    plan = MeshPlan.create(slices=slices)
+    comm = plan.communicator(
+        reduce_dtype=policy.reduce_dtype if policy is not None
+        else None)
+    model = MLP(n_units=16, n_out=10)
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 784), jnp.float32))
+    clf = Classifier(model.apply)
+    optimizer = chainermn_tpu.create_multi_node_optimizer(
+        optax.adam(1e-3), comm)
+    updater = training.StandardUpdater(
+        iter([]), optimizer, clf, params, comm, has_aux=True,
+        policy=policy)
+    n = 2 * plan.data_size
+    batch = _policy_batch(policy, (
+        jnp.zeros((n, 784), jnp.float32),
+        jnp.zeros((n,), jnp.int32)))
+    staged = ((plan.slice_axis,) if plan.slice_axis is not None
+              else None)
+    return _updater_target('step:mlp_slice', updater, batch,
+                           dict(plan.mesh.shape),
+                           compute_dtype=_policy_compute(policy),
+                           items=n,
+                           plan_axes=tuple(plan.mesh.axis_names),
+                           staged_axes=staged)
+
+
 def serve_forward_target(policy=None, tp=2, bucket=None):
     """The serving engine's forward-only apply over the MeshPlan
     (``docs/serving.md``): a tensor-parallel ``TransformerLM`` served
@@ -571,6 +623,8 @@ STEP_FACTORIES = {
     'transformer_tp_pp':
         lambda policy=None: transformer_tp_pp_step_target(
             policy=policy),
+    'mlp_slice':
+        lambda policy=None: mlp_slice_step_target(policy=policy),
     'serve_forward':
         lambda policy=None: serve_forward_target(policy=policy),
     'decode_forward':

@@ -59,6 +59,13 @@ AXIS_DATA = 'data'
 AXIS_MODEL = 'model'
 AXIS_PIPE = 'pipe'
 AXIS_EXPERT = 'expert'
+#: the failure-domain axis ABOVE the mesh's data axis: devices inside
+#: one slice share fast ICI, slices talk over DCN, and a slice is the
+#: unit of both hierarchical gradient reduction (in-slice psum, then
+#: cross-slice reduce) and supervisor shrink (a dead slice is removed
+#: whole, never split) -- the TPU-native twin of the reference's
+#: node-aware hierarchical communicators.
+AXIS_SLICE = 'slice'
 PLAN_AXES = (AXIS_DATA, AXIS_MODEL)
 PLAN_AXES_3D = (AXIS_DATA, AXIS_MODEL, AXIS_PIPE)
 
@@ -83,24 +90,34 @@ class MeshPlan:
     def __init__(self, mesh, data_axes=(AXIS_DATA,),
                  model_axis=AXIS_MODEL, requested_tp=None,
                  pipe_axis=None, requested_pp=None,
-                 expert_axis=None, requested_ep=None):
+                 expert_axis=None, requested_ep=None,
+                 slice_axis=None, requested_slices=None):
         self.mesh = mesh
         self.data_axes = tuple(data_axes)
         if model_axis is not None and model_axis not in mesh.shape:
             model_axis = None
         self.model_axis = model_axis
         # a directly-constructed Mesh that binds the canonical pipe /
-        # expert names IS a 3-D / expert plan (test meshes build this
-        # way); explicit kwargs override
+        # expert / slice names IS a 3-D / expert / multi-slice plan
+        # (test meshes build this way); explicit kwargs override
         if pipe_axis is None and AXIS_PIPE in mesh.shape:
             pipe_axis = AXIS_PIPE
         if expert_axis is None and AXIS_EXPERT in mesh.shape:
             expert_axis = AXIS_EXPERT
+        if slice_axis is None and AXIS_SLICE in mesh.shape:
+            slice_axis = AXIS_SLICE
         self.pipe_axis = pipe_axis
         self.expert_axis = expert_axis
+        self.slice_axis = slice_axis
+        if (slice_axis is not None
+                and slice_axis not in self.data_axes):
+            # the slice level sits ABOVE data: batch sharding, ZeRO
+            # and gradient reduction span (slice, data), slice major
+            self.data_axes = (slice_axis,) + self.data_axes
         self.requested_tp = requested_tp
         self.requested_pp = requested_pp
         self.requested_ep = requested_ep
+        self.requested_slices = requested_slices
         bound = self.data_axes + tuple(
             ax for ax in (self.model_axis, self.pipe_axis,
                           self.expert_axis) if ax is not None)
@@ -112,7 +129,7 @@ class MeshPlan:
     # -- construction --------------------------------------------------
     @classmethod
     def create(cls, tp=1, devices=None, axis_names=PLAN_AXES, pp=None,
-               ep=None):
+               ep=None, slices=None):
         """Compose a plan over the global devices.
 
         ``tp`` is the requested model-axis width; it degrades to the
@@ -139,19 +156,34 @@ class MeshPlan:
         carries the :class:`chainermn_tpu.parallel.MoELayer`
         ``all_to_all`` (see :meth:`expert_param_specs`).  Composing
         ``ep`` with ``tp > 1`` or ``pp`` is not implemented yet.
+
+        ``slices`` (an int >= 1) binds the failure-domain axis ABOVE
+        the mesh: the slice-aware device sort already groups each ICI
+        domain contiguously, so ``slices=N`` reshapes those groups
+        into the MAJOR mesh axis -- one mesh row = one slice = one
+        unit of loss.  Gradient reduction goes hierarchical over it
+        (in-slice psum, then cross-slice reduce -- see
+        :meth:`MeshPlanCommunicator._allreduce_impl`) and the
+        supervisor shrinks by whole slices on ``slice_loss``.  The
+        slice width has top clamping priority (a slice boundary is
+        physical), then tp, then pp; ``slices=None`` (the default)
+        keeps the plan sliceless.  Composing ``slices`` with ``ep``
+        is not implemented yet.
         """
         if tp < 1:
             raise ValueError('tp must be >= 1, got %d' % tp)
+        if slices is not None and slices < 1:
+            raise ValueError('slices must be >= 1, got %d' % slices)
         devices = mesh_utility.sorted_devices(devices)
         n = len(devices)
         if ep is not None:
             if ep < 1:
                 raise ValueError('ep must be >= 1, got %d' % ep)
-            if tp > 1 or pp is not None:
+            if tp > 1 or pp is not None or slices is not None:
                 raise NotImplementedError(
                     'the expert axis composes with data parallelism '
-                    'only for now: pass ep= without tp/pp (full '
-                    'mesh-placed MoE training is the follow-up)')
+                    'only for now: pass ep= without tp/pp/slices '
+                    '(full mesh-placed MoE training is the follow-up)')
             eff = mesh_utility.divisor_leq(n, ep)
             arr = np.asarray(  # noqa: shardlint - eager driver-level
                 devices, dtype=object).reshape(n // eff, eff)
@@ -159,26 +191,48 @@ class MeshPlan:
                        data_axes=(AXIS_DATA,), model_axis=None,
                        expert_axis=AXIS_EXPERT, requested_ep=ep)
         if pp is None:
-            eff = mesh_utility.divisor_leq(n, tp)
+            if slices is None:
+                eff = mesh_utility.divisor_leq(n, tp)
+                arr = np.asarray(  # noqa: shardlint - eager driver
+                    devices, dtype=object).reshape(n // eff, eff)
+                data_name, model_name = axis_names
+                return cls(Mesh(arr, (data_name, model_name)),
+                           data_axes=(data_name,),
+                           model_axis=model_name, requested_tp=tp)
+            eff_s, eff_tp = mesh_utility.divisors_leq(n, (slices, tp))
             arr = np.asarray(  # noqa: shardlint - eager driver-level
-                devices, dtype=object).reshape(n // eff, eff)
+                devices, dtype=object).reshape(
+                    eff_s, n // (eff_s * eff_tp), eff_tp)
             data_name, model_name = axis_names
-            return cls(Mesh(arr, (data_name, model_name)),
+            return cls(Mesh(arr, (AXIS_SLICE, data_name, model_name)),
                        data_axes=(data_name,), model_axis=model_name,
-                       requested_tp=tp)
+                       requested_tp=tp, slice_axis=AXIS_SLICE,
+                       requested_slices=slices)
         if pp < 1:
             raise ValueError('pp must be >= 1, got %d' % pp)
-        eff_tp, eff_pp = mesh_utility.divisors_leq(n, (tp, pp))
-        arr = np.asarray(  # noqa: shardlint - eager driver-level
-            devices, dtype=object).reshape(
-                n // (eff_tp * eff_pp), eff_tp, eff_pp)
         if len(axis_names) == 2:
             axis_names = tuple(axis_names) + (AXIS_PIPE,)
         data_name, model_name, pipe_name = axis_names
-        return cls(Mesh(arr, (data_name, model_name, pipe_name)),
+        if slices is None:
+            eff_tp, eff_pp = mesh_utility.divisors_leq(n, (tp, pp))
+            arr = np.asarray(  # noqa: shardlint - eager driver-level
+                devices, dtype=object).reshape(
+                    n // (eff_tp * eff_pp), eff_tp, eff_pp)
+            return cls(Mesh(arr, (data_name, model_name, pipe_name)),
+                       data_axes=(data_name,), model_axis=model_name,
+                       requested_tp=tp, pipe_axis=pipe_name,
+                       requested_pp=pp)
+        eff_s, eff_tp, eff_pp = mesh_utility.divisors_leq(
+            n, (slices, tp, pp))
+        arr = np.asarray(  # noqa: shardlint - eager driver-level
+            devices, dtype=object).reshape(
+                eff_s, n // (eff_s * eff_tp * eff_pp), eff_tp, eff_pp)
+        return cls(Mesh(arr, (AXIS_SLICE, data_name, model_name,
+                              pipe_name)),
                    data_axes=(data_name,), model_axis=model_name,
                    requested_tp=tp, pipe_axis=pipe_name,
-                   requested_pp=pp)
+                   requested_pp=pp, slice_axis=AXIS_SLICE,
+                   requested_slices=slices)
 
     # -- topology ------------------------------------------------------
     @property
@@ -214,6 +268,15 @@ class MeshPlan:
         return self.mesh.shape[self.expert_axis]
 
     @property
+    def slice_size(self):
+        """Number of failure-domain slices (1 when no slice axis is
+        bound -- the shape-only degradation contract: a one-slice
+        plan is the flat plan)."""
+        if self.slice_axis is None:
+            return 1
+        return self.mesh.shape[self.slice_axis]
+
+    @property
     def axis_names(self):
         return tuple(self.mesh.axis_names)
 
@@ -232,6 +295,10 @@ class MeshPlan:
             out['expert_axis'] = self.expert_axis
             out['requested_ep'] = self.requested_ep
             out['effective_ep'] = int(self.expert_size)
+        if self.slice_axis is not None:
+            out['slice_axis'] = self.slice_axis
+            out['requested_slices'] = self.requested_slices
+            out['effective_slices'] = int(self.slice_size)
         return out
 
     # -- spec handout --------------------------------------------------
@@ -409,7 +476,28 @@ class MeshPlanCommunicator(CommunicatorBase):
 
     # -- collectives ---------------------------------------------------
     def _allreduce_impl(self, grads):
-        axes = self.plan.data_axes
+        plan = self.plan
+        if plan.slice_axis is not None:
+            # hierarchical two-stage reduction: psum inside each slice
+            # first (ICI -- cheap, wide links), then psum the per-slice
+            # partials across slices (DCN -- the expensive hop moves
+            # each leaf once per slice, not once per device).  The
+            # staged sum over disjoint axis sets equals the flat psum
+            # over all data axes; dividing by data_size restores the
+            # pmean contract bit-for-bit in f32.  shardlint knows this
+            # chain is deliberate via the target's ``staged_axes``
+            # declaration (SL011's staged-reduce exemption).
+            inner = tuple(ax for ax in plan.data_axes
+                          if ax != plan.slice_axis)
+            k = plan.data_size
+
+            def staged(g):
+                if inner:
+                    g = lax.psum(g, inner)
+                g = lax.psum(g, (plan.slice_axis,))
+                return g / k
+            return jax.tree_util.tree_map(staged, grads)
+        axes = plan.data_axes
         return jax.tree_util.tree_map(
             lambda g: lax.pmean(g, axes), grads)
 

@@ -39,6 +39,11 @@ def _build_parser():
                     'classify, restart/shrink, record.')
     p.add_argument('-n', '--nprocs', type=int, default=2,
                    help='initial world size (worker processes)')
+    p.add_argument('--slices', type=int, default=None,
+                   help='failure-domain slices (must divide nprocs): '
+                        'workers build a MeshPlan.create(slices=N) '
+                        'topology with hierarchical grad reduction, '
+                        'and failures/shrinks happen by whole slices')
     p.add_argument('--out', default='supervised',
                    help='shared output dir (checkpoints, ledger, '
                         'logs, telemetry)')
@@ -116,7 +121,7 @@ def main(argv=None):
         startup_grace=args.startup_grace,
         term_grace=args.term_grace, drain_grace=args.drain_grace,
         attempt_timeout=args.attempt_timeout,
-        oracle=not args.no_oracle)
+        oracle=not args.no_oracle, slices=args.slices)
     rc = sup.run()
     print('supervisor: %s (ledger: %s)'
           % ('complete' if rc == 0 else 'ABORTED',

@@ -26,12 +26,21 @@ deterministic, and byte-identical to what the live monitor's
 ``slo_report.json`` into ``DIR``; targets are overridable per
 invocation (``--ttft-ms`` etc.).
 
+``goodput OUT`` joins a supervisor run's ledger with every attempt's
+telemetry capture (:mod:`chainermn_tpu.telemetry.goodput`) and
+decomposes the wall clock into useful-step / bubble / exposed-
+collective / checkpoint / input-bound / restart-downtime / other --
+disjoint buckets that sum to the wall exactly -- then prints
+``goodput_fraction`` and writes ``goodput_report.json`` into the run
+dir.  ``--floor F`` makes it a CI gate (exit 1 below the floor).
+
 Exit codes (all subcommands): 0 on a non-empty capture, 2 when the
 directory holds no telemetry at all (CI smoke legs fail loudly on an
 accidentally-disabled capture); ``report`` additionally exits 1 on a
 malformed Prometheus export (never expected; guards the exporter)
-and on an unknown ``--request`` id.  A missing or unknown subcommand
-prints usage and exits 2 -- CI misuse must never look like success.
+and on an unknown ``--request`` id; ``goodput`` exits 1 below its
+``--floor``.  A missing or unknown subcommand prints usage and exits
+2 -- CI misuse must never look like success.
 """
 
 import argparse
@@ -81,6 +90,25 @@ def _build_parser():
     doc.add_argument('--no-export', action='store_true',
                      help='print only; do not write '
                           'doctor_report.json into the session dir')
+    good = sub.add_parser(
+        'goodput', help='decompose a run\'s wall clock into useful-'
+                        'step / bubble / exposed-collective / '
+                        'checkpoint / input-bound / restart-downtime '
+                        'and print the goodput fraction')
+    good.add_argument('outdir',
+                      help='supervisor out dir (supervisor_ledger.'
+                           'jsonl + telemetry/a* attempt captures) '
+                           'or one telemetry session directory')
+    good.add_argument('--json', action='store_true',
+                      help='print the goodput report as JSON instead '
+                           'of text')
+    good.add_argument('--no-export', action='store_true',
+                      help='print only; do not write '
+                           'goodput_report.json into the run dir')
+    good.add_argument('--floor', type=float, default=None,
+                      metavar='F',
+                      help='exit 1 when goodput_fraction < F (CI '
+                           'chaos legs pin their floor here)')
     slo = sub.add_parser('slo', help='sliding-window SLO verdict '
                                      '(ok/warn/breach) over the '
                                      'capture\'s request traces')
@@ -191,6 +219,31 @@ def _cmd_doctor(args):
     return 0
 
 
+def _cmd_goodput(args):
+    from chainermn_tpu.telemetry import goodput as goodput_mod
+
+    gp = goodput_mod.build_goodput(args.outdir)
+    if gp.get('wall_s') is not None and not args.no_export:
+        goodput_mod.export(args.outdir, gp)
+    if args.json:
+        import json
+        print(json.dumps(gp, indent=1))
+    else:
+        print(goodput_mod.render_text(gp))
+    if gp.get('wall_s') is None:
+        print('telemetry goodput: EMPTY capture under %s (was '
+              'CHAINERMN_TPU_TELEMETRY set, and did the run flush?)'
+              % args.outdir, file=sys.stderr)
+        return 2
+    if (args.floor is not None
+            and gp['goodput_fraction'] < args.floor):
+        print('telemetry goodput: fraction %.4f BELOW floor %.4f'
+              % (gp['goodput_fraction'], args.floor),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def _cmd_slo(args):
     from chainermn_tpu.telemetry import slo as slo_mod
 
@@ -235,7 +288,7 @@ def main(argv=None):
     if args.cmd is None:
         parser.print_usage(sys.stderr)
         print('%s: error: a subcommand is required (report | doctor '
-              '| slo)' % parser.prog, file=sys.stderr)
+              '| slo | goodput)' % parser.prog, file=sys.stderr)
         return 2
     import os
     if not os.path.isdir(args.outdir):
@@ -250,6 +303,8 @@ def main(argv=None):
         return _cmd_report(args)
     if args.cmd == 'slo':
         return _cmd_slo(args)
+    if args.cmd == 'goodput':
+        return _cmd_goodput(args)
     return _cmd_doctor(args)
 
 

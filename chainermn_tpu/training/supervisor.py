@@ -80,6 +80,10 @@ ENV_CKPT_EVERY = 'CMN_SUP_CKPT_EVERY'
 ENV_LIVE = 'CMN_SUP_LIVE'
 ENV_LOCAL_DEVICES = 'CMN_SUP_LOCAL_DEVICES'
 ENV_ORACLE = 'CMN_SUP_ORACLE'
+#: number of failure-domain slices in the handout (the worker builds
+#: ``MeshPlan.create(slices=N)`` when > 1); each rank additionally
+#: receives its own slice id in ``chaos.SLICE_ENV_VAR``
+ENV_SLICES = 'CMN_SUP_SLICES'
 
 LEDGER_NAME = 'supervisor_ledger.jsonl'
 
@@ -104,11 +108,15 @@ def _free_port():
 # ----------------------------------------------------------------------
 
 Decision = collections.namedtuple(
-    'Decision', ['action', 'nprocs', 'delay', 'reason'])
+    'Decision', ['action', 'nprocs', 'delay', 'reason',
+                 'granularity'])
+Decision.__new__.__defaults__ = ('rank',)
 Decision.__doc__ += (
     ': the policy verdict for one failure.  action is '
     "'restart' | 'shrink' | 'abort'; nprocs the next world size; "
-    'delay the backoff sleep before relaunch (seconds).')
+    'delay the backoff sleep before relaunch (seconds); granularity '
+    "is 'rank' (the default) or 'slice' when the lost unit was a "
+    'whole failure-domain slice.')
 
 
 class RestartPolicy:
@@ -157,10 +165,22 @@ class RestartPolicy:
                 'backoff_delays_s': self.backoff.delays(4),
                 'shrink_causes': sorted(self.shrink_causes)}
 
-    def on_failure(self, cause, nprocs, dead_ranks=()):
+    def on_failure(self, cause, nprocs, dead_ranks=(),
+                   granularity='rank', slice_size=1):
         """The :class:`Decision` for one classified failure of a
         ``nprocs``-wide attempt.  Order of precedence: crash-loop
-        abort, budget abort, shrink, restart."""
+        abort, budget abort, shrink, restart.
+
+        One CALL is one incident: a whole-slice loss hands all its
+        member ranks in ``dead_ranks`` but charges the crash-loop
+        window exactly ONE failure -- counting correlated deaths
+        ``world_size`` times would abort on the first slice loss.
+
+        ``slice_size`` (ranks per failure-domain slice, from the
+        supervisor's topology) makes shrink slice-aligned: the next
+        world size is rounded DOWN to a multiple of it, so a shrink
+        never splits a slice.  ``granularity`` annotates the decision
+        (``'rank'`` | ``'slice'``) for the ledger."""
         now = self._clock()
         self._failures.append(now)
         recent = [t for t in self._failures
@@ -170,31 +190,41 @@ class RestartPolicy:
                 'abort', nprocs, 0.0,
                 'crash_loop: %d failures within %.0fs window '
                 '(threshold %d)' % (len(recent), self.crash_window,
-                                    self.crash_threshold))
+                                    self.crash_threshold),
+                granularity)
         if self.restarts >= self.max_restarts:
             return Decision(
                 'abort', nprocs, 0.0,
                 'restart_budget: %d restarts already spent'
-                % self.restarts)
+                % self.restarts, granularity)
         self.restarts += 1
         delay = self.backoff.next()
         dead = sorted(set(dead_ranks))
         if cause in self.shrink_causes and dead:
             shrunk = nprocs - len(dead)
+            unit = 'rank(s)'
+            if slice_size > 1:
+                # never split a slice: a sliced mesh only builds at a
+                # multiple of the slice width
+                shrunk -= shrunk % slice_size
+                if granularity == 'slice':
+                    unit = 'slice (%d rank(s))' % len(dead)
             if shrunk >= self.min_procs:
                 return Decision(
                     'shrink', shrunk, delay,
-                    'cause %r lost rank(s) %s: elastic shrink %d -> '
-                    '%d' % (cause, dead, nprocs, shrunk))
+                    'cause %r lost %s %s: elastic shrink %d -> '
+                    '%d' % (cause, unit, dead, nprocs, shrunk),
+                    granularity)
             return Decision(
                 'restart', nprocs, delay,
-                'cause %r lost rank(s) %s but shrink would go below '
+                'cause %r lost %s %s but shrink would go below '
                 'min_procs=%d: restart at %d'
-                % (cause, dead, self.min_procs, nprocs))
+                % (cause, unit, dead, self.min_procs, nprocs),
+                granularity)
         return Decision(
             'restart', nprocs, delay,
             'cause %r is not capacity loss (or no culprit named): '
-            'restart at %d' % (cause, nprocs))
+            'restart at %d' % (cause, nprocs), granularity)
 
     def on_success(self):
         """A healthy attempt completed: the backoff schedule resets
@@ -392,7 +422,7 @@ def classify_failure(first_death, rank_rcs, doctor=None,
     # mistaken for the cause of death
     terminal = ('chaos:kill_step', 'chaos:kill_recv',
                 'chaos:ckpt_kill', 'chaos:sigterm_step',
-                'chaos:hang_step')
+                'chaos:hang_step', 'chaos:slice_loss')
 
     def chaos_site_of(rank):
         # the flight record keeps only the LAST dump's reason (a
@@ -449,6 +479,55 @@ def classify_failure(first_death, rank_rcs, doctor=None,
     return cause, culprit, details
 
 
+#: exit classes that read as a HARD death (machine loss / injected
+#: kill) for slice-domain accounting; 'preempted' and 'peer_dead'
+#: exits are echoes -- survivors evacuating, not lost capacity
+_HARD_EXITS = frozenset({'crash', 'killed', 'uncaught'})
+
+
+def slice_verdict(culprit, rank_rcs, ranks_per_slice, doctor_dead=(),
+                  forced=()):
+    """``(granularity, dead_ranks)`` for a failed attempt on a sliced
+    topology: the escalation from "rank R died" to "slice S died".
+
+    A rank counts dead when the doctor names it or its exit class is
+    a hard death (``crash``/``killed``/``uncaught``/``signal:*``) --
+    survivors that left through SIGTERM evacuation (``preempted``) or
+    a typed ``peer_dead`` are messengers, not corpses, and ranks in
+    ``forced`` (SIGKILLed by the supervisor's OWN escalation) prove
+    nothing either way.  When every member of the culprit's slice --
+    or of any slice -- is dead, the verdict is
+    ``('slice', all member ranks of every fully-dead slice)``: the
+    restart policy then shrinks by whole slices in ONE decision.  Any
+    partial-slice death stays ``('rank', [culprit])`` -- a sliced
+    mesh cannot run a fractional slice, but the policy's
+    slice-aligned rounding handles that, and the ledger should not
+    claim a slice died when it did not."""
+    if not ranks_per_slice or ranks_per_slice <= 1:
+        return 'rank', ([int(culprit)] if culprit is not None else [])
+    forced = set(int(r) for r in forced)
+    dead = set(int(r) for r in doctor_dead)
+    if culprit is not None:
+        dead.add(int(culprit))
+    for r, rc in rank_rcs.items():
+        cls = failure.classify_exit(rc)
+        if int(r) in forced:
+            continue
+        if cls in _HARD_EXITS or cls.startswith('signal:'):
+            dead.add(int(r))
+    by_slice = {}
+    for r in dead:
+        by_slice.setdefault(r // ranks_per_slice, set()).add(r)
+    whole = sorted(s for s, members in by_slice.items()
+                   if len(members) >= ranks_per_slice)
+    if whole:
+        ranks = sorted(r for s in whole
+                       for r in range(s * ranks_per_slice,
+                                      (s + 1) * ranks_per_slice))
+        return 'slice', ranks
+    return 'rank', ([int(culprit)] if culprit is not None else [])
+
+
 # ----------------------------------------------------------------------
 # the append-only ledger -- shared implementation in utils/ledger.py
 # (the fleet's fleet_ledger.jsonl writes through the same class);
@@ -482,9 +561,20 @@ class Supervisor:
                  term_grace=10.0, drain_grace=5.0,
                  attempt_timeout=900.0, poll_interval=0.25,
                  oracle=True, python=None, env=None,
-                 clock=time.monotonic, sleep=time.sleep):
+                 clock=time.monotonic, sleep=time.sleep,
+                 slices=None):
         if nprocs < 1:
             raise ValueError('nprocs must be >= 1')
+        if slices is not None:
+            if slices < 1 or nprocs % slices:
+                raise ValueError(
+                    'slices must divide nprocs (%d procs, %d slices)'
+                    % (nprocs, slices))
+        self.slices = slices
+        #: ranks per failure-domain slice -- FIXED for the whole run
+        #: (an elastic shrink removes whole slices, never resizes one)
+        self.ranks_per_slice = (nprocs // slices
+                                if slices else None)
         self.nprocs = nprocs
         self.out = out
         self.worker_argv = list(worker_argv) if worker_argv else None
@@ -528,6 +618,8 @@ class Supervisor:
         self.ledger.append('start', nprocs=self.nprocs, out=self.out,
                            steps=self.steps, chaos=chaos_spec,
                            worker=(self.worker_argv or 'demo'),
+                           slices=self.slices,
+                           ranks_per_slice=self.ranks_per_slice,
                            policy=self.policy.describe())
         nprocs, attempt = self.nprocs, 0
         downtimes = []
@@ -548,17 +640,29 @@ class Supervisor:
                     mttr_s=mttr)
                 return 0
             cause, culprit, details = res['verdict']
+            granularity = 'rank'
+            dead = [culprit] if culprit is not None else []
+            if self.ranks_per_slice and self.ranks_per_slice > 1:
+                forced = [r for act, r in (res.get('escalation') or ())
+                          if act == 'sigkill']
+                granularity, dead = slice_verdict(
+                    culprit, res['rank_rcs'], self.ranks_per_slice,
+                    doctor_dead=details.get('doctor_dead_ranks') or (),
+                    forced=forced)
             self.ledger.append('failure', attempt=attempt,
                                world_size=nprocs, cause=cause,
-                               rank=culprit, **details)
-            dead = [culprit] if culprit is not None else []
-            decision = self.policy.on_failure(cause, nprocs,
-                                              dead_ranks=dead)
+                               rank=culprit, granularity=granularity,
+                               dead_ranks=dead, **details)
+            decision = self.policy.on_failure(
+                cause, nprocs, dead_ranks=dead,
+                granularity=granularity,
+                slice_size=self.ranks_per_slice or 1)
             self.ledger.append(
                 'decision', attempt=attempt, action=decision.action,
                 world_before=nprocs, world_after=decision.nprocs,
                 delay_s=round(decision.delay, 3),
                 reason=decision.reason,
+                granularity=decision.granularity,
                 restarts_used=self.policy.restarts)
             if decision.action == 'abort':
                 self.ledger.append('abort', attempt=attempt,
@@ -589,7 +693,7 @@ class Supervisor:
         # chaos/telemetry wiring
         env_base = {k: v for k, v in self._env.items()
                     if k not in ('JAX_PLATFORMS', 'XLA_FLAGS',
-                                 chaos.ENV_VAR,
+                                 chaos.ENV_VAR, chaos.SLICE_ENV_VAR,
                                  'CHAINERMN_TPU_TELEMETRY')}
         root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -605,6 +709,8 @@ class Supervisor:
             ENV_ORACLE: '1' if self.oracle else '0',
             'CHAINERMN_TPU_TELEMETRY': tdir,
         }
+        if self.ranks_per_slice:
+            common[ENV_SLICES] = str(nprocs // self.ranks_per_slice)
         if chaos_spec:
             common[chaos.ENV_VAR] = chaos_spec
         argv = self.worker_argv or [
@@ -613,6 +719,9 @@ class Supervisor:
         for r in range(nprocs):
             env = dict(env_base, **common)
             env[ENV_RANK] = str(r)
+            if self.ranks_per_slice:
+                env[chaos.SLICE_ENV_VAR] = str(
+                    r // self.ranks_per_slice)
             logf = open(os.path.join(
                 logdir, 'a%d-rank%d.log' % (attempt, r)), 'ab')
             procs[r] = subprocess.Popen(argv, env=env, stdout=logf,
@@ -873,6 +982,7 @@ def demo_worker():
     live = os.environ.get(ENV_LIVE) or os.path.join(out, 'live')
     ndev = int(os.environ.get(ENV_LOCAL_DEVICES, '2'))
     want_oracle = os.environ.get(ENV_ORACLE, '1') != '0'
+    slices = int(os.environ.get(ENV_SLICES, '0') or '0')
 
     os.environ['JAX_PLATFORMS'] = 'cpu'
     os.environ['XLA_FLAGS'] = (
@@ -892,8 +1002,15 @@ def demo_worker():
     from chainermn_tpu.training import recovery
     from chainermn_tpu.utils import chaos
 
-    comm = chainermn_tpu.create_communicator(
-        'xla', mesh_shape=(nprocs, ndev))
+    if slices > 1:
+        # multi-slice topology: the plan binds the slice axis over
+        # the SAME global devices, gradient reduction goes
+        # hierarchical (in-slice psum, cross-slice DCN reduce)
+        from chainermn_tpu.parallel.meshplan import MeshPlan
+        comm = MeshPlan.create(slices=slices).communicator()
+    else:
+        comm = chainermn_tpu.create_communicator(
+            'xla', mesh_shape=(nprocs, ndev))
     upd, batch = _build_demo_train(rank, nprocs, comm, ndev)
     res = {'rank': rank, 'attempt': attempt, 'world_size': nprocs,
            'steps': steps, 'chaos_spec': os.environ.get(chaos.ENV_VAR)}
@@ -902,7 +1019,12 @@ def demo_worker():
             rank, nprocs, comm, batch, steps, ndev)
 
     ckdir = os.path.join(out, 'state')
-    handler = recovery.PreemptionHandler(upd, out=ckdir, method='npz')
+    # async snapshots: the write happens off the step path; the
+    # wait() after each periodic checkpoint keeps the demo's
+    # deterministic resume contract (the supervisor tests assert the
+    # exact resumed step, so "checkpointed" must mean durable here)
+    handler = recovery.PreemptionHandler(upd, out=ckdir, method='npz',
+                                         async_=True)
     hb = failure.Heartbeat(
         os.path.join(live, 'heartbeat-%d.json' % rank),
         interval=0.2).start()
@@ -927,6 +1049,7 @@ def demo_worker():
             if (ckpt_every and upd.iteration < steps
                     and upd.iteration % ckpt_every == 0):
                 handler.checkpoint()
+                handler.wait()
         res['losses'] = losses
         res['final_iteration'] = upd.iteration
         res['preempted'] = preempted

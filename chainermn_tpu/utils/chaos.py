@@ -73,6 +73,28 @@ Sites (the action is part of the site name):
                     rename -- the crash-mid-write case; the final
                     file must never appear and the previous snapshot
                     must survive intact
+``ckpt_stall``      sleep ARG (default 0.5 s) BETWEEN a checkpoint's
+                    temp-file fsync and its atomic rename -- a slow
+                    or contended disk mid-commit.  Under the async
+                    checkpoint writer the stall lands on the
+                    BACKGROUND committer thread, so the training step
+                    path must stay flat (p99 pinned) while the commit
+                    completes late; under a synchronous handler the
+                    same stall lands squarely in the step time --
+                    exactly the cadence-vs-step-cost trade async
+                    checkpointing removes
+``slice_loss``      hard-kill (``os._exit(45)``) every process whose
+                    failure-domain slice (``CHAINERMN_TPU_SLICE``
+                    env; the supervisor's per-rank handout for
+                    ``MeshPlan.create(slices=)`` topologies) equals
+                    the rule ARG (default slice 0) at the start of
+                    update_core occurrence N -- a whole ICI slice
+                    dropping off the DCN at once.  Processes outside
+                    the target slice never consult the occurrence
+                    counter, so survivors record no chaos event and
+                    the supervisor must classify the correlated
+                    deaths as ONE slice-granularity failure and
+                    shrink by whole slices, never splitting one
 ``ckpt_truncate``   truncate the just-committed checkpoint file to
                     ARG (default 0.5) of its size -- torn write /
                     filesystem loss; verification must reject it
@@ -165,9 +187,24 @@ ENV_VAR = 'CHAINERMN_TPU_CHAOS'
 SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
          'nan_batch', 'sigterm_step', 'kill_step', 'hang_step',
          'kill_recv', 'ckpt_kill', 'ckpt_truncate', 'ckpt_flip',
+         'ckpt_stall', 'slice_loss',
          'serve_burst', 'serve_cancel', 'swap_kill', 'serve_slow',
          'data_stall', 'data_corrupt', 'extra_collective',
          'serve_longprompt')
+
+#: environment variable naming this process's failure-domain slice
+#: (the supervisor's per-rank handout; MeshPlan.create(slices=)
+#: builds the matching mesh axis).  ``slice_loss`` consults it.
+SLICE_ENV_VAR = 'CHAINERMN_TPU_SLICE'
+
+
+def slice_id():
+    """This process's slice index from :data:`SLICE_ENV_VAR`, or
+    None when the run declares no slice topology."""
+    v = os.environ.get(SLICE_ENV_VAR)
+    if v in (None, ''):
+        return None
+    return int(v)
 
 
 class InjectedFault(RuntimeError):
@@ -279,7 +316,7 @@ class FaultInjector:
                 telemetry.event('chaos:' + site, kind='chaos',
                                 occurrence=idx, arg=rule.arg)
                 if site in ('kill_step', 'kill_recv', 'ckpt_kill',
-                            'hang_step', 'swap_kill'):
+                            'hang_step', 'swap_kill', 'slice_loss'):
                     # os._exit skips atexit: flush the timeline AND
                     # drop the crash-safe flight record NOW, or the
                     # fatal injection is invisible post-mortem
@@ -430,6 +467,16 @@ def on_step(iteration):
     r = inj.fires('hang_step')
     if r is not None:
         time.sleep(r.arg if r.arg is not None else 3600.0)
+    # slice_loss: membership gate BEFORE the occurrence counter --
+    # survivors outside the target slice must not advance it (their
+    # step cadence may differ post-shrink) and must record no chaos
+    # event, so the post-mortem sees correlated deaths only on the
+    # lost slice.
+    rule = inj.rules.get('slice_loss')
+    if rule is not None:
+        target = int(rule.arg) if rule.arg is not None else 0
+        if slice_id() == target and inj.fires('slice_loss') is not None:
+            os._exit(45)
 
 
 def on_checkpoint_write(tmp_path):
@@ -444,6 +491,14 @@ def on_checkpoint_write(tmp_path):
     r = inj.fires('ckpt_kill')
     if r is not None:
         os._exit(int(r.arg) if r.arg is not None else 43)
+    # ckpt_stall: a slow/contended disk mid-commit.  Landing between
+    # fsync and rename means the stalled snapshot is invisible to
+    # chain_heads()/CheckpointWatcher for the whole stall -- and under
+    # the async writer the sleep is on the background committer, so
+    # the step path must not feel it.
+    r = inj.fires('ckpt_stall')
+    if r is not None:
+        time.sleep(r.arg if r.arg is not None else 0.5)
     del tmp_path  # reserved for future partial-write faults
 
 

@@ -92,6 +92,54 @@ python -m pytest tests/test_multiprocess.py -q --runslow -k 'protocol'
 echo "=== supervisor leg: kill->shrink->resume, hang->escalation, crash-loop abort ==="
 python -m pytest tests/test_supervisor_mp.py -q --runslow
 
+# SLICE-LOSS GOODPUT LEG (ISSUE 18 acceptance): slice-level failure
+# domains + async checkpointing + the unified goodput report, end to
+# end over real jax.distributed CPU procs.  4 workers run as 2
+# slices of 2 (--slices 2; each rank's CHAINERMN_TPU_SLICE names its
+# domain); chaos slice_loss hard-kills EVERY rank of slice 1
+# mid-train.  The supervisor must classify the whole-slice death
+# (granularity=slice, both member ranks named, counted as ONE
+# failure), shrink by the whole slice 4 -> 2 -- never splitting one
+# -- resume from the async npz checkpoint, and complete.  Then
+# `telemetry goodput` joins the ledger with every attempt's capture:
+# the decomposition must sum to the wall clock (+-1%), bank a
+# NONZERO restart-downtime bucket, and keep goodput_fraction inside
+# (0, 1) and above the chaos floor.  See docs/fault_tolerance.md
+# ("Goodput").
+echo "=== slice-loss goodput leg: 2x2 slices, whole-slice kill -> shrink -> goodput report ==="
+SLICE_DIR=$(mktemp -d /tmp/slice_goodput.XXXXXX)
+CHAINERMN_TPU_CHAOS='slice_loss=@2:1' \
+  python -m chainermn_tpu.supervisor -n 4 --slices 2 \
+  --out "${SLICE_DIR}" --steps 6 --ckpt-every 2 --local-devices 2 \
+  --stall-timeout 30 --startup-grace 120 --attempt-timeout 420 \
+  --no-oracle
+python -m chainermn_tpu.telemetry goodput "${SLICE_DIR}" --floor 0.02
+python - "${SLICE_DIR}" <<'PY'
+import json, sys
+d = sys.argv[1]
+ledger = [json.loads(l) for l in open(d + '/supervisor_ledger.jsonl')]
+fails = [e for e in ledger if e['event'] == 'failure']
+assert len(fails) == 1, [e['event'] for e in ledger]
+assert fails[0]['granularity'] == 'slice', fails[0]
+assert sorted(fails[0]['dead_ranks']) == [2, 3], fails[0]
+dec = [e for e in ledger if e['event'] == 'decision'][0]
+assert dec['action'] == 'shrink' and dec['granularity'] == 'slice', dec
+assert (dec['world_before'], dec['world_after']) == (4, 2), dec
+assert any(e['event'] == 'complete' for e in ledger), \
+    [e['event'] for e in ledger]
+gp = json.load(open(d + '/goodput_report.json'))
+assert 0.0 < gp['goodput_fraction'] < 1.0, gp['goodput_fraction']
+assert gp['buckets_s']['restart_downtime'] > 0.0, gp['buckets_s']
+total = sum(gp['buckets_s'].values())
+assert abs(total - gp['wall_s']) <= 0.01 * gp['wall_s'], \
+    (total, gp['wall_s'])
+print('slice goodput OK: fraction=%.4f, downtime=%.3fs of %.3fs '
+      'wall, slice shrink 4->2'
+      % (gp['goodput_fraction'],
+         gp['buckets_s']['restart_downtime'], gp['wall_s']))
+PY
+rm -rf "${SLICE_DIR}"
+
 # TELEMETRY SMOKE LEG (ISSUE 6): capture -> merge -> report on the
 # mnist example.  The env var is the ONLY switch (zero-cost-off
 # contract): the run records step phases, collective/trace marks and

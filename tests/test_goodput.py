@@ -1,0 +1,233 @@
+"""The unified goodput report (ISSUE 18): wall-clock decomposition
+over synthetic ledgers + captures with hand-computable buckets.
+
+The load-bearing property is the EXACT-SUM contract: the buckets are
+computed by interval subtraction against a running covered union, so
+they are disjoint by construction and sum to the wall clock to float
+precision -- every scenario here asserts it.  The end-to-end proof
+over a real chaos run is the ci/run_matrix.sh slice-loss goodput leg.
+"""
+
+import json
+import os
+
+import pytest
+
+from chainermn_tpu.telemetry import goodput
+from chainermn_tpu.telemetry.__main__ import main as telemetry_main
+
+
+# ---------------------------------------------------------------------
+# interval helpers
+
+class TestIntervals:
+    def test_subtract_disjoint(self):
+        assert goodput.subtract_intervals(
+            [(0.0, 10.0)], [(2.0, 4.0), (6.0, 7.0)]) == \
+            [(0.0, 2.0), (4.0, 6.0), (7.0, 10.0)]
+
+    def test_subtract_total_cover(self):
+        assert goodput.subtract_intervals(
+            [(2.0, 4.0)], [(0.0, 10.0)]) == []
+
+    def test_subtract_no_overlap(self):
+        assert goodput.subtract_intervals(
+            [(0.0, 1.0)], [(5.0, 6.0)]) == [(0.0, 1.0)]
+
+    def test_clip(self):
+        assert goodput.clip_intervals(
+            [(0.0, 5.0), (8.0, 12.0), (20.0, 30.0)], 4.0, 10.0) == \
+            [(4.0, 5.0), (8.0, 10.0)]
+
+
+# ---------------------------------------------------------------------
+# synthetic run fixture
+
+def _span(name, kind, t0, t1, rank=0, **attrs):
+    d = {'type': 'span', 'name': name, 'kind': kind, 'rank': rank,
+         't0': t0, 't1': t1}
+    d.update(attrs)
+    return d
+
+
+def _write_capture(cap, records):
+    os.makedirs(cap, exist_ok=True)
+    with open(os.path.join(cap, 'events-rank0.jsonl'), 'w') as f:
+        for rec in records:
+            f.write(json.dumps(rec) + '\n')
+
+
+def _write_ledger(out, events):
+    with open(os.path.join(out, 'supervisor_ledger.jsonl'),
+              'w') as f:
+        for ev in events:
+            f.write(json.dumps(ev) + '\n')
+
+
+@pytest.fixture
+def chaos_run(tmp_path):
+    """A hand-built supervised run: 100 s wall, one failure, one
+    recovery, every bucket nonzero and hand-computable."""
+    out = str(tmp_path / 'run')
+    os.makedirs(out)
+    _write_ledger(out, [
+        {'event': 'start', 't': 1000.0, 'nprocs': 4},
+        {'event': 'launch', 't': 1000.5, 'attempt': 0},
+        {'event': 'failure', 't': 1045.0, 'attempt': 0,
+         'cause': 'killed', 'granularity': 'slice',
+         'dead_ranks': [2, 3]},
+        {'event': 'decision', 't': 1045.1, 'attempt': 0,
+         'action': 'shrink', 'granularity': 'slice',
+         'world_before': 4, 'world_after': 2},
+        {'event': 'launch', 't': 1046.0, 'attempt': 1},
+        {'event': 'recovered', 't': 1079.0, 'attempt': 1,
+         'downtime_s': 30.0},
+        {'event': 'complete', 't': 1100.0, 'attempt': 1,
+         'mttr_s': 30.0},
+    ])
+    _write_capture(os.path.join(out, 'telemetry', 'a0'), [
+        _span('host_batch_prep', 'host', 1005.0, 1010.0,
+              iteration=0),
+        _span('jitted_step', 'compute', 1010.0, 1020.0, iteration=0),
+        _span('allreduce', 'collective', 1018.0, 1028.0),
+        _span('checkpoint_write', 'checkpoint', 1028.0, 1033.0),
+        _span('checkpoint_write', 'checkpoint', 1033.0, 1043.0,
+              background=True),
+    ])
+    _write_capture(os.path.join(out, 'telemetry', 'a1'), [
+        _span('jitted_step', 'compute', 1070.0, 1074.0, iteration=1),
+        _span('jitted_step', 'compute', 1074.0, 1078.0, iteration=2),
+    ])
+    return out
+
+
+class TestBuildGoodput:
+    def test_bucket_decomposition(self, chaos_run):
+        gp = goodput.build_goodput(chaos_run)
+        assert gp['wall_s'] == 100.0
+        assert gp['window']['terminal'] == 'complete'
+        b = gp['buckets_s']
+        # steps: [1010,1020] + [1070,1078] = 18 s useful
+        assert b['useful_step'] == pytest.approx(18.0)
+        assert b['bubble'] == 0.0
+        # collective [1018,1028]: 2 s hidden behind the step, 8
+        # exposed
+        assert b['exposed_collective'] == pytest.approx(8.0)
+        # sync checkpoint write [1028,1033] fully exposed; the
+        # background span is NOT charged
+        assert b['checkpoint'] == pytest.approx(5.0)
+        assert gp['hidden_checkpoint_s'] == pytest.approx(10.0)
+        # input prep [1005,1010] fully exposed
+        assert b['input_bound'] == pytest.approx(5.0)
+        # downtime window anchored at its END = the recovered
+        # attempt's first completed step (t1=1074): [1044,1074],
+        # minus the [1070,1074] step overlap = 26 charged
+        assert b['restart_downtime'] == pytest.approx(26.0)
+        assert b['other'] == pytest.approx(
+            100.0 - (18 + 8 + 5 + 5 + 26))
+        assert gp['goodput_fraction'] == pytest.approx(0.18)
+
+    def test_buckets_sum_to_wall_exactly(self, chaos_run):
+        gp = goodput.build_goodput(chaos_run)
+        assert sum(gp['buckets_s'].values()) == pytest.approx(
+            gp['wall_s'], abs=1e-5)
+        fr = gp['buckets_fraction']
+        assert sum(fr.values()) == pytest.approx(1.0, abs=1e-5)
+        assert set(gp['buckets_s']) == set(goodput.BUCKETS)
+
+    def test_ledger_summary(self, chaos_run):
+        gp = goodput.build_goodput(chaos_run)
+        led = gp['ledger']
+        assert led['failures'] == 1
+        assert led['shrinks'] == 1
+        assert led['slice_shrinks'] == 1
+        assert led['restart_downtime_s'] == pytest.approx(30.0)
+        assert led['mttr_s'] == pytest.approx(30.0)
+        assert gp['n_steps'] == 3
+        assert len(gp['attempts']) == 2
+
+    def test_bare_capture_without_ledger(self, tmp_path):
+        # a plain telemetry dir: wall = span extent, no downtime
+        cap = str(tmp_path / 'cap')
+        _write_capture(cap, [
+            _span('jitted_step', 'compute', 10.0, 14.0),
+            _span('jitted_step', 'compute', 14.0, 18.0),
+            _span('checkpoint_write', 'checkpoint', 18.0, 20.0),
+        ])
+        gp = goodput.build_goodput(cap)
+        assert gp['wall_s'] == pytest.approx(10.0)
+        assert gp['ledger'] is None
+        assert gp['buckets_s']['useful_step'] == pytest.approx(8.0)
+        assert gp['buckets_s']['checkpoint'] == pytest.approx(2.0)
+        assert gp['buckets_s']['restart_downtime'] == 0.0
+        assert gp['goodput_fraction'] == pytest.approx(0.8)
+
+    def test_pipeline_bubble_split(self, tmp_path):
+        from chainermn_tpu.parallel.pipeline import (
+            bubble_fractions_per_stage)
+        cap = str(tmp_path / 'cap')
+        _write_capture(cap, [
+            _span('jitted_step', 'compute', 0.0, 10.0),
+            {'type': 'event', 'name': 'pipeline:schedule',
+             'kind': 'pipeline', 't': 0.0, 'schedule': '1f1b',
+             'n_micro': 2, 'n_stages': 2, 'total_ticks': 4},
+        ])
+        bf = bubble_fractions_per_stage(2, 2, '1f1b')[0]
+        assert bf > 0.0
+        gp = goodput.build_goodput(cap)
+        b = gp['buckets_s']
+        assert b['bubble'] == pytest.approx(10.0 * bf, rel=1e-4)
+        assert b['useful_step'] == pytest.approx(10.0 * (1 - bf),
+                                                 rel=1e-4)
+        assert b['useful_step'] + b['bubble'] == pytest.approx(10.0)
+
+    def test_empty_dir_is_empty_capture(self, tmp_path):
+        gp = goodput.build_goodput(str(tmp_path))
+        assert gp['wall_s'] is None
+
+    def test_export_writes_report(self, chaos_run):
+        goodput.export(chaos_run)
+        with open(os.path.join(chaos_run,
+                               'goodput_report.json')) as f:
+            gp = json.load(f)
+        assert gp['goodput_fraction'] == pytest.approx(0.18)
+
+
+# ---------------------------------------------------------------------
+# CLI contract
+
+class TestGoodputCli:
+    def test_report_and_floor_pass(self, chaos_run, capsys):
+        rc = telemetry_main(['goodput', chaos_run, '--floor', '0.1'])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert 'GOODPUT FRACTION: 0.1800' in out
+        assert 'restart_downtime' in out
+        assert os.path.exists(
+            os.path.join(chaos_run, 'goodput_report.json'))
+
+    def test_floor_breach_exits_1(self, chaos_run, capsys):
+        rc = telemetry_main(['goodput', chaos_run, '--floor', '0.5'])
+        assert rc == 1
+        assert 'BELOW floor' in capsys.readouterr().err
+
+    def test_json_mode(self, chaos_run, capsys):
+        rc = telemetry_main(['goodput', chaos_run, '--json',
+                             '--no-export'])
+        assert rc == 0
+        gp = json.loads(capsys.readouterr().out)
+        assert gp['goodput_fraction'] == pytest.approx(0.18)
+        assert not os.path.exists(
+            os.path.join(chaos_run, 'goodput_report.json'))
+
+    def test_empty_capture_exits_2(self, tmp_path, capsys):
+        empty = str(tmp_path / 'nothing')
+        os.makedirs(empty)
+        rc = telemetry_main(['goodput', empty])
+        assert rc == 2
+        assert 'EMPTY' in capsys.readouterr().err
+
+    def test_missing_dir_exits_2(self, tmp_path, capsys):
+        rc = telemetry_main(['goodput',
+                             str(tmp_path / 'does-not-exist')])
+        assert rc == 2

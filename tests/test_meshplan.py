@@ -505,3 +505,101 @@ def test_divisor_leq():
     assert mesh_utility.divisor_leq(1, 4) == 1   # one device: (1, 1)
     with pytest.raises(ValueError):
         mesh_utility.divisor_leq(0, 1)
+
+
+# ---------------------------------------------------------------------
+# slice failure domains (ISSUE 18): the slice axis above the mesh +
+# hierarchical gradient reduction
+
+class TestSlices:
+    def test_slice_axis_is_major(self):
+        plan = MeshPlan.create(slices=2)
+        assert plan.axis_names == ('slice', 'data', 'model')
+        assert plan.slice_axis == 'slice'
+        assert plan.slice_size == 2
+        # the slice level sits ABOVE data: batch sharding, ZeRO and
+        # reduction all span (slice, data)
+        assert plan.data_axes == ('slice', 'data')
+        assert plan.data_size == jax.device_count()
+        assert plan.batch_spec() == P(('slice', 'data'))
+
+    def test_slices_compose_with_tp_and_pp(self):
+        plan = MeshPlan.create(slices=2, tp=2)
+        assert plan.axis_names == ('slice', 'data', 'model')
+        assert (plan.slice_size, plan.data_size,
+                plan.model_size) == (2, 4, 2)
+        plan3 = MeshPlan.create(slices=2, tp=2, pp=2)
+        assert plan3.axis_names == ('slice', 'data', 'model', 'pipe')
+        assert (plan3.slice_size, plan3.data_size, plan3.model_size,
+                plan3.pipe_size) == (2, 2, 2, 2)
+
+    def test_slice_clamping_has_top_priority(self):
+        # 8 devices: slices=3 clamps to 2 (a slice boundary is
+        # physical, so it clamps FIRST), request recorded
+        plan = MeshPlan.create(slices=3)
+        assert plan.slice_size == 2
+        assert plan.requested_slices == 3
+        d = plan.describe()
+        assert d['effective_slices'] == 2
+        assert d['requested_slices'] == 3
+        assert d['slice_axis'] == 'slice'
+
+    def test_one_slice_plan_keeps_axis(self):
+        plan = MeshPlan.create(slices=1)
+        assert plan.axis_names == ('slice', 'data', 'model')
+        assert plan.slice_size == 1
+        assert plan.data_size == jax.device_count()
+
+    def test_sliceless_plan_unchanged(self):
+        plan = MeshPlan.create(tp=2)
+        assert plan.slice_axis is None
+        assert plan.slice_size == 1
+        assert 'slice_axis' not in plan.describe()
+
+    def test_slices_with_ep_not_implemented(self):
+        with pytest.raises(NotImplementedError):
+            MeshPlan.create(ep=2, slices=2)
+
+    def test_hierarchical_reduce_matches_flat_mean(self):
+        # the staged (in-slice psum, cross-slice psum, / data_size)
+        # reduction must equal the flat pmean over all data axes --
+        # per-device contributions chosen distinct so any missed or
+        # double-counted device changes the answer
+        plan = MeshPlan.create(slices=2, tp=2)
+        comm = plan.communicator()
+        n_data = plan.data_size
+
+        def f(x):
+            v = (x + comm.axis_rank().astype(jnp.float32)
+                 + 100.0 * comm.model_rank().astype(jnp.float32))
+            return comm.allreduce_grad({'g': v})['g']
+
+        out = jax.jit(jax.shard_map(
+            f, mesh=plan.mesh, in_specs=P(),
+            out_specs=P(('slice', 'data'), 'model'),
+            check_vma=False))(jnp.zeros((1, 1)))
+        got = np.asarray(out).reshape(n_data, 2)
+        # data-mean of ranks 0..n-1 per model column, model kept
+        want = sum(range(n_data)) / n_data
+        np.testing.assert_allclose(got[:, 0], want, rtol=1e-6)
+        np.testing.assert_allclose(got[:, 1], want + 100.0,
+                                   rtol=1e-6)
+
+    def test_slice_reduction_axes_cover_both_levels(self):
+        plan = MeshPlan.create(slices=2)
+        comm = plan.communicator()
+        assert comm.data_axes == ('slice', 'data')
+        assert comm.size == plan.data_size
+
+    def test_slice_step_target_lints_clean(self):
+        # the shardlint target threads staged_axes so SL011's
+        # cross-axis-chain rule recognizes the deliberate two-stage
+        # reduction; without the declaration the same jaxpr fires
+        from chainermn_tpu.analysis import runner, targets
+        t = targets.mlp_slice_step_target(slices=2)
+        assert t.staged_axes == ('slice',)
+        findings = runner.lint_target(t)
+        assert [f for f in findings if f.rule_id == 'SL011'] == []
+        t.staged_axes = None
+        noisy = runner.lint_target(t)
+        assert [f for f in noisy if f.rule_id == 'SL011']

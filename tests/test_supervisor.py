@@ -440,3 +440,114 @@ class TestChaosSupervisorSites:
         assert chaos.strip_sites(spec, ['nope']) == spec
         # the stripped spec still parses
         chaos.parse_spec(out)
+
+
+# ----------------------------------------------------------------------
+# slice failure domains (ISSUE 18): verdict escalation + slice-aligned
+# policy decisions
+# ----------------------------------------------------------------------
+
+class TestSliceVerdict:
+    def test_unsliced_topology_stays_rank(self):
+        assert sup.slice_verdict(2, {2: 70}, None) == ('rank', [2])
+        assert sup.slice_verdict(2, {2: 70}, 1) == ('rank', [2])
+        assert sup.slice_verdict(None, {}, None) == ('rank', [])
+
+    def test_whole_slice_dead_escalates(self):
+        # 4 ranks as 2x2 slices: both members of slice 1 exit hard
+        rcs = {0: failure.EXIT_PREEMPTED, 1: failure.EXIT_PREEMPTED,
+               2: 45, 3: 45}
+        assert sup.slice_verdict(3, rcs, 2) == ('slice', [2, 3])
+
+    def test_partial_slice_death_stays_rank(self):
+        # rank 3 died hard, its slice-mate evacuated (preempted):
+        # messengers are not corpses, the slice did NOT die
+        rcs = {0: failure.EXIT_PREEMPTED, 1: failure.EXIT_PREEMPTED,
+               2: failure.EXIT_PREEMPTED, 3: 45}
+        assert sup.slice_verdict(3, rcs, 2) == ('rank', [3])
+
+    def test_signal_exits_count_as_hard_deaths(self):
+        rcs = {0: 0, 1: 0, 2: -9, 3: -11}  # SIGKILL + SIGSEGV
+        assert sup.slice_verdict(2, rcs, 2) == ('slice', [2, 3])
+
+    def test_escalation_sigkill_is_not_evidence(self):
+        # the supervisor SIGKILLed rank 2 itself (hang escalation):
+        # its -9 proves nothing, so slice 1 is only half-dead
+        rcs = {0: 0, 1: 0, 2: -9, 3: 45}
+        assert sup.slice_verdict(
+            3, rcs, 2, forced=[2]) == ('rank', [3])
+
+    def test_doctor_dead_ranks_complete_the_slice(self):
+        # rank 2's corpse left no exit code evidence (clean-looking
+        # rc) but the doctor's flight record names it dead
+        rcs = {0: failure.EXIT_PREEMPTED, 1: failure.EXIT_PREEMPTED,
+               2: 0, 3: 45}
+        assert sup.slice_verdict(
+            3, rcs, 2, doctor_dead=[2]) == ('slice', [2, 3])
+
+    def test_multiple_dead_slices_all_named(self):
+        rcs = {0: 45, 1: 45, 2: 45, 3: 45}
+        assert sup.slice_verdict(0, rcs, 2) == ('slice', [0, 1, 2, 3])
+
+
+class TestSlicePolicy:
+    def _policy(self, clock, **kw):
+        kw.setdefault('backoff', failure.Backoff(
+            initial=0.5, factor=2.0, max_delay=8.0))
+        return sup.RestartPolicy(clock=clock, **kw)
+
+    def test_decision_granularity_defaults_to_rank(self):
+        d = sup.Decision('restart', 4, 0.5, 'why')
+        assert d.granularity == 'rank'
+
+    def test_slice_loss_is_one_crash_loop_failure(self):
+        # a whole slice (2 ranks) dying is ONE incident: with
+        # threshold 3, two slice losses must NOT abort
+        clock = FakeClock()
+        p = self._policy(clock, max_restarts=8, crash_window=300.0,
+                         crash_threshold=3)
+        d1 = p.on_failure('killed', 4, dead_ranks=[2, 3],
+                          granularity='slice', slice_size=2)
+        assert d1.action == 'shrink'
+        d2 = p.on_failure('killed', 2, dead_ranks=[0, 1],
+                          granularity='slice', slice_size=2)
+        assert d2.action != 'abort'
+        d3 = p.on_failure('crash', 2, dead_ranks=[0],
+                          granularity='rank', slice_size=2)
+        assert d3.action == 'abort'
+        assert 'crash_loop' in d3.reason
+
+    def test_shrink_by_whole_slice(self):
+        clock = FakeClock()
+        p = self._policy(clock)
+        d = p.on_failure('killed', 4, dead_ranks=[2, 3],
+                         granularity='slice', slice_size=2)
+        assert (d.action, d.nprocs) == ('shrink', 2)
+        assert d.granularity == 'slice'
+        assert 'slice' in d.reason
+
+    def test_shrink_never_splits_a_slice(self):
+        # one rank of a 2-wide slice died (partial death): 4 - 1 = 3
+        # rounds DOWN to the slice multiple 2
+        clock = FakeClock()
+        p = self._policy(clock)
+        d = p.on_failure('crash', 4, dead_ranks=[3],
+                         granularity='rank', slice_size=2)
+        assert (d.action, d.nprocs) == ('shrink', 2)
+        assert d.granularity == 'rank'
+
+    def test_slice_rounding_respects_min_procs(self):
+        # rounding to the slice multiple would land below min_procs:
+        # plain restart at the full width instead
+        clock = FakeClock()
+        p = self._policy(clock, min_procs=2)
+        d = p.on_failure('crash', 2, dead_ranks=[1],
+                         granularity='rank', slice_size=2)
+        assert d.action == 'restart'
+        assert d.nprocs == 2
+
+    def test_chaos_slice_loss_is_terminal_site(self):
+        # classify_failure must treat a flight-recorded slice_loss
+        # like the other chaos kill sites: the doctor's site evidence
+        # refines the exit-code verdict instead of contradicting it
+        assert 'slice_loss' in chaos.SITES

@@ -14,7 +14,12 @@ invocation per scenario -- no manual relaunch anywhere:
 - a chaos ``hang_step`` wedge (heartbeat time fresh, iteration
   frozen): the progress watch catches it, escalation runs SIGTERM ->
   grace -> SIGKILL, the doctor's chaos-event history names the wedged
-  rank, and the pod comes back smaller and finishes.
+  rank, and the pod comes back smaller and finishes;
+- a chaos ``slice_loss`` whole-slice kill at 2x2 slices (ISSUE 18):
+  classified at slice granularity as ONE failure, shrunk by the whole
+  slice 4 -> 2, resumed, completed -- and the unified goodput report
+  over the same out dir decomposes the wall clock with a nonzero
+  restart-downtime bucket that sums with the rest to the wall.
 
 The fast policy units (no subprocesses) are in
 ``tests/test_supervisor.py``; ``ci/run_matrix.sh`` runs this file in
@@ -198,3 +203,61 @@ def test_hang_escalated_culprit_named_and_pod_shrinks(tmp_path):
     np.testing.assert_allclose(res['losses'], res['oracle'][2:],
                                rtol=0, atol=1e-5)
     assert abs(res['param_sum'] - res['oracle_param_sum']) < 1e-4
+
+
+@pytest.mark.slow
+def test_slice_loss_shrinks_whole_slice_and_goodput_decomposes(tmp_path):
+    """ISSUE 18 acceptance (the pytest twin of the ci/run_matrix.sh
+    slice-loss goodput leg): 4 procs as 2 slices of 2, chaos
+    ``slice_loss=@2:1`` hard-kills BOTH ranks of slice 1 mid-train.
+    One supervisor invocation classifies the whole-slice death at
+    slice granularity (one failure, both member ranks named), shrinks
+    by the whole slice 4 -> 2 -- never splitting one -- resumes from
+    the periodic async checkpoint and completes.  The goodput report
+    over the same out dir then decomposes the wall clock: nonzero
+    restart downtime, buckets summing to the wall, and a fraction
+    strictly inside (0, 1)."""
+    out = tmp_path / 'run'
+    proc, ledger = _run_supervisor(
+        out, ['-n', '4', '--slices', '2', '--local-devices', '2',
+              '--ckpt-every', '2', '--stall-timeout', '30',
+              '--no-oracle'],
+        chaos='slice_loss=@2:1')
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    # CLASSIFY: the whole-slice death is ONE failure at slice
+    # granularity naming every member rank of the dead slice
+    fails = _events(ledger, 'failure')
+    assert len(fails) == 1, fails
+    f = fails[0]
+    assert f['granularity'] == 'slice'
+    assert sorted(f['dead_ranks']) == [2, 3]
+    assert f['world_size'] == 4
+
+    # DECIDE: shrink by the whole slice, never splitting one
+    decs = _events(ledger, 'decision')
+    assert len(decs) == 1
+    assert decs[0]['action'] == 'shrink'
+    assert decs[0]['granularity'] == 'slice'
+    assert (decs[0]['world_before'], decs[0]['world_after']) == (4, 2)
+
+    # RESUME + COMPLETE at 2 procs, downtime measured
+    recs = _events(ledger, 'recovered')
+    assert len(recs) == 1
+    assert recs[0]['downtime_s'] > 0
+    comp = _events(ledger, 'complete')
+    assert len(comp) == 1
+    assert comp[0]['world_size'] == 2
+
+    # GOODPUT: the unified report over the same out dir
+    from chainermn_tpu.telemetry.goodput import build_goodput
+    gp = build_goodput(str(out))
+    assert gp['wall_s'] is not None
+    assert 0.0 < gp['goodput_fraction'] < 1.0
+    b = gp['buckets_s']
+    assert b['restart_downtime'] > 0.0
+    assert sum(b.values()) == pytest.approx(gp['wall_s'],
+                                            rel=0.01)
+    assert gp['ledger']['failures'] == 1
+    assert gp['ledger']['slice_shrinks'] == 1
+    assert len(gp['attempts']) == 2  # a0 + the recovered a1
