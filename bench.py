@@ -2225,7 +2225,8 @@ GENERATE_SIDECAR_KEYS = (
     'tokens_per_s', 'ttft_p50_ms', 'ttft_p99_ms',
     'intertoken_p50_ms', 'intertoken_p99_ms', 'shed_fraction',
     'capacity_tok_per_s', 'slo_verdict', 'prefix_hit_rate',
-    'pages_per_request', 'kv_bytes_per_token')
+    'pages_per_request', 'kv_bytes_per_token',
+    'accepted_draft_rate', 'verify_per_token')
 
 #: fleet-row sidecars (--serve --fleet): the deployment regime's
 #: vocabulary -- swap downtime, swap-attributable drops (the zero
@@ -2563,6 +2564,8 @@ def generate_family(argv):
         name += '_paged'
     if '--int8-kv' in argv:
         name += '_int8kv'
+    if '--speculative' in argv:
+        name += '_spec'
     return name
 
 
@@ -2573,7 +2576,12 @@ def measure_generate(argv):
     Builds a ``TransformerLM`` :class:`~chainermn_tpu.serving.
     GenerationEngine` (prefill bucketed by prompt length, decode by
     active-slot count, AOT over the persistent cache; ``--int8-kv``
-    stores the KV cache int8), probes steady-state decode capacity at
+    stores the KV cache int8; ``--speculative`` adds a half-depth
+    draft model proposing ``--spec-tokens`` per tick with the target
+    verifying in one pass -- an in-bench probe asserts exact greedy
+    equivalence vs a non-speculative oracle twin, and the
+    ``accepted_draft_rate`` / ``verify_per_token`` sidecars carry the
+    amortization), probes steady-state decode capacity at
     full occupancy, then offers an OPEN-loop prompt stream above
     capacity so continuous batching and typed shedding are both in
     the measurement.  Row value = generated tokens/s/chip; TTFT and
@@ -2600,9 +2608,12 @@ def measure_generate(argv):
     int8_kv = '--int8-kv' in argv
     paged = '--paged' in argv
     prefill_chunk = _flag_value(argv, '--prefill-chunk', None, int)
+    speculative = '--speculative' in argv
+    spec_tokens = int(_flag_value(argv, '--spec-tokens', 4, int))
     _log('generate: backend=%s n_dev=%d int8_kv=%s paged=%s '
-         'prefill_chunk=%s' % (jax.default_backend(), n_dev, int8_kv,
-                               paged, prefill_chunk))
+         'prefill_chunk=%s speculative=%s'
+         % (jax.default_backend(), n_dev, int8_kv, paged,
+            prefill_chunk, speculative))
 
     import jax.numpy as jnp
 
@@ -2637,15 +2648,71 @@ def measure_generate(argv):
                         page_size=int(_flag_value(
                             argv, '--page-size', 16, int)),
                         prefill_chunk=prefill_chunk)
+    spec_kw = {}
+    if speculative:
+        # the draft: same vocab (hard requirement -- the accept rule
+        # compares token ids), a fraction of the target's depth; its
+        # own params from a DIFFERENT seed, so acceptance is earned,
+        # never an artifact of identical weights
+        draft = TransformerLM(
+            vocab_size=model.vocab_size, d_model=model.d_model,
+            n_heads=model.n_heads,
+            n_layers=max(1, model.n_layers // 2),
+            d_ff=model.d_ff, max_len=model.max_len,
+            dtype=model.dtype)
+        draft_params = init_on_host(
+            lambda *a: draft.init(*a)['params'],
+            jax.random.PRNGKey(7), jnp.zeros((1, 8), jnp.int32))
+        spec_kw = dict(draft_model=draft, draft_params=draft_params,
+                       spec_tokens=spec_tokens)
     engine = serving.GenerationEngine(
         model, params, n_slots=n_slots, max_prompt_len=max_prompt,
-        policy=policy, int8_kv=int8_kv, cache_dir=cache, **paged_kw)
+        policy=policy, int8_kv=int8_kv, cache_dir=cache,
+        **paged_kw, **spec_kw)
     _log('generate: warmup over prefill buckets %s + decode buckets '
          '%s' % (list(engine.prefill_edges),
                  list(engine.decode_edges)))
     t0 = time.perf_counter()
     aot_map = engine.warmup()
     warmup_s = time.perf_counter() - t0
+
+    # the speculative correctness pin, measured IN the bench so the
+    # CI smoke leg asserts it off the row: the same prompt set drained
+    # through the speculative engine and a non-speculative oracle
+    # twin must produce token-for-token identical outputs (exact
+    # greedy equivalence, not a similarity bound)
+    spec_equivalent = None
+    if speculative:
+        oracle = serving.GenerationEngine(
+            model, params, n_slots=n_slots, max_prompt_len=max_prompt,
+            policy=policy, int8_kv=int8_kv, cache_dir=cache,
+            **paged_kw)
+        oracle.warmup()
+        eq_rng = np.random.RandomState(3)
+        eq_prompts = [eq_rng.randint(0, model.vocab_size,
+                                     size=int(n)).astype(np.int32)
+                      for n in eq_rng.randint(4, max_prompt + 1,
+                                              size=2 * n_slots)]
+
+        def _drain_probe(eng):
+            q = serving.GenerationQueue(
+                max_prompt_len=max_prompt, max_queue=4 * n_slots,
+                page_size=eng.page_size if paged else None)
+            reqs = [q.submit(p, max_new) for p in eq_prompts]
+            deadline = time.perf_counter() + 300.0
+            while not all(r.done() for r in reqs):
+                eng.step(q)
+                if time.perf_counter() > deadline:
+                    break
+            return [list(r.result(timeout=1.0)) for r in reqs]
+
+        spec_out = _drain_probe(engine)
+        oracle_out = _drain_probe(oracle)
+        spec_equivalent = bool(spec_out == oracle_out)
+        _log('generate: speculative equivalence probe over %d '
+             'prompts: %s' % (len(eq_prompts),
+                              'EXACT' if spec_equivalent
+                              else 'MISMATCH'))
 
     # capacity probe: saturate every slot once (arrivals effectively
     # instantaneous, queue sized to hold them all) and read the
@@ -2758,12 +2825,21 @@ def measure_generate(argv):
         prefix_hit_rate=prefix_hit_rate,
         pages_per_request=pages_per_request,
         kv_bytes_per_token=kv_bytes,
+        speculative=rep.get('speculative'),
+        accepted_draft_rate=(rep.get('speculative') or {}).get(
+            'accepted_draft_rate'),
+        verify_per_token=(rep.get('speculative') or {}).get(
+            'verify_per_token'),
+        spec_equivalent=spec_equivalent,
         policy={'compute': str(policy.compute_dtype)}
         if policy is not None else None,
     )
+    ok = bool(rep['served']) and spec_equivalent is not False
     if rep['served'] == 0:
         row['error'] = 'generate_no_completions'
-    emit(row, rc=0 if rep['served'] else 1)
+    elif spec_equivalent is False:
+        row['error'] = 'speculative_mismatch'
+    emit(row, rc=0 if ok else 1)
 
 
 def main():

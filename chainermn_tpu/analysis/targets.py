@@ -603,6 +603,57 @@ def decode_forward_target(policy=None, tp=2, bucket=None):
         make_args=lambda it: engine.traceable_decode(bucket)[1])
 
 
+def spec_verify_forward_target(policy=None, tp=2, bucket=None,
+                               spec_tokens=4):
+    """The speculative-decoding TARGET VERIFY pass over the MeshPlan
+    (``docs/serving.md``, "Speculative decoding"): the k-token
+    ``spec_verify`` executable a speculative
+    :class:`chainermn_tpu.serving.GenerationEngine` compiles -- one
+    batched pass scoring every draft-proposed position against the
+    tensor-parallel target's KV cache, traced at the full-slot bucket.
+
+    Same collective story as ``step:decode_forward`` (the tp psums
+    are the only collectives; ``plan_axes=('model',)``), but with
+    ``spec_tokens`` query rows per slot flowing through the
+    ``flash_attention_chunk`` window shape.  ``make_args`` is
+    iteration-independent: the verify executable's shape depends on
+    (bucket, spec_tokens), never the step or the acceptance history --
+    the SL007 static twin of the runtime guarantee that rollback and
+    variable per-tick commit counts never retrace."""
+    from chainermn_tpu.models import (TransformerLM, tp_oracle,
+                                      tp_param_specs)
+    from chainermn_tpu.parallel.meshplan import MeshPlan
+    from chainermn_tpu.precision import Policy
+    from chainermn_tpu.serving import GenerationEngine
+
+    plan = MeshPlan.create(tp=tp)
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, d_ff=64, max_len=64,
+                          tp_axis=plan.model_axis)
+    params = tp_oracle(model).init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))['params']
+    specs = tp_param_specs(params, plan.model_axis)
+    # the draft rides replicated (never tp-sharded): it is small by
+    # construction, and sharding it would serialize the cheap propose
+    # loop behind collectives
+    draft = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=1, d_ff=64, max_len=64)
+    draft_params = draft.init(
+        jax.random.PRNGKey(1), jnp.zeros((1, 16), jnp.int32))['params']
+    engine = GenerationEngine(
+        model, params, n_slots=8, max_prompt_len=16,
+        policy=policy or Policy.bf16(), plan=plan, param_specs=specs,
+        draft_model=draft, draft_params=draft_params,
+        spec_tokens=spec_tokens)
+    bucket = bucket or engine.n_slots
+    fn, args = engine.traceable_verify(bucket)
+    return LintTarget(
+        'step:spec_verify_forward', fn, args, dict(plan.mesh.shape),
+        compute_dtype='bfloat16', items=bucket * spec_tokens,
+        plan_axes=(plan.model_axis,),
+        make_args=lambda it: engine.traceable_verify(bucket)[1])
+
+
 #: step name -> factory(policy) -- the CLI's ``--step`` catalogue.
 #: Keys are the short names (target name minus the ``step:`` prefix),
 #: in sweep order; the resnet50 pair sits last (the slowest traces,
@@ -629,6 +680,8 @@ STEP_FACTORIES = {
         lambda policy=None: serve_forward_target(policy=policy),
     'decode_forward':
         lambda policy=None: decode_forward_target(policy=policy),
+    'spec_verify_forward':
+        lambda policy=None: spec_verify_forward_target(policy=policy),
     'resnet50_example':
         lambda policy=None: resnet50_step_target(policy=policy),
     'resnet50_fused':

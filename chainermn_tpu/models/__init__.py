@@ -24,7 +24,8 @@ from chainermn_tpu.models.transformer import (  # noqa
     TransformerLM, TransformerBlock, decode_step, decode_step_paged,
     init_kv_cache, init_paged_kv_cache, kv_cache_specs, lm_loss,
     lm_loss_sum, pipeline_parts, pipeline_stage_specs, prefill,
-    prefill_paged, tp_oracle, tp_param_specs)
+    prefill_paged, spec_verify, spec_verify_paged, tp_oracle,
+    tp_param_specs)
 
 
 def get_arch(name, **kwargs):

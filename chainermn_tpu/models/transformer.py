@@ -866,6 +866,218 @@ def prefill_paged(model, params, cache, tokens, length, page_table,
     return _head_logits(model, params, x_last)[0], cache
 
 
+def _verify_core(model, params, cache, tokens, positions, write,
+                 attend):
+    """Shared k-token verify body (the windowed twin of
+    :func:`_decode_core`): ``tokens`` (N, K) int32 -- row i's window is
+    K consecutive tokens starting at absolute position
+    ``positions[i]`` -- embed + per-layer (norm -> qkv -> ``write`` the
+    window's K/V -> ``attend`` window-causal against the banked prefix
+    -> proj residual -> MLP residual) -> final norm -> head at ALL K
+    positions.  ``write(cache, layer, k_new, v_new)`` /
+    ``attend(cache, layer, q, k_new, v_new)`` close over the cache
+    addressing exactly as in :func:`_decode_core`; ``attend``
+    additionally receives the fresh window K/V because the chunk
+    kernel takes them as operands rather than re-reading the cache.
+    Returns ``(logits (N, K, vocab) f32, new_cache)``."""
+    from chainermn_tpu import ops
+    from chainermn_tpu.parallel import tensor
+
+    dtype = model.dtype
+    tp_mode = model.tp_axis is not None
+    n, kk = tokens.shape
+    window = (positions.astype(jnp.int32)[:, None]
+              + jnp.arange(kk, dtype=jnp.int32)[None, :])   # (N, K)
+    if tp_mode:
+        x = _tp_embed_rows(params, tokens, model.vocab_size,
+                           model.d_model, dtype, model.tp_axis)
+    else:
+        x = jnp.take(params['embed']['embedding'], tokens,
+                     axis=0).astype(dtype)
+    x = x + jnp.take(params['pos_embed'], window,
+                     axis=0).astype(dtype)
+    for i in range(model.n_layers):
+        bp = params['block_%d' % i]
+        h = ops.layer_norm(x, bp['ln1_scale'],
+                           bp['ln1_bias']).astype(dtype)
+        qkv = _qkv_proj(h, bp, dtype)           # (N, K, 3, H, d_head)
+        q, k_new, v_new = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        cache = write(cache, i, k_new, v_new)
+        attn = attend(cache, i, q, k_new, v_new)
+        attn = attn.reshape(n, kk, -1)
+        if tp_mode:
+            out = tensor.row_parallel_dense(
+                attn, bp['proj']['kernel'].astype(dtype),
+                model.tp_axis, bp['proj']['bias'].astype(dtype))
+        else:
+            out = _dense(attn, bp['proj'], dtype)
+        x = x + out
+        h = ops.layer_norm(x, bp['ln2_scale'],
+                           bp['ln2_bias']).astype(dtype)
+        if tp_mode:
+            g = nn.gelu(tensor.column_parallel_dense(
+                h, bp['ff_in']['kernel'].astype(dtype),
+                bp['ff_in']['bias'].astype(dtype)))
+            x = x + tensor.row_parallel_dense(
+                g, bp['ff_out']['kernel'].astype(dtype),
+                model.tp_axis, bp['ff_out']['bias'].astype(dtype))
+        else:
+            x = x + _dense(nn.gelu(_dense(h, bp['ff_in'], dtype)),
+                           bp['ff_out'], dtype)
+    x = ops.layer_norm(x, params['lnf_scale'], params['lnf_bias'])
+    return _head_logits(model, params, x), cache
+
+
+def _roundtrip_kv(cache, k_new, v_new):
+    """What the oracle's NEXT decode step would read back for the
+    window's freshly written K/V: the cache-dtype cast (float caches)
+    or the int8 quantize->dequantize roundtrip.  Feeding these -- not
+    the raw float values -- as the chunk kernel's fresh half is what
+    makes speculative verify argmax-equal to the sequential decode
+    loop in every KV mode."""
+    from chainermn_tpu.precision import dequantize_kv, quantize_kv
+    if _cache_int8(cache):
+        return (dequantize_kv(*quantize_kv(k_new)),
+                dequantize_kv(*quantize_kv(v_new)))
+    dt = cache['k'].dtype
+    return k_new.astype(dt), v_new.astype(dt)
+
+
+def spec_verify(model, params, cache, tokens, positions, slots=None):
+    """Speculative-decoding verify pass: score K consecutive proposed
+    tokens per row in ONE executable.  ``tokens`` (N, K) int32 -- row
+    i's window ``[last committed token, draft_1, ..., draft_{K-1}]``
+    written at absolute positions ``positions[i] + [0, K)``;
+    ``positions`` (N,) int32; ``slots`` as in :func:`decode_step`
+    (``None`` = full bucket, one row per slot).  Returns ``(logits
+    (N, K, vocab) f32, new_cache)`` where ``logits[i, j]`` is the
+    target's next-token distribution GIVEN the window prefix through
+    ``tokens[i, j]`` -- row j's argmax verifies draft j+1 and row
+    K-1's argmax is the bonus/correction token.
+
+    Column 0 computes exactly what :func:`decode_step` would for
+    ``tokens[:, 0]``, and inductively every accepted column matches
+    the sequential decode loop -- attention is
+    :func:`~chainermn_tpu.ops.flash_attention_chunk` (the chunked-
+    prefill kernel: window-causal fresh half + banked context masked
+    at ``positions``), with the fresh half fed the cache-roundtripped
+    K/V so int8-KV verify attends the same dequantized values the
+    oracle reads back.  Window entries at/beyond the cache depth are
+    dropped by the scatter and never committed by the scheduler, so a
+    window overhanging ``max_len`` is harmless.  Rollback after the
+    accept-prefix decision is a position rewind: rejected columns'
+    K/V (and int8 scales) stay as masked garbage, exactly like a
+    reused slot."""
+
+    if slots is None and tokens.shape[0] != cache['k'].shape[1]:
+        raise ValueError(
+            'full-bucket verify needs one row per cache slot '
+            '(%d rows vs %d slots); pass slots= for a compacted '
+            'bucket' % (tokens.shape[0], cache['k'].shape[1]))
+    from chainermn_tpu import ops
+
+    n, kk = tokens.shape
+    positions = positions.astype(jnp.int32)
+    window = positions[:, None] + jnp.arange(kk, dtype=jnp.int32)
+    idx_slots = (jnp.arange(n) if slots is None
+                 else slots.astype(jnp.int32))
+
+    def write(cache, layer, k_new, v_new):
+        from chainermn_tpu.precision import quantize_kv
+        out = dict(cache)
+        rows_idx = idx_slots[:, None]
+        if _cache_int8(cache):
+            for name, val in (('k', k_new), ('v', v_new)):
+                qv, scale = quantize_kv(val)
+                out[name] = cache[name].at[
+                    layer, rows_idx, window].set(qv)
+                out[name + '_scale'] = cache[name + '_scale'].at[
+                    layer, rows_idx, window].set(scale)
+            return out
+        dt = cache['k'].dtype
+        out['k'] = cache['k'].at[layer, rows_idx, window].set(
+            k_new.astype(dt))
+        out['v'] = cache['v'].at[layer, rows_idx, window].set(
+            v_new.astype(dt))
+        return out
+
+    def attend(cache, layer, q, k_new, v_new):
+        def rows(name):
+            full = cache[name][layer]
+            return full if slots is None else jnp.take(
+                full, idx_slots, axis=0)
+        k_att, v_att = _roundtrip_kv(cache, k_new, v_new)
+        if _cache_int8(cache):
+            return ops.flash_attention_chunk(
+                q, k_att, v_att, rows('k'), rows('v'), positions,
+                k_scale=rows('k_scale'), v_scale=rows('v_scale'))
+        return ops.flash_attention_chunk(
+            q, k_att, v_att, rows('k'), rows('v'), positions)
+
+    return _verify_core(model, params, cache, tokens, positions,
+                        write, attend)
+
+
+def spec_verify_paged(model, params, cache, tokens, positions,
+                      page_tables):
+    """:func:`spec_verify` against a PAGED cache: ``page_tables``
+    (N, n_max) int32 as in :func:`decode_step_paged`; table entries
+    covering ``[positions[i], positions[i] + K)`` must be allocated
+    by the scheduler (the speculative page-growth step), and window
+    rows past the pool's addressable range are routed to the scratch
+    page like chunked-prefill pad rows.  Context is gathered through
+    the page table (:func:`prefill_paged`'s read pattern) and masked
+    at ``positions``; arithmetic is otherwise identical to the slab
+    verify -- paging stays a storage indirection."""
+    from chainermn_tpu import ops
+    from chainermn_tpu.precision import quantize_kv
+
+    n, kk = tokens.shape
+    n_max = page_tables.shape[1]
+    ps = cache['k'].shape[2]
+    positions = positions.astype(jnp.int32)
+    window = positions[:, None] + jnp.arange(kk, dtype=jnp.int32)
+    page_idx = jnp.clip(window // ps, 0, n_max - 1)
+    pages = jnp.where(
+        window < n_max * ps,
+        jnp.take_along_axis(page_tables.astype(jnp.int32), page_idx,
+                            axis=1), 0)                      # (N, K)
+    offsets = window % ps
+
+    def write(cache, layer, k_new, v_new):
+        out = dict(cache)
+        if _cache_int8(cache):
+            for name, val in (('k', k_new), ('v', v_new)):
+                qv, scale = quantize_kv(val)
+                out[name] = cache[name].at[
+                    layer, pages, offsets].set(qv)
+                out[name + '_scale'] = cache[name + '_scale'].at[
+                    layer, pages, offsets].set(scale)
+            return out
+        dt = cache['k'].dtype
+        out['k'] = cache['k'].at[layer, pages, offsets].set(
+            k_new.astype(dt))
+        out['v'] = cache['v'].at[layer, pages, offsets].set(
+            v_new.astype(dt))
+        return out
+
+    def attend(cache, layer, q, k_new, v_new):
+        def gather(name):
+            g = jnp.take(cache[name][layer],
+                         page_tables.astype(jnp.int32), axis=0)
+            return g.reshape((n, n_max * ps) + g.shape[3:])
+        k_att, v_att = _roundtrip_kv(cache, k_new, v_new)
+        if _cache_int8(cache):
+            return ops.flash_attention_chunk(
+                q, k_att, v_att, gather('k'), gather('v'), positions,
+                k_scale=gather('k_scale'), v_scale=gather('v_scale'))
+        return ops.flash_attention_chunk(
+            q, k_att, v_att, gather('k'), gather('v'), positions)
+
+    return _verify_core(model, params, cache, tokens, positions,
+                        write, attend)
+
+
 def pipeline_parts(model, params, n_stages, pad_id=-1, tp_axis=None,
                    local_loss=False):
     """Split a ``TransformerLM`` parameter tree into

@@ -30,7 +30,14 @@ Three cooperating layers (``docs/serving.md``):
   KV-cache mode, and the same no-recompile signature guard -- plus
   the PAGED mode: a pooled KV cache addressed through per-sequence
   page tables, radix-trie prompt-prefix sharing with copy-on-write,
-  and SARATHI-style chunked prefill interleaved with decode ticks;
+  and SARATHI-style chunked prefill interleaved with decode ticks --
+  and SPECULATIVE decoding (ISSUE 19): a small draft model
+  (``draft_model=``) proposes ``spec_tokens`` tokens per tick, the
+  target scores them all in ONE ``spec_verify`` pass, and the engine
+  commits the longest draft/target-agreeing prefix plus the target's
+  correction token -- exact greedy equivalence with the
+  non-speculative oracle, with rollback of slot lengths and paged
+  page-table tails to the accepted boundary;
 - :mod:`~chainermn_tpu.serving.paged` -- the host-side page
   accounting behind paged mode: a refcounted :class:`PagePool`
   (page 0 reserved scratch), the :class:`RadixPrefixIndex` banking

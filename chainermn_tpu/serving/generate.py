@@ -374,6 +374,28 @@ class GenerationEngine:
         prompt in one tick.
       prefix_sharing: disable the radix index (pages still pool, no
         cross-request reuse) -- an ablation knob for the bench.
+      draft_model / draft_params: enable SPECULATIVE DECODING -- a
+        smaller ``TransformerLM`` (fewer layers/heads, SAME vocab,
+        never tensor-parallel) that autoregressively proposes
+        ``spec_tokens - 1`` tokens per scheduler tick; the target
+        scores the whole window in ONE verify executable
+        (:func:`chainermn_tpu.models.spec_verify`) and the longest
+        draft prefix whose argmaxes agree is committed plus the
+        target's own next token (the correction at the first
+        divergence, the bonus on full acceptance).  Greedy outputs
+        are EXACTLY the non-speculative engine's token for token --
+        acceptance rate only changes THROUGHPUT, never content
+        (tests/test_serving.py pins all four cache modes).  The draft
+        rides its own KV cache through the same slot ids, page
+        tables, pool refcounts, prefix-shared pages and CoW copies
+        as the target; rejected positions roll back by position
+        rewind (+ page-table tail release in paged mode) -- stale
+        rows are masked exactly like a reused slot.
+      spec_tokens: verify window width ``k`` (>= 2): one tick runs
+        ``k`` draft-decode steps and one k-token verify, committing
+        1..k tokens, so accepted drafts amortize the HBM-bound
+        target cache read (``verify_steps / tokens_generated < 1``
+        whenever anything is accepted).
       plan / param_specs: MeshPlan tensor-parallel serving (the cache
         shards its head dim over ``plan.model_axis``).
       cache_dir / aot: the engine's persistent-compilation-cache and
@@ -393,6 +415,7 @@ class GenerationEngine:
                  max_len=None, eos_id=None, policy=None,
                  int8_kv=False, paged=False, page_size=16,
                  n_pages=None, prefill_chunk=None, prefix_sharing=True,
+                 draft_model=None, draft_params=None, spec_tokens=4,
                  plan=None, param_specs=None, cache_dir=None, aot=True,
                  label=None, version=0):
         import os
@@ -488,6 +511,55 @@ class GenerationEngine:
                              if plan is not None else None)
         self._cache = jax.device_put(cache, self._cache_sharding())
 
+        # -- speculative decoding: the draft twin ----------------------
+        self.spec_tokens = int(spec_tokens)
+        self.draft_model = draft_model
+        self.speculative = draft_model is not None
+        if draft_params is not None and draft_model is None:
+            raise ValueError('draft_params requires draft_model')
+        self._draft_params = None
+        self._draft_cache = None
+        if self.speculative:
+            if draft_params is None:
+                raise ValueError('draft_model requires draft_params')
+            if self.spec_tokens < 2:
+                raise ValueError('spec_tokens must be >= 2 (1 is '
+                                 'plain decode), got %d'
+                                 % self.spec_tokens)
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError(
+                    'draft vocab %d != target vocab %d -- speculative '
+                    'decoding compares token ids, so the tokenizer '
+                    'must be shared' % (draft_model.vocab_size,
+                                        model.vocab_size))
+            if draft_model.max_len < self.max_len:
+                raise ValueError(
+                    'draft max_len %d cannot cover the cache depth %d'
+                    % (draft_model.max_len, self.max_len))
+            if draft_model.tp_axis is not None:
+                raise ValueError(
+                    'the draft model is small by construction and '
+                    'runs replicated; build it without tp_axis')
+            host = draft_params
+            if self.policy is not None and not self.quantized:
+                from chainermn_tpu.precision import cast_floating
+                host = cast_floating(host, self.policy.compute_dtype)
+            self._draft_params = jax.device_put(
+                host, self._draft_sharding())
+            if self.paged:
+                # SAME pool geometry as the target: the draft cache is
+                # addressed through the same page tables and refcounts,
+                # so one allocation/CoW/eviction decision serves both
+                dcache = init_paged_kv_cache(
+                    draft_model, self.n_pages, self.page_size,
+                    int8_kv=self.int8_kv, tp=1)
+            else:
+                dcache = init_kv_cache(
+                    draft_model, self.n_slots, self.max_len,
+                    int8_kv=self.int8_kv, tp=1)
+            self._draft_cache = jax.device_put(
+                dcache, self._draft_sharding())
+
         # prefill executable widths: chunked paged mode compiles ONE
         # fixed-width chunk executable; otherwise one per prompt bucket
         self._prefill_widths = (
@@ -500,16 +572,26 @@ class GenerationEngine:
         self._prefill = {}    # prompt/chunk bucket -> callable
         self._decode = {}     # slot bucket -> callable
         self._copy = None     # paged CoW page-copy executable
+        self._draft_prefill = {}  # speculative: draft prompt buckets
+        self._draft_decode = {}   # speculative: draft slot buckets
+        self._verify = {}         # speculative: k-token verify buckets
+        self._draft_copy = None   # speculative paged: draft CoW copy
         self._signatures = set()
         self._lock = threading.Lock()
         self.prefill_trace_count = 0
         self.decode_trace_count = 0
         self.copy_trace_count = 0
+        self.draft_trace_count = 0
+        self.verify_trace_count = 0
         self.compile_count = 0
         self.prefills = 0
         self.prefill_chunks = 0
         self.cow_copies = 0
         self.decode_steps = 0
+        self.draft_steps = 0
+        self.verify_steps = 0
+        self.draft_proposed = 0
+        self.draft_accepted = 0
         self.tokens_generated = 0
         self.cancelled = 0
         self._step_index = 0
@@ -605,6 +687,14 @@ class GenerationEngine:
             return jax.devices()[0]
         return self.plan.param_shardings(self._cache_specs)
 
+    def _draft_sharding(self):
+        """The draft model is always replicated: it is small by
+        construction, so sharding it would trade cheap FLOPs for
+        collective latency on the critical decode path."""
+        if self.plan is None:
+            return jax.devices()[0]
+        return self.plan.replicated()
+
     # -- traced bodies -------------------------------------------------
     def _prepare_params(self, params):
         if self.quantized:
@@ -650,11 +740,78 @@ class GenerationEngine:
         """Copy-on-write page duplication: every leaf's page ``src``
         row copied to page ``dst`` in one donated pass.  ``params``
         rides along unused to keep the shared ``_compile`` calling
-        convention (one signature family, cache donated at arg 1)."""
+        convention (one signature family, cache donated at arg 1).
+        Shape-generic: the speculative engine compiles a second
+        instance of this body over the DRAFT cache, so one CoW
+        decision duplicates the page in both pools."""
         del params
         self.copy_trace_count += 1     # trace-time counter
         return {key: leaf.at[:, dst].set(leaf[:, src])
                 for key, leaf in cache.items()}
+
+    # -- speculative traced bodies (the draft twin + verify) -----------
+    def _draft_prefill_body(self, params, cache, tokens, length, slot):
+        from chainermn_tpu.models import prefill as model_prefill
+        self.draft_trace_count += 1    # trace-time counter
+        logits, cache = model_prefill(
+            self.draft_model, params, cache, tokens, length, slot)
+        return jnp.argmax(logits).astype(jnp.int32), cache
+
+    def _draft_prefill_body_paged(self, params, cache, tokens, length,
+                                  pos0, table):
+        from chainermn_tpu.models import prefill_paged
+        self.draft_trace_count += 1    # trace-time counter
+        logits, cache = prefill_paged(
+            self.draft_model, params, cache, tokens, length, table,
+            pos0)
+        return jnp.argmax(logits).astype(jnp.int32), cache
+
+    def _draft_decode_body(self, params, cache, tokens, positions,
+                           slots=None):
+        from chainermn_tpu.models import decode_step
+        self.draft_trace_count += 1    # trace-time counter
+        logits, cache = decode_step(
+            self.draft_model, params, cache, tokens, positions,
+            slots=slots)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    def _draft_decode_body_paged(self, params, cache, tokens,
+                                 positions, tables):
+        from chainermn_tpu.models import decode_step_paged
+        self.draft_trace_count += 1    # trace-time counter
+        logits, cache = decode_step_paged(
+            self.draft_model, params, cache, tokens, positions,
+            tables)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    def _verify_body(self, params, cache, tokens, positions,
+                     slots=None):
+        from chainermn_tpu.models import spec_verify
+        self.verify_trace_count += 1   # trace-time counter
+        logits, cache = spec_verify(
+            self.model, self._prepare_params(params), cache, tokens,
+            positions, slots=slots)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    def _verify_body_paged(self, params, cache, tokens, positions,
+                           tables):
+        from chainermn_tpu.models import spec_verify_paged
+        self.verify_trace_count += 1   # trace-time counter
+        logits, cache = spec_verify_paged(
+            self.model, self._prepare_params(params), cache, tokens,
+            positions, tables)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+    def _draft_mapped(self, body, n_extra):
+        """The draft twin of :meth:`_mapped`: everything replicated
+        (draft params, draft cache, small int operands)."""
+        if self.plan is None:
+            return body
+        from jax.sharding import PartitionSpec as P
+        return jax.shard_map(
+            body, mesh=self.plan.mesh,
+            in_specs=(P(), P()) + (P(),) * n_extra,
+            out_specs=(P(), P()), check_vma=False)
 
     def _mapped(self, body, n_extra):
         """Wrap a traced body in the plan's shard_map (params sharded
@@ -670,11 +827,13 @@ class GenerationEngine:
             out_specs=(P(), self._cache_specs), check_vma=False)
 
     # -- compilation ---------------------------------------------------
-    def _compile(self, fn, args, table, key):
+    def _compile(self, fn, args, table, key, params=None):
         jitted = jax.jit(fn, donate_argnums=(1,))
         exe = None
         if self.aot_requested:
-            exe = jax_compat.aot_compile(jitted, self.params, *args)
+            exe = jax_compat.aot_compile(
+                jitted, self.params if params is None else params,
+                *args)
         aot = exe is not None
         if exe is None:
             exe = jitted
@@ -711,10 +870,33 @@ class GenerationEngine:
                 jax.ShapeDtypeStruct((bucket,), i32),
                 jax.ShapeDtypeStruct((bucket,), i32))
 
+    def _verify_structs(self, bucket):
+        """Verify operand structs for one slot bucket: the decode
+        structs with the token vector widened to the (bucket,
+        spec_tokens) window."""
+        i32 = jnp.int32
+        kk = self.spec_tokens
+        if self.paged:
+            return (jax.ShapeDtypeStruct((bucket, kk), i32),
+                    jax.ShapeDtypeStruct((bucket,), i32),
+                    jax.ShapeDtypeStruct((bucket, self.pages_per_seq),
+                                         i32))
+        if bucket == self.n_slots:
+            return (jax.ShapeDtypeStruct((bucket, kk), i32),
+                    jax.ShapeDtypeStruct((bucket,), i32))
+        return (jax.ShapeDtypeStruct((bucket, kk), i32),
+                jax.ShapeDtypeStruct((bucket,), i32),
+                jax.ShapeDtypeStruct((bucket,), i32))
+
     def _cache_struct(self):
         return jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
             self._cache)
+
+    def _draft_cache_struct(self):
+        return jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            self._draft_cache)
 
     def _get_prefill(self, bucket):
         hit = self._prefill.get(bucket)
@@ -770,11 +952,20 @@ class GenerationEngine:
 
     def _copy_page(self, src, dst):
         """Duplicate pool page ``src`` into the private page ``dst``
-        (already allocated by the caller)."""
+        (already allocated by the caller).  A speculative engine
+        duplicates the page in the DRAFT cache too: both caches are
+        addressed through the same page table, so a copy-on-write
+        divergence must fork them together."""
         exe = self._get_copy()
         self._cache = exe(self.params, self._cache,
                           jnp.asarray(src, jnp.int32),
                           jnp.asarray(dst, jnp.int32))
+        if self.speculative:
+            dexe = self._get_draft_copy()
+            self._draft_cache = dexe(self._draft_params,
+                                     self._draft_cache,
+                                     jnp.asarray(src, jnp.int32),
+                                     jnp.asarray(dst, jnp.int32))
         self.cow_copies += 1
         reg = _telemetry.registry()
         if reg is not None:
@@ -839,6 +1030,129 @@ class GenerationEngine:
         args.append(jnp.zeros((bucket,), jnp.int32))
         return fn, tuple(args)
 
+    # -- speculative executables ---------------------------------------
+    def _get_draft_prefill(self, bucket):
+        hit = self._draft_prefill.get(bucket)
+        if hit is not None:
+            return hit[0]
+        with self._lock:
+            hit = self._draft_prefill.get(bucket)
+            if hit is not None:
+                return hit[0]
+            body = (self._draft_mapped(self._draft_prefill_body_paged,
+                                       4)
+                    if self.paged
+                    else self._draft_mapped(self._draft_prefill_body,
+                                            3))
+            exe, _ = self._compile(
+                body, (self._draft_cache_struct(),)
+                + self._token_structs(bucket),
+                self._draft_prefill, bucket,
+                params=self._draft_params)
+            return exe
+
+    def _draft_decode_mapped(self, bucket):
+        if self.paged:
+            return self._draft_mapped(self._draft_decode_body_paged,
+                                      3)
+        if bucket == self.n_slots:
+            return self._draft_mapped(
+                lambda p, c, t, pos: self._draft_decode_body(
+                    p, c, t, pos), 2)
+        return self._draft_mapped(
+            lambda p, c, t, s, pos: self._draft_decode_body(
+                p, c, t, pos, slots=s), 3)
+
+    def _get_draft_decode(self, bucket):
+        hit = self._draft_decode.get(bucket)
+        if hit is not None:
+            return hit[0]
+        with self._lock:
+            hit = self._draft_decode.get(bucket)
+            if hit is not None:
+                return hit[0]
+            exe, _ = self._compile(
+                self._draft_decode_mapped(bucket),
+                (self._draft_cache_struct(),)
+                + self._decode_structs(bucket),
+                self._draft_decode, bucket,
+                params=self._draft_params)
+            return exe
+
+    def _verify_mapped(self, bucket):
+        """The k-token verify callable for one slot bucket -- the
+        decode callable's windowed twin, same operand orders."""
+        if self.paged:
+            return self._mapped(self._verify_body_paged, 3)
+        if bucket == self.n_slots:
+            return self._mapped(
+                lambda p, c, t, pos: self._verify_body(p, c, t, pos),
+                2)
+        return self._mapped(
+            lambda p, c, t, s, pos: self._verify_body(
+                p, c, t, pos, slots=s), 3)
+
+    def _get_verify(self, bucket):
+        hit = self._verify.get(bucket)
+        if hit is not None:
+            return hit[0]
+        with self._lock:
+            hit = self._verify.get(bucket)
+            if hit is not None:
+                return hit[0]
+            if bucket not in self.decode_edges:
+                raise RuntimeError(
+                    'verify bucket %d is not an edge %r'
+                    % (bucket, list(self.decode_edges)))
+            exe, _ = self._compile(
+                self._verify_mapped(bucket),
+                (self._cache_struct(),) + self._verify_structs(bucket),
+                self._verify, bucket)
+            return exe
+
+    def _get_draft_copy(self):
+        if self._draft_copy is not None:
+            return self._draft_copy[0]
+        with self._lock:
+            if self._draft_copy is not None:
+                return self._draft_copy[0]
+            body = self._copy_body
+            if self.plan is not None:
+                from jax.sharding import PartitionSpec as P
+                body = jax.shard_map(
+                    self._copy_body, mesh=self.plan.mesh,
+                    in_specs=(P(), P(), P(), P()), out_specs=P(),
+                    check_vma=False)
+            table = {}
+            exe, aot = self._compile(
+                body,
+                (self._draft_cache_struct(),
+                 jax.ShapeDtypeStruct((), jnp.int32),
+                 jax.ShapeDtypeStruct((), jnp.int32)),
+                table, 'copy', params=self._draft_params)
+            self._draft_copy = table['copy']
+            return exe
+
+    def traceable_verify(self, bucket=None):
+        """``(fn, args)`` for ``jax.make_jaxpr`` -- the EXACT mapped
+        verify callable the speculative engine compiles for
+        ``bucket``, on zero operands over the real cache/params: the
+        shardlint ``step:spec_verify_forward`` target traces
+        production code (the :meth:`traceable_decode` contract)."""
+        bucket = bucket or self.n_slots
+        fn = self._verify_mapped(bucket)
+        args = [self.params, self._cache,
+                jnp.zeros((bucket, self.spec_tokens), jnp.int32)]
+        if self.paged:
+            args.append(jnp.zeros((bucket,), jnp.int32))
+            args.append(jnp.zeros((bucket, self.pages_per_seq),
+                                  jnp.int32))
+            return fn, tuple(args)
+        if bucket != self.n_slots:
+            args.append(jnp.arange(bucket, dtype=jnp.int32))
+        args.append(jnp.zeros((bucket,), jnp.int32))
+        return fn, tuple(args)
+
     def warmup(self):
         """Compile (or cache-load) every prefill and decode bucket
         executable eagerly, largest first.  Fallback (plain-jit)
@@ -891,10 +1205,88 @@ class GenerationEngine:
                     zero = jnp.asarray(0, jnp.int32)
                     self._cache = exe(self.params, self._cache,
                                       zero, zero)
-        return {'prefill': {b: a for b, (_, a)
-                            in sorted(self._prefill.items())},
-                'decode': {b: a for b, (_, a)
-                           in sorted(self._decode.items())}}
+        if self.speculative:
+            self._warmup_speculative()
+        out = {'prefill': {b: a for b, (_, a)
+                           in sorted(self._prefill.items())},
+               'decode': {b: a for b, (_, a)
+                          in sorted(self._decode.items())}}
+        if self.speculative:
+            out['draft_prefill'] = {
+                b: a for b, (_, a)
+                in sorted(self._draft_prefill.items())}
+            out['draft_decode'] = {
+                b: a for b, (_, a)
+                in sorted(self._draft_decode.items())}
+            out['verify'] = {b: a for b, (_, a)
+                             in sorted(self._verify.items())}
+        return out
+
+    def _warmup_speculative(self):
+        """Warm the draft-prefill / draft-decode / verify bucket
+        families (largest first, same fallback force-run contract as
+        the base families: free slots + zero tables make warmup
+        garbage structurally unattendable)."""
+        for bucket in sorted(self._prefill_widths, reverse=True):
+            with _telemetry.span('serve_warmup', kind='serve',
+                                 phase='draft_prefill',
+                                 bucket=bucket):
+                exe = self._get_draft_prefill(bucket)
+                if not self._draft_prefill[bucket][1]:
+                    args = [jnp.zeros((1, bucket), jnp.int32),
+                            jnp.asarray(1, jnp.int32),
+                            jnp.asarray(0, jnp.int32)]
+                    if self.paged:
+                        args.append(jnp.zeros((self.pages_per_seq,),
+                                              jnp.int32))
+                    tok, dcache = exe(self._draft_params,
+                                      self._draft_cache, *args)
+                    jax.block_until_ready(tok)
+                    self._draft_cache = dcache
+        for bucket in sorted(self.decode_edges, reverse=True):
+            with _telemetry.span('serve_warmup', kind='serve',
+                                 phase='draft_decode', bucket=bucket):
+                exe = self._get_draft_decode(bucket)
+                if not self._draft_decode[bucket][1]:
+                    args = self._zero_decode_args(bucket)
+                    tok, dcache = exe(self._draft_params,
+                                      self._draft_cache, *args)
+                    jax.block_until_ready(tok)
+                    self._draft_cache = dcache
+            with _telemetry.span('serve_warmup', kind='serve',
+                                 phase='verify', bucket=bucket):
+                exe = self._get_verify(bucket)
+                if not self._verify[bucket][1]:
+                    args = self._zero_decode_args(
+                        bucket, window=self.spec_tokens)
+                    tok, cache = exe(self.params, self._cache, *args)
+                    jax.block_until_ready(tok)
+                    self._cache = cache
+        if self.paged:
+            with _telemetry.span('serve_warmup', kind='serve',
+                                 phase='draft_copy_page'):
+                exe = self._get_draft_copy()
+                if not self._draft_copy[1]:
+                    zero = jnp.asarray(0, jnp.int32)
+                    self._draft_cache = exe(self._draft_params,
+                                            self._draft_cache,
+                                            zero, zero)
+
+    def _zero_decode_args(self, bucket, window=None):
+        """Zero operands matching :meth:`_decode_structs` (or the
+        verify structs when ``window`` is set) -- the warmup
+        force-run inputs."""
+        shape = (bucket,) if window is None else (bucket, window)
+        args = [jnp.zeros(shape, jnp.int32)]
+        if self.paged:
+            args.append(jnp.zeros((bucket,), jnp.int32))
+            args.append(jnp.zeros((bucket, self.pages_per_seq),
+                                  jnp.int32))
+        else:
+            if bucket != self.n_slots:
+                args.append(jnp.arange(bucket, dtype=jnp.int32))
+            args.append(jnp.zeros((bucket,), jnp.int32))
+        return args
 
     def guard_signature(self, args):
         """The SL007 machinery as a runtime pin (the engine.py
@@ -1049,6 +1441,25 @@ class GenerationEngine:
                 tok, cache = exe(self.params, self._cache, *args)
                 tok = int(jax.block_until_ready(tok))
             self._cache = cache
+            if self.speculative:
+                # the draft prefills the same prompt into ITS cache at
+                # the same slot (its proposals need the prompt's K/V);
+                # the draft's own first-token logits are discarded --
+                # the target's token is authoritative
+                dexe = self._get_draft_prefill(bucket)
+                self.guard_signature(
+                    (self._draft_cache_struct(),) + tuple(
+                        jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in args))
+                with _telemetry.span('serve_draft', kind='serve',
+                                     stage='prefill', bucket=bucket,
+                                     slot=sid,
+                                     iteration=self._step_index,
+                                     **ident):
+                    dtok, dcache = dexe(self._draft_params,
+                                        self._draft_cache, *args)
+                    jax.block_until_ready(dtok)
+                self._draft_cache = dcache
             self.prefills += 1
             self.tokens_generated += 1
             t_first = clock()
@@ -1211,6 +1622,24 @@ class GenerationEngine:
                 tok, cache = exe(self.params, self._cache, *args)
                 tok = jax.block_until_ready(tok)
             self._cache = cache
+            if self.speculative:
+                # same chunk, same pages, into the draft cache: banked
+                # prefix pages stay valid for BOTH caches, so a future
+                # prefix hit serves the draft too
+                dexe = self._get_draft_prefill(width)
+                self.guard_signature(
+                    (self._draft_cache_struct(),) + tuple(
+                        jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in args))
+                with _telemetry.span('serve_draft', kind='serve',
+                                     stage='prefill', bucket=width,
+                                     slot=sid, chunk=st.chunks,
+                                     iteration=self._step_index,
+                                     **ident):
+                    dtok, dcache = dexe(self._draft_params,
+                                        self._draft_cache, *args)
+                    jax.block_until_ready(dtok)
+                self._draft_cache = dcache
             st.pos += n
             st.chunks += 1
             self.prefill_chunks += 1
@@ -1404,6 +1833,225 @@ class GenerationEngine:
         self.decode_steps += 1
         self.tokens_generated += k
 
+    def _spec_once(self, clock):
+        """One SPECULATIVE tick over every active slot: ``spec_tokens``
+        draft-decode steps propose a window, ONE target verify
+        executable scores all of it, and each slot commits the longest
+        prefix where draft and target argmax agree PLUS the target's
+        own next token (the correction at the first divergence, the
+        bonus on full acceptance) -- so every tick emits 1..k tokens
+        for one expensive target pass, and a rejection at draft
+        position 0 degenerates to exactly the plain decode step.
+
+        Rollback is a position rewind: rejected window positions'
+        K/V (and int8 scales) in BOTH caches stay as garbage masked
+        by the live length -- the reused-slot contract -- and in
+        paged mode the page-table tail past the accepted boundary is
+        released back to the pool so refcounts track committed tokens
+        only."""
+        kk = self.spec_tokens
+        if self.paged:
+            # grow page tables to cover the WHOLE window [position,
+            # position + k) before dispatch; overhang past the cache
+            # depth is clamped (those rows write scratch, never commit)
+            for sid in sorted(self._slots):
+                slot = self._slots[sid]
+                last = min(slot.position + kk - 1, self.max_len - 1)
+                need = last // self.page_size
+                while len(slot.pages) <= need:
+                    page = self._alloc_page()
+                    if page is None:
+                        del self._slots[sid]
+                        self._shed_paged(slot.request, slot.pages,
+                                         'decode')
+                        self._free.append(sid)
+                        break
+                    slot.pages.append(page)
+            if not self._slots:
+                return
+        active = sorted(self._slots)
+        k = len(active)
+        bucket = bucket_of(k, self.decode_edges)
+        if self.paged:
+            rows = active + [None] * (bucket - k)
+        elif bucket == self.n_slots:
+            rows = list(range(self.n_slots))
+        else:
+            rows = active + self._free[:bucket - k]
+        base_tok = np.asarray(
+            [self._slots[s].generated[-1] if s in self._slots else 0
+             for s in rows], np.int32)
+        base_pos = np.asarray(
+            [self._slots[s].position if s in self._slots else 0
+             for s in rows], np.int32)
+        tables = None
+        if self.paged:
+            tables = np.zeros((bucket, self.pages_per_seq), np.int32)
+            for i, sid in enumerate(rows):
+                if sid is not None:
+                    pages = self._slots[sid].pages
+                    tables[i, :len(pages)] = pages
+        rec = _telemetry.active()
+        reg = _telemetry.registry()
+        ident = self._ident()
+        if reg is not None:
+            reg.gauge('active_slots',
+                      help='live sequences at this decode step'
+                      ).set(k)
+        if _chaos._active is not None:
+            _chaos.on_serve_slow(
+                self.param_version != self._boot_version)
+        t0 = clock()
+
+        def operand_args(tok, pos):
+            if self.paged:
+                return (jnp.asarray(tok), jnp.asarray(pos),
+                        jnp.asarray(tables))
+            if bucket == self.n_slots:
+                return (jnp.asarray(tok), jnp.asarray(pos))
+            return (jnp.asarray(tok),
+                    jnp.asarray(np.asarray(rows, np.int32)),
+                    jnp.asarray(pos))
+
+        # -- draft loop: k cheap steps propose the window -------------
+        d_exe = self._get_draft_decode(bucket)
+        proposals = np.zeros((bucket, kk), np.int32)
+        cur = base_tok
+        with _telemetry.span('serve_draft', kind='serve',
+                             stage='decode',
+                             iteration=self._step_index,
+                             active_slots=k, bucket=bucket,
+                             window=kk, **ident):
+            for j in range(kk):
+                # clamp overhang past the cache depth: the write lands
+                # on a not-yet-committed row, the proposal is garbage,
+                # and garbage past the boundary is never committed
+                pos = np.minimum(base_pos + j,
+                                 self.max_len - 1).astype(np.int32)
+                args = operand_args(cur, pos)
+                self.guard_signature(
+                    (self._draft_cache_struct(),) + tuple(
+                        jax.ShapeDtypeStruct(a.shape, a.dtype)
+                        for a in args))
+                toks, dcache = d_exe(self._draft_params,
+                                     self._draft_cache, *args)
+                self._draft_cache = dcache
+                cur = np.asarray(jax.block_until_ready(toks))
+                proposals[:, j] = cur
+                self.draft_steps += 1
+        # window row: [last committed token, draft_1 .. draft_{k-1}];
+        # the k-th draft proposal is never verified -- its draft step
+        # exists to keep the draft cache covering every position the
+        # window can commit
+        win = np.zeros((bucket, kk), np.int32)
+        win[:, 0] = base_tok
+        win[:, 1:] = proposals[:, :kk - 1]
+        # -- the ONE target pass --------------------------------------
+        v_exe = self._get_verify(bucket)
+        vargs = operand_args(win, base_pos)
+        self.guard_signature((self._cache_struct(),) + tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in vargs))
+        with _telemetry.span('serve_verify', kind='serve',
+                             iteration=self._step_index,
+                             active_slots=k, bucket=bucket,
+                             window=kk, n_slots=self.n_slots,
+                             queue_depth=self._last_queue_depth,
+                             **ident):
+            tgt, cache = v_exe(self.params, self._cache, *vargs)
+            tgt = np.asarray(jax.block_until_ready(tgt))
+        self._cache = cache
+        self.verify_steps += 1
+        now = clock()
+        now_tele = rec.now() if rec is not None else None
+        itl = (reg.histogram('serve_intertoken_seconds',
+                             help='per-sequence gap between '
+                                  'consecutive tokens (s)')
+               if reg is not None else None)
+        proposed_tick = accepted_tick = emitted_total = 0
+        # -- host-side accept-prefix + commit/rollback ----------------
+        for i, sid in enumerate(rows):
+            slot = self._slots.get(sid)
+            if slot is None:
+                continue   # pad row (or inactive full-bucket row)
+            drafts = win[i, 1:]       # the k-1 verified proposals
+            targets = tgt[i]          # target argmax after win[i, j]
+            m = 0
+            while m < kk - 1 and drafts[m] == targets[m]:
+                m += 1
+            proposed_tick += kk - 1
+            accepted_tick += m
+            emitted = ([int(x) for x in drafts[:m]]
+                       + [int(targets[m])])
+            # clip to the request's budget (a window near the end
+            # proposes more than max_new_tokens allows)
+            emitted = emitted[:min(len(emitted), slot.remaining)]
+            if self.eos_id is not None and self.eos_id in emitted:
+                # EOS inside the accepted prefix ends the request
+                # exactly where the oracle loop would have stopped
+                emitted = emitted[:emitted.index(self.eos_id) + 1]
+            c = len(emitted)
+            slot.generated.extend(emitted)
+            slot.position += c
+            slot.remaining -= c
+            emitted_total += c
+            if itl is not None:
+                gap = (now - slot.t_last_token) / c
+                for _ in range(c):
+                    itl.observe(gap)
+            slot.t_last_token = now
+            if rec is not None:
+                t_prev = slot.t_stage_end
+                if t_prev is None:
+                    t_prev = now_tele - (now - t0)
+                rec.child_span(slot.request.request_id, 'decode',
+                               t_prev, now_tele, slot=sid,
+                               step=self._step_index,
+                               token_index=len(slot.generated) - 1,
+                               tokens=c, accepted=m, **ident)
+                slot.t_stage_end = now_tele
+            if slot.remaining == 0 or (self.eos_id is not None
+                                       and emitted[-1] == self.eos_id):
+                slot.request.set_result(slot.generated)
+                if rec is not None:
+                    rec.event('complete', kind='request',
+                              request_id=slot.request.request_id,
+                              tokens=len(slot.generated), slot=sid,
+                              **ident)
+                self._release_pages(slot.pages)
+                del self._slots[sid]
+                self._free.append(sid)
+            elif self.paged:
+                # rollback the page-table tail to the accepted
+                # boundary: pages grown for rejected window positions
+                # go back to the pool NOW (refcounts track committed
+                # tokens, not speculation)
+                keep = (slot.position - 1) // self.page_size + 1
+                while len(slot.pages) > keep:
+                    self.pool.release(slot.pages.pop())
+        self.draft_proposed += proposed_tick
+        self.draft_accepted += accepted_tick
+        self.decode_steps += 1
+        self.tokens_generated += emitted_total
+        if reg is not None:
+            reg.histogram('serve_decode_seconds',
+                          help='per-decode-step wall time (s)'
+                          ).observe(now - t0)
+            reg.counter('serve_tokens_total',
+                        help='generated tokens').inc(emitted_total)
+            reg.counter(
+                'serve_draft_proposed_total',
+                help='draft tokens submitted to target verify'
+            ).inc(proposed_tick)
+            reg.counter(
+                'serve_draft_accepted_total',
+                help='draft tokens whose target argmax agreed'
+            ).inc(accepted_tick)
+        if rec is not None:
+            rec.event('serve_spec', kind='serve',
+                      iteration=self._step_index,
+                      proposed=proposed_tick, accepted=accepted_tick,
+                      tokens=emitted_total, **ident)
+
     def _flight_table(self):
         """The in-flight request table embedded in every flight dump
         (:attr:`Recorder.flight_sources`): which requests were alive,
@@ -1484,7 +2132,10 @@ class GenerationEngine:
         if self.paged and self._prefilling:
             worked = self._prefill_tick(clock)
         if self._slots:
-            self._decode_once(clock)
+            if self.speculative:
+                self._spec_once(clock)
+            else:
+                self._decode_once(clock)
             worked = True
         if not worked:
             return False
@@ -1553,6 +2204,33 @@ class GenerationEngine:
             'active_slots': len(self._slots),
         }
         base.update(paged)
+        if self.speculative:
+            rate = (self.draft_accepted / self.draft_proposed
+                    if self.draft_proposed else None)
+            base['speculative'] = {
+                'spec_tokens': self.spec_tokens,
+                'draft_steps': self.draft_steps,
+                'verify_steps': self.verify_steps,
+                'draft_proposed': self.draft_proposed,
+                'draft_accepted': self.draft_accepted,
+                'accepted_draft_rate': rate,
+                'draft_trace_count': self.draft_trace_count,
+                'verify_trace_count': self.verify_trace_count,
+                'draft_decode_buckets': sorted(self._draft_decode),
+                'verify_buckets': sorted(self._verify),
+                'aot': {
+                    'draft_prefill': {
+                        b: a for b, (_, a)
+                        in sorted(self._draft_prefill.items())},
+                    'draft_decode': {
+                        b: a for b, (_, a)
+                        in sorted(self._draft_decode.items())},
+                    'verify': {b: a for b, (_, a)
+                               in sorted(self._verify.items())},
+                },
+            }
+        else:
+            base['speculative'] = False
         return base
 
     # -- constructors --------------------------------------------------

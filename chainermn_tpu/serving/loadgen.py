@@ -223,8 +223,44 @@ def open_loop_generate(engine, queue, rate, n_requests, seed=0,
             'prefix_hits', 'prefix_hit_rate',
             'prefix_tokens_reused')} if st.get('paged') else None),
         'worst_request': worst,
+        'speculative': _spec_report(st, st0),
         'slo': (slo_monitor.evaluate() if slo_monitor is not None
                 else None),
+    }
+
+
+def _spec_report(st, st0):
+    """The speculative-decoding slice of a generate report: windowed
+    deltas of the engine's draft/verify accounting plus the two
+    derived ratios the bench row banks -- ``accepted_draft_rate``
+    (draft tokens whose target argmax agreed, over proposed) and
+    ``verify_per_token`` (target executable invocations per generated
+    token: < 1 IS the amortization).  ``None`` on non-speculative
+    engines."""
+    spec, spec0 = st.get('speculative'), st0.get('speculative')
+    if not spec:
+        return None
+    spec0 = spec0 or {}
+    proposed = (spec['draft_proposed']
+                - spec0.get('draft_proposed', 0))
+    accepted = (spec['draft_accepted']
+                - spec0.get('draft_accepted', 0))
+    verify_steps = (spec['verify_steps']
+                    - spec0.get('verify_steps', 0))
+    tokens = (st['tokens_generated'] - st0['tokens_generated'])
+    return {
+        'spec_tokens': spec['spec_tokens'],
+        'draft_steps': spec['draft_steps'] - spec0.get(
+            'draft_steps', 0),
+        'verify_steps': verify_steps,
+        'draft_proposed': proposed,
+        'draft_accepted': accepted,
+        'accepted_draft_rate': (accepted / proposed
+                                if proposed else None),
+        'verify_per_token': (verify_steps / tokens
+                             if tokens else None),
+        'draft_trace_count': spec['draft_trace_count'],
+        'verify_trace_count': spec['verify_trace_count'],
     }
 
 

@@ -45,9 +45,15 @@ STEP_PHASES = ('data_decode', 'host_batch_prep', 'h2d',
 #: phases (``serving/generate.py``): prefill spans carry the prompt
 #: bucket, decode spans the step index (``iteration``) and
 #: ``active_slots`` -- both feed the doctor's anomaly scan the way
-#: ``serve_execute`` batches do
+#: ``serve_execute`` batches do.  ``serve_draft``/``serve_verify``
+#: are the SPECULATIVE-decoding phases: the draft model's propose
+#: loop (one span wrapping all ``spec_tokens`` cheap steps, plus the
+#: lockstep draft prefill with ``stage='prefill'``) and the single
+#: target verify pass of the whole window (carrying the decode-tick
+#: attrs, so occupancy/tick dashboards keep working in spec mode)
 SERVE_PHASES = ('serve_queue_wait', 'serve_h2d', 'serve_execute',
-                'serve_warmup', 'serve_prefill', 'serve_decode')
+                'serve_warmup', 'serve_prefill', 'serve_decode',
+                'serve_draft', 'serve_verify')
 
 #: span kinds whose time counts as "compute the collective could
 #: hide behind"
@@ -466,6 +472,20 @@ def serve_summary(metrics):
                              if tokens and decode_wall > 0 else None),
             'active_slots': gauge.get('value'),
         }
+        # the speculative-decoding view: draft tokens submitted to
+        # the target verify pass vs those whose target argmax agreed
+        # -- the rate is the amortization lever (accepted tokens per
+        # expensive target pass); ``None`` rate when the engine
+        # proposed nothing (non-speculative captures omit the block)
+        proposed = total('serve_draft_proposed_total')
+        accepted = total('serve_draft_accepted_total')
+        if 'serve_draft_proposed_total' in serve:
+            out['generate']['speculative'] = {
+                'draft_proposed': proposed,
+                'draft_accepted': accepted,
+                'accepted_draft_rate': (accepted / proposed
+                                        if proposed else None),
+            }
     return out
 
 

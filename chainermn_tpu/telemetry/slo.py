@@ -372,6 +372,11 @@ class SLOMonitor:
         self.tokens = mk_c()
         self.completed = mk_c()
         self.shed = mk_c()
+        # speculative decoding (serving/generate.py spec mode): the
+        # per-tick ``serve_spec`` event feeds windowed proposed /
+        # accepted totals; evaluate() surfaces their ratio
+        self.draft_proposed = mk_c()
+        self.draft_accepted = mk_c()
         self._t_first = None
         self._t_last = None
         self._last_snapshot_t = None
@@ -390,8 +395,14 @@ class SLOMonitor:
         kind = rec.get('kind')
         if kind == 'request':
             self._ingest_request(rec)
-        elif kind == 'serve' and rec.get('name') == 'serve_decode':
+        elif kind == 'serve' and rec.get('name') in ('serve_decode',
+                                                     'serve_verify'):
+            # the speculative engine's verify span IS its decode tick
+            # (same active_slots/n_slots attrs), so occupancy keeps
+            # flowing in spec mode
             self._ingest_decode_tick(rec)
+        elif kind == 'serve' and rec.get('name') == 'serve_spec':
+            self._ingest_spec_tick(rec)
         else:
             return
         if (self.outdir is not None and self._t_last is not None
@@ -452,6 +463,16 @@ class SLOMonitor:
         active = rec.get('active_slots')
         if n_slots and active is not None:
             self.occupancy.observe(active / float(n_slots), rec['t1'])
+
+    def _ingest_spec_tick(self, rec):
+        """Per-tick speculative accounting (the ``serve_spec`` event):
+        draft tokens submitted to the target verify vs accepted."""
+        if 't' not in rec:
+            return
+        t = rec['t']
+        self._seen(t)
+        self.draft_proposed.inc(t, float(rec.get('proposed') or 0))
+        self.draft_accepted.inc(t, float(rec.get('accepted') or 0))
 
     # -- live attachment ----------------------------------------------
     def attach(self, recorder):
@@ -574,11 +595,28 @@ class SLOMonitor:
             summary.append(
                 'all %d SLOs ok over the fast/slow windows'
                 % len(rows) if rows else 'no serving records ingested')
+        speculative = None
+        if now is not None:
+            proposed = self.draft_proposed.total(
+                DEFAULT_SLOW_WINDOW_S, now)
+            accepted = self.draft_accepted.total(
+                DEFAULT_SLOW_WINDOW_S, now)
+            if proposed:
+                # informational, not an SLO verdict: the windowed
+                # accepted-draft-rate a canary dashboard reads next
+                # to the latency verdicts
+                speculative = {
+                    'window_s': DEFAULT_SLOW_WINDOW_S,
+                    'draft_proposed': proposed,
+                    'draft_accepted': accepted,
+                    'accepted_draft_rate': accepted / proposed,
+                }
         return {
             'now': now,
             'n_ingested': self.n_ingested,
             'window_first_t': self._t_first,
             'window_last_t': self._t_last,
+            'speculative': speculative,
             'slos': rows,
             'verdict': {
                 'overall': worst,
@@ -681,6 +719,14 @@ def render_slo_text(result):
                      % (name, row['verdict'].upper(), detail, burn,
                         '' if row.get('data', True)
                         else '  [no data: %s]' % row.get('detail')))
+    spec = result.get('speculative')
+    if spec:
+        lines.append(
+            '  speculative: accepted_draft_rate %.3f (%d/%d drafts '
+            'over %.0fs)' % (spec['accepted_draft_rate'],
+                             spec['draft_accepted'],
+                             spec['draft_proposed'],
+                             spec['window_s']))
     v = result['verdict']
     lines.append('verdict: %s' % v['overall'].upper())
     for s in v['summary']:

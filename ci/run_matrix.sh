@@ -230,6 +230,52 @@ print('slo smoke OK: verdict=%s (row %s), %d requests traced, worst '
 PY
 rm -rf "${SLO_DIR}"
 
+# SPECULATIVE DECODING SMOKE LEG (ISSUE 19): the paged speculative
+# engine under a real open-loop window, with the two acceptance
+# observables asserted straight off the bench row: (1) the in-bench
+# equivalence probe -- the speculative engine's outputs are
+# token-for-token identical to a non-speculative oracle twin's
+# (spec_equivalent, the exact-greedy pin, not a similarity bound);
+# (2) amortization accounting -- draft proposals flowed
+# (accepted_draft_rate is a number, possibly 0.0 with an untrained
+# draft) and verify_per_token < 1 (strictly fewer target passes than
+# tokens whenever anything was accepted; <= 1 always).  The capture
+# replay must also carry the serve_draft/serve_verify phases and the
+# accepted-draft-rate block in serve_summary.
+echo "=== speculative smoke: draft-propose / target-verify equivalence + accepted rate ==="
+SPEC_DIR=$(mktemp -d /tmp/spec_smoke.XXXXXX)
+python bench.py --serve --generate --speculative --quick --cpu \
+  --paged --serve-requests 24 --capture "${SPEC_DIR}" \
+  > "${SPEC_DIR}/bench_row.json"
+python -m chainermn_tpu.telemetry report "${SPEC_DIR}" > /dev/null
+python - "${SPEC_DIR}" <<'PY'
+import json, sys
+d = sys.argv[1]
+row = json.load(open(d + '/bench_row.json'))
+assert row.get('spec_equivalent') is True, (
+    'speculative output diverged from the oracle: %r'
+    % row.get('spec_equivalent'))
+spec = row.get('speculative')
+assert spec, 'speculative block missing from the generate row'
+assert spec['draft_proposed'] > 0, spec
+rate = row.get('accepted_draft_rate')
+assert rate is not None and 0.0 <= rate <= 1.0, rate
+vpt = row.get('verify_per_token')
+assert vpt is not None and vpt <= 1.0, vpt
+assert spec['verify_steps'] > 0, spec
+from chainermn_tpu.telemetry import report as trep
+assert 'serve_draft' in trep.SERVE_PHASES
+assert 'serve_verify' in trep.SERVE_PHASES
+rep = json.load(open(d + '/merged_report.json'))
+gen = ((rep.get('serve') or {}).get('generate')) or {}
+sb = gen.get('speculative')
+assert sb and sb['draft_proposed'] > 0, sb
+print('speculative smoke OK: equivalent=EXACT rate=%.3f '
+      'verify/token=%.3f (%d drafts proposed)'
+      % (rate, vpt, spec['draft_proposed']))
+PY
+rm -rf "${SPEC_DIR}"
+
 # FLEET LEG (ISSUE 13 acceptance): train-to-serve continuous
 # deployment proved end to end over REAL subprocess replicas -- one
 # `python -m chainermn_tpu.serving.fleet` invocation per scenario,

@@ -171,6 +171,40 @@ print('decode OK: decode_forward swept, no errors, SL010 family '
 " "$1"
 }
 
+# spec-verify gate (docs/serving.md "Speculative decoding"): the
+# speculative engine's k-token target-verify executable must be IN
+# the sweep and clean under every ERROR-severity rule and the SL010
+# multi-axis family -- the verify pass carries the same tp psums as
+# decode but at window shapes, and its make_args is iteration- AND
+# acceptance-independent, so SL007 here is the static twin of the
+# runtime guarantee that rollback / variable per-tick commit counts
+# never retrace.  SL008 tolerated as in check_decode (lm-head f32
+# contraction, now over k positions); anything else fails the gate.
+check_spec() {
+  python -c "
+import json, sys
+report = json.load(open(sys.argv[1]))
+assert 'step:spec_verify_forward' in report['targets'], \
+    report['targets']
+fs = [f for f in report['findings']
+      if f['target'] == 'step:spec_verify_forward']
+errors = [f for f in fs if f['severity'] == 'error']
+assert not errors, (
+    'spec_verify_forward must carry no error findings: %r' % errors)
+multi = [f for f in fs if f['rule'] in ('SL010', 'SL011', 'SL012')]
+assert not multi, (
+    'spec_verify_forward must lint clean under the SL010 family: %r'
+    % multi)
+unexpected = [f for f in fs if f['rule'] != 'SL008']
+assert not unexpected, (
+    'spec_verify_forward grew findings beyond the tolerated SL008 '
+    'set: %r' % unexpected)
+print('spec OK: spec_verify_forward swept, no errors, SL010 family '
+      'clean (%d SL008 warning(s))'
+      % len([f for f in fs if f['rule'] == 'SL008']))
+" "$1"
+}
+
 # commcheck gate (docs/static_analysis.md "Cross-rank verification"):
 # the cross-rank communication verifier must have swept EVERY
 # registered strategy and the eager reference protocol at world sizes
@@ -256,6 +290,7 @@ check_sl009 "$out_f32"
 check_sl010 "$out_f32"
 check_serve "$out_f32"
 check_decode "$out_f32"
+check_spec "$out_f32"
 check_commcheck "$out_f32"
 JAX_PLATFORMS=cpu python -m chainermn_tpu.analysis --json --policy bf16 | tee "$out_bf16"
 check_memtraffic "$out_bf16"
@@ -263,5 +298,6 @@ check_sl009 "$out_bf16"
 check_sl010 "$out_bf16"
 check_serve "$out_bf16"
 check_decode "$out_bf16"
+check_spec "$out_bf16"
 check_commcheck "$out_bf16"
 check_commcheck_fires

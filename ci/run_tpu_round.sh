@@ -346,6 +346,17 @@ run bench_serve_generate_int8kv $QT python bench.py --serve --generate --quick -
 run bench_serve_generate_paged $QT python bench.py --serve --generate --quick --paged --prefill-chunk 8
 run bench_serve_generate_paged_int8kv $QT python bench.py --serve --generate --quick --paged --prefill-chunk 8 --int8-kv
 
+# speculative decoding (ISSUE 19): the last serving-memory-economy
+# lever -- a half-depth draft proposes k tokens, the target verifies
+# the window in ONE pass, so accepted tokens amortize the HBM-bound
+# cache read.  The row's in-bench probe pins exact greedy equivalence
+# vs the non-speculative oracle twin (spec_equivalent=true or the arm
+# fails), and accepted_draft_rate / verify_per_token ride as the
+# amortization sidecars; the paged twin composes with prefix sharing
+# + chunked prefill, pairing column-wise with the arms above.
+run bench_serve_generate_spec $QT python bench.py --serve --generate --quick --speculative
+run bench_serve_generate_paged_spec $QT python bench.py --serve --generate --quick --speculative --paged --prefill-chunk 8
+
 # continuous deployment (ISSUE 13): how fast weights roll through a
 # 2-replica serving fleet under live traffic -- rolls/minute with
 # the contract sidecars (dropped_during_swap MUST be 0, per-replica

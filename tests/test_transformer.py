@@ -699,6 +699,156 @@ class TestPagedDecode:
                                        rtol=5e-2, atol=5e-2)
 
 
+class TestSpecVerify:
+    """Speculative-decoding verify twin (ISSUE 19): ``spec_verify`` /
+    ``spec_verify_paged`` score a k-token window in ONE pass and must
+    reproduce the sequential teacher-forced ``decode_step`` /
+    ``decode_step_paged`` trajectory over the same tokens -- logits
+    close, ARGMAX exactly equal (the accept rule compares argmaxes,
+    so argmax parity, not a logit tolerance, is what exact greedy
+    equivalence rests on).  int8-KV included: the verify pass
+    quantize-roundtrips its fresh K/V so in-window attention reads
+    bitwise-match what the oracle wrote to the cache."""
+
+    PS = 8
+    K = 4
+
+    def _model(self, max_len=64):
+        return TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                             n_layers=2, d_ff=64, max_len=max_len,
+                             dtype=jnp.float32)
+
+    @pytest.mark.parametrize('paged', [False, True])
+    @pytest.mark.parametrize('int8_kv', [False, True])
+    def test_window_matches_sequential_decode(self, paged, int8_kv):
+        from chainermn_tpu.models import (
+            decode_step, decode_step_paged, init_kv_cache,
+            init_paged_kv_cache, prefill, prefill_paged, spec_verify,
+            spec_verify_paged)
+        model = self._model()
+        rng = np.random.RandomState(20)
+        toks = rng.randint(0, 64, size=6 + self.K).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.asarray([toks]))['params']
+        t_pre = 6
+        pad = np.zeros((1, t_pre), np.int32)
+        pad[0] = toks[:t_pre]
+        table = np.array([2, 1, 3, 4], np.int32)
+        if paged:
+            mk = lambda: init_paged_kv_cache(  # noqa: E731
+                model, n_pages=5, page_size=self.PS, int8_kv=int8_kv)
+            c_seq = c_win = mk()
+            _, c_seq = prefill_paged(
+                model, params, c_seq, jnp.asarray(pad),
+                jnp.asarray(t_pre, jnp.int32),
+                jnp.asarray(table, jnp.int32),
+                jnp.asarray(0, jnp.int32))
+            _, c_win = prefill_paged(
+                model, params, mk(), jnp.asarray(pad),
+                jnp.asarray(t_pre, jnp.int32),
+                jnp.asarray(table, jnp.int32),
+                jnp.asarray(0, jnp.int32))
+        else:
+            mk = lambda: init_kv_cache(  # noqa: E731
+                model, n_slots=2, int8_kv=int8_kv)
+            _, c_seq = prefill(model, params, mk(), jnp.asarray(pad),
+                               jnp.asarray(t_pre), jnp.asarray(1))
+            _, c_win = prefill(model, params, mk(), jnp.asarray(pad),
+                               jnp.asarray(t_pre), jnp.asarray(1))
+        # oracle: teacher-force the window one decode step at a time
+        want = []
+        for j in range(self.K):
+            p = t_pre + j
+            if paged:
+                lg, c_seq = decode_step_paged(
+                    model, params, c_seq,
+                    jnp.asarray([toks[p]], jnp.int32),
+                    jnp.asarray([p], jnp.int32),
+                    jnp.asarray([table], jnp.int32))
+            else:
+                lg, c_seq = decode_step(
+                    model, params, c_seq,
+                    jnp.asarray([toks[p]], jnp.int32),
+                    jnp.asarray([p], jnp.int32),
+                    slots=jnp.asarray([1], jnp.int32))
+            want.append(np.asarray(lg[0]))
+        # one verify pass over the same window
+        win = jnp.asarray([toks[t_pre:t_pre + self.K]], jnp.int32)
+        base = jnp.asarray([t_pre], jnp.int32)
+        if paged:
+            got, c_win = spec_verify_paged(
+                model, params, c_win, win, base,
+                jnp.asarray([table], jnp.int32))
+        else:
+            got, c_win = spec_verify(model, params, c_win, win, base,
+                                     slots=jnp.asarray([1],
+                                                       jnp.int32))
+        got = np.asarray(got)[0]
+        for j in range(self.K):
+            np.testing.assert_allclose(got[j], want[j], rtol=1e-5,
+                                       atol=1e-5)
+            assert int(got[j].argmax()) == int(want[j].argmax()), j
+        # the verify WRITES the window into the cache: continuing
+        # with plain decode from either cache must agree (the engine's
+        # full-acceptance path never re-writes accepted positions)
+        p = t_pre + self.K
+        nxt = jnp.asarray([int(got[-1].argmax())], jnp.int32)
+        if paged:
+            lg_a, _ = decode_step_paged(
+                model, params, c_seq, nxt,
+                jnp.asarray([p], jnp.int32),
+                jnp.asarray([table], jnp.int32))
+            lg_b, _ = decode_step_paged(
+                model, params, c_win, nxt,
+                jnp.asarray([p], jnp.int32),
+                jnp.asarray([table], jnp.int32))
+        else:
+            lg_a, _ = decode_step(
+                model, params, c_seq, nxt,
+                jnp.asarray([p], jnp.int32),
+                slots=jnp.asarray([1], jnp.int32))
+            lg_b, _ = decode_step(
+                model, params, c_win, nxt,
+                jnp.asarray([p], jnp.int32),
+                slots=jnp.asarray([1], jnp.int32))
+        np.testing.assert_allclose(np.asarray(lg_b), np.asarray(lg_a),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_full_bucket_variant_matches_compacted(self):
+        """The full-slot verify executable (cache read in place, no
+        slots operand) must produce the same logits as the compacted
+        variant for the same live rows."""
+        from chainermn_tpu.models import (init_kv_cache, prefill,
+                                          spec_verify)
+        model = self._model()
+        rng = np.random.RandomState(21)
+        toks = rng.randint(0, 64, size=10).astype(np.int32)
+        params = model.init(jax.random.PRNGKey(1),
+                            jnp.asarray([toks]))['params']
+        pad = np.zeros((1, 6), np.int32)
+        pad[0] = toks[:6]
+        c_a = c_b = None
+        _, c_a = prefill(model, params,
+                         init_kv_cache(model, n_slots=2),
+                         jnp.asarray(pad), jnp.asarray(6),
+                         jnp.asarray(0))
+        _, c_b = prefill(model, params,
+                         init_kv_cache(model, n_slots=2),
+                         jnp.asarray(pad), jnp.asarray(6),
+                         jnp.asarray(0))
+        win = jnp.asarray([toks[6:10]], jnp.int32)
+        base = jnp.asarray([6], jnp.int32)
+        lg_c, _ = spec_verify(model, params, c_a, win, base,
+                              slots=jnp.asarray([0], jnp.int32))
+        win2 = jnp.asarray([toks[6:10], np.zeros(4, np.int32)],
+                           jnp.int32)
+        lg_f, _ = spec_verify(model, params, c_b, win2,
+                              jnp.asarray([6, 0], jnp.int32))
+        np.testing.assert_allclose(np.asarray(lg_f)[0],
+                                   np.asarray(lg_c)[0],
+                                   rtol=1e-6, atol=1e-6)
+
+
 def test_ulysses_matches_single_device():
     """sp_scheme='ulysses' (all_to_all head resharding) must also
     reproduce the unsharded model: 2 heads over 2 devices."""
