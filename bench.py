@@ -169,6 +169,14 @@ _log.t0 = time.monotonic()
 
 
 def metric_stub(model):
+    if model == 'serve_fleet_recovery':
+        # the self-healing arm (--serve --fleet --recovery): the
+        # product number is how fast a hard-killed replica's
+        # generations resume on a survivor -- kill to first
+        # recovered token (docs/fault_tolerance.md "Serving
+        # self-healing")
+        return {'metric': 'serve_fleet_recovery_mttr_ms',
+                'unit': 'ms'}
     if model == 'serve_fleet':
         # the continuous-deployment arm (--serve --fleet): the
         # product number is how fast weights can roll through a
@@ -2555,6 +2563,155 @@ def measure_fleet(argv):
     emit(row, rc=0 if ok else 1)
 
 
+def measure_fleet_recovery(argv):
+    """``--serve --fleet --recovery``: the serving self-healing row
+    (ISSUE 20).
+
+    Boots the journaled demo-LM fleet with a live
+    :class:`~chainermn_tpu.serving.fleet.ReplicaSupervisor`, hard-
+    kills a replica MID-DECODE under open-loop traffic, and times the
+    healing machine.  Row value = MTTR in ms from the kill to the
+    first journaled token of a requeued continuation on a survivor.
+    Sidecars: detection latency, requeued/shed counts, respawn count,
+    degradation-rung occupancy, and ``lost_requests`` -- which is a
+    HARD rc-1 gate: a journal with open entries after recovery means
+    the self-healing contract is broken, whatever the MTTR says."""
+    quick = '--quick' in argv
+    stub = metric_stub('serve_fleet_recovery')
+
+    import tempfile
+    import threading
+
+    from chainermn_tpu.utils.platform import enable_host_cpu_backend
+    enable_host_cpu_backend()
+    if '--cpu' in argv:
+        from chainermn_tpu.utils import force_host_devices
+        force_host_devices(8)
+    import jax
+
+    from chainermn_tpu import telemetry
+    from chainermn_tpu.serving import fleet as fleet_mod
+    from chainermn_tpu.utils.ledger import Ledger, events
+
+    telemetry.enable()
+    n_replicas = int(_flag_value(argv, '--fleet-replicas', 2, int))
+    rate = _flag_value(argv, '--serve-rate', 30.0)
+    max_new = 8
+    work = tempfile.mkdtemp(prefix='bench_fleet_recovery_')
+    ck, out = (os.path.join(work, 'ckpt'), os.path.join(work, 'out'))
+    fleet_mod.demo_train(ck, steps=2, snapshot_every=2)
+    controller = fleet_mod.build_local_fleet(
+        ck, out, n_replicas=n_replicas, n_slots=2,
+        max_prompt_len=16, journal=True)
+    controller.watcher.debounce_s = 0.15
+    controller.start()
+    degradation = fleet_mod.DegradationPolicy()
+    supervisor = fleet_mod.ReplicaSupervisor(
+        controller,
+        spawn_fn=fleet_mod.local_respawn_fn(n_slots=2,
+                                            max_prompt_len=16),
+        degradation=degradation).start()
+    _log('fleet-recovery: %d replicas at version %d; offering %.0f '
+         'req/s' % (n_replicas, controller.current_version, rate))
+
+    stop = threading.Event()
+    ctl_thread = threading.Thread(target=controller.run,
+                                  args=(stop,), daemon=True)
+    ctl_thread.start()
+    # traffic prompts stay short: a continuation prefill needs
+    # prompt + emitted <= max_prompt_len headroom
+    traffic = fleet_mod._TrafficGen(
+        controller.front, rate=rate, max_new_tokens=max_new,
+        prompt_len_range=(1, 4)).start()
+    victim = controller.front.replicas[-1]
+    journal = controller.front.journal
+    killed_inflight = 0
+    t_kill = None
+    try:
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:   # arm: wait MID-decode
+            inf = journal.inflight(replica=victim.name)
+            if any(e['emitted'] for e in inf.values()):
+                break
+            time.sleep(0.002)
+        t_kill = time.time()
+        victim.kill()
+        killed_inflight = len(journal.inflight(replica=victim.name))
+        _log('fleet-recovery: killed %s with %d in flight'
+             % (victim.name, killed_inflight))
+        t_end = time.monotonic() + (3.0 if quick else 8.0)
+        while time.monotonic() < t_end:
+            if supervisor.aborted:
+                break
+            time.sleep(0.05)
+    finally:
+        traffic.stop()
+        supervisor.stop()
+        stop.set()
+        ctl_thread.join(timeout=30.0)
+        controller.complete(traffic=traffic.stats())
+        controller.close()
+
+    ledger = Ledger.read(os.path.join(out, fleet_mod.LEDGER_NAME))
+    dead_ev = events(ledger, 'replica_dead')
+    requeues = events(ledger, 'requeue')
+    requeue_ids = [e['request_id'] for e in requeues]
+    jevents = Ledger.read(os.path.join(out, fleet_mod.JOURNAL_NAME))
+    # first token journaled AFTER a request's own requeue event --
+    # gating on the kill time instead would count the victim's final
+    # pre-death frame as "recovered"
+    t_first = min(
+        (min((e['t'] for e in jevents
+              if e.get('event') == 'token'
+              and e.get('request_id') == rq['request_id']
+              and e['t'] >= rq['t']), default=float('inf'))
+         for rq in requeues), default=None)
+    if t_first == float('inf'):
+        t_first = None
+    mttr_ms = (round((t_first - t_kill) * 1e3, 3)
+               if t_first is not None else None)
+    detect_ms = (round((dead_ev[0]['t'] - t_kill) * 1e3, 3)
+                 if dead_ev and t_kill is not None else None)
+    d = supervisor.describe()
+    tstats = traffic.stats()
+    row = dict(
+        stub,
+        value=mttr_ms if mttr_ms is not None else 0.0,
+        vs_baseline=0.0,
+        baseline_derivation='none: first serving self-healing '
+                            'metric family round (reference has no '
+                            'serving path)',
+        n_devices=jax.device_count(),
+        backend=jax.default_backend(),
+        device_kind=jax.devices()[0].device_kind,
+        quick=quick,
+        n_replicas=n_replicas,
+        killed_inflight=killed_inflight,
+        detect_ms=detect_ms,
+        requeued=len(requeue_ids),
+        requeue_shed=len(d['shed']),
+        deaths=d['deaths'],
+        respawns=d['respawns'],
+        lost_requests=d['lost_requests'],
+        rung_occupancy_s=d['degradation']['occupancy_s'],
+        degradation_transitions=d['degradation']['transitions'],
+        offered=tstats['offered'],
+        served=tstats['served'],
+        traffic_errors=tstats['errors'],
+        offered_req_per_s=round(rate, 2),
+    )
+    ok = (d['lost_requests'] == 0 and not d['aborted']
+          and d['respawns'] >= 1 and mttr_ms is not None
+          and tstats['errors'] == 0)
+    if d['lost_requests']:
+        row['error'] = 'fleet_recovery_lost_requests'
+    elif mttr_ms is None:
+        row['error'] = 'fleet_recovery_no_recovered_token'
+    elif d['aborted']:
+        row['error'] = 'fleet_recovery_aborted'
+    emit(row, rc=0 if ok else 1)
+
+
 def generate_family(argv):
     """Metric-family name for the autoregressive arm: the --int8-kv
     and --paged A/Bs bank under their own tags so sidecars never
@@ -2845,6 +3002,11 @@ def measure_generate(argv):
 def main():
     argv = [a for a in sys.argv[1:]]
     if '--recovery' in argv:
+        if '--serve' in argv and '--fleet' in argv:
+            # the serving self-healing arm: in-process fleet, so
+            # self-contained like the training recovery row below
+            measure_fleet_recovery(argv)
+            return
         # self-contained CPU-subprocess scenario: no backend probe,
         # no watchdog child (the supervisor bounds its own attempts)
         measure_recovery(argv)

@@ -52,17 +52,31 @@ Three cooperating layers (``docs/serving.md``):
   deterministic hash-slice canary judged by per-version SLO monitors
   (:class:`CanaryJudge`), automatic rollback on breach, and an
   append-only ``fleet_ledger.jsonl``.  CLI: ``python -m
-  chainermn_tpu.serving.fleet``.
+  chainermn_tpu.serving.fleet``.  SERVING SELF-HEALING rides the same
+  module: a crash-safe fsynced :class:`RequestJournal` records every
+  admission and streamed token batch so a dead replica's in-flight
+  generations recover by EXACT REPLAY (teacher-forced continuation of
+  ``prompt + emitted`` on a survivor, token-for-token identical to the
+  uninterrupted run, one seamless :class:`FrontHandle` stream); a
+  :class:`ReplicaSupervisor` detects deaths, respawns replacements
+  from the incumbent snapshot under the training ``RestartPolicy``
+  (crash-loop abort), and drives the typed hysteresis-reversible
+  :class:`DegradationPolicy` ladder (none -> evict_prefix -> no_spec
+  -> shrink_admission -> shed) off the live SLO verdict and KV-page
+  pressure.
 """
 
 from chainermn_tpu.serving.batcher import (  # noqa: F401
-    PackedBatch, Request, RequestQueue, bucket_edges, bucket_of,
-    next_request_id, pack_sizes, record_shed)
+    PackedBatch, Request, RequestQueue, admission_order, bucket_edges,
+    bucket_of, next_request_id, pack_sizes, record_shed)
 from chainermn_tpu.serving.engine import (  # noqa: F401
     InferenceEngine, load_params)
 from chainermn_tpu.serving.fleet import (  # noqa: F401
-    CanaryJudge, CheckpointWatcher, FleetController, FleetFront,
-    LocalReplica, SubprocessReplica, build_local_fleet, canary_slice)
+    CanaryJudge, CheckpointWatcher, DegradationPolicy, FleetController,
+    FleetFront, FrontHandle, LocalReplica, ReplicaSupervisor,
+    RequestJournal, SubprocessReplica, apply_degradation_rung,
+    build_local_fleet, canary_slice, local_respawn_fn,
+    strip_oneshot_kills)
 from chainermn_tpu.serving.generate import (  # noqa: F401
     GenerationEngine, GenerationQueue, GenRequest)
 from chainermn_tpu.serving.loadgen import (  # noqa: F401

@@ -68,6 +68,20 @@ def next_request_id():
     return 'r%d' % next(_request_counter)
 
 
+def admission_order(request_id):
+    """Sort key recovering the monotonic admission stamp from a
+    :func:`next_request_id` id (``'r7'`` -> ``(0, 7)``) -- what the
+    fleet's exact-replay recovery sorts a dead replica's in-flight
+    worklist by, so requeue order is deterministic and matches the
+    original admission order regardless of dict/journal iteration
+    order.  Foreign ids (not ``r<N>``-shaped) sort after every native
+    one, lexicographically."""
+    try:
+        return (0, int(str(request_id).lstrip('r')))
+    except (TypeError, ValueError):
+        return (1, str(request_id))
+
+
 def record_shed(reason, request_id=None, queue_depth=None,
                 count_total=True, **attrs):
     """Shed forensics, one call per turned-away request: bump the

@@ -83,13 +83,17 @@ import zlib
 import numpy as np
 
 from chainermn_tpu import telemetry as _telemetry
-from chainermn_tpu.serving.batcher import next_request_id, record_shed
+from chainermn_tpu.serving.batcher import (admission_order,
+                                           next_request_id, record_shed)
 from chainermn_tpu.utils import chaos as _chaos
 from chainermn_tpu.utils import failure
-from chainermn_tpu.utils.failure import OverloadError, WeightSwapError
+from chainermn_tpu.utils.failure import (OverloadError,
+                                         ReplicaDeadError,
+                                         WeightSwapError)
 from chainermn_tpu.utils.ledger import Ledger
 
 LEDGER_NAME = 'fleet_ledger.jsonl'
+JOURNAL_NAME = 'request_journal.jsonl'
 
 #: hash-slice resolution: canary fractions are exact to 1/10000
 CANARY_MOD = 10000
@@ -108,6 +112,320 @@ def canary_slice(request_id, fraction):
         return True
     return (zlib.crc32(str(request_id).encode()) % CANARY_MOD
             < int(fraction * CANARY_MOD))
+
+
+# ----------------------------------------------------------------------
+# the crash-safe request journal (the recovery source)
+# ----------------------------------------------------------------------
+
+class RequestJournal:
+    """Crash-safe admission journal at the front -- the RECOVERY
+    source for exact-replay requeue (the flight-recorder request
+    table stays the *forensic* twin).
+
+    One fsynced JSON line per state change, on
+    :class:`~chainermn_tpu.utils.ledger.Ledger` underneath, so the
+    append-survives-``os._exit`` and torn-tail-tolerant-read
+    guarantees are inherited rather than re-implemented:
+
+    - ``admit``: ``request_id``, prompt tokens, ``max_new``, absolute
+      deadline (front clock), assigned ``replica``, params
+      ``version``;
+    - ``token``: the tokens a replica streamed back this scheduler
+      tick -- after a death the journal knows each request's
+      committed ``prompt + emitted`` prefix, which IS the
+      continuation prompt that exact-replay recovery teacher-forces
+      into a survivor;
+    - ``reassign``: the requeue target after a replica death;
+    - ``done``: terminal outcome (``served`` / ``shed`` / ``error``)
+      with attribution fields.
+
+    The in-memory mirror answers :meth:`inflight` without re-reading
+    the file; :meth:`replay` rebuilds the same mirror from disk --
+    what a restarted front would know.
+    """
+
+    def __init__(self, path):
+        self.path = path
+        self._ledger = Ledger(path)
+        self._lock = threading.Lock()
+        self._live = {}   # request_id -> entry
+        self.admitted = 0
+        self.completed = 0
+
+    def admit(self, request_id, prompt, max_new_tokens, deadline,
+              replica, version):
+        toks = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        with self._lock:
+            self._live[request_id] = {
+                'prompt': toks, 'max_new': int(max_new_tokens),
+                'deadline': deadline, 'replica': replica,
+                'version': version, 'emitted': []}
+            self.admitted += 1
+        self._ledger.append('admit', request_id=request_id,
+                            prompt=toks, max_new=int(max_new_tokens),
+                            deadline=deadline, replica=replica,
+                            version=version)
+
+    def tokens(self, request_id, tokens):
+        """The per-tick ``token`` frame sink (the shape of the
+        engines' ``on_token`` callback and of the subprocess stream
+        frames, so it plugs into either directly)."""
+        toks = [int(t) for t in tokens]
+        with self._lock:
+            e = self._live.get(request_id)
+            if e is None:
+                return
+            e['emitted'].extend(toks)
+        self._ledger.append('token', request_id=request_id,
+                            tokens=toks)
+
+    def reassign(self, request_id, replica):
+        with self._lock:
+            e = self._live.get(request_id)
+            if e is not None:
+                e['replica'] = replica
+        self._ledger.append('reassign', request_id=request_id,
+                            replica=replica)
+
+    def done(self, request_id, outcome='served', **fields):
+        """Close a request; False when it was already closed -- the
+        idempotency guard that makes a requeue racing a late
+        completion frame harmless (greedy twins carry identical
+        tokens, and only the first closer resolves the handle)."""
+        with self._lock:
+            if request_id not in self._live:
+                return False
+            del self._live[request_id]
+            self.completed += 1
+        self._ledger.append('done', request_id=request_id,
+                            outcome=outcome, **fields)
+        return True
+
+    def inflight(self, replica=None):
+        """Snapshot of the open requests (optionally one replica's)
+        -- the requeue worklist at a death."""
+        with self._lock:
+            return {rid: dict(e, emitted=list(e['emitted']))
+                    for rid, e in self._live.items()
+                    if replica is None or e['replica'] == replica}
+
+    @staticmethod
+    def replay(path):
+        """Rebuild the in-flight mirror from disk (torn tails from a
+        killed writer skipped, inherited from ``Ledger.read``): what
+        a RESTARTED front knows about committed prefixes."""
+        live = {}
+        for e in Ledger.read(path):
+            rid, ev = e.get('request_id'), e.get('event')
+            if ev == 'admit':
+                live[rid] = {'prompt': list(e.get('prompt') or []),
+                             'max_new': e.get('max_new'),
+                             'deadline': e.get('deadline'),
+                             'replica': e.get('replica'),
+                             'version': e.get('version'),
+                             'emitted': []}
+            elif ev == 'token' and rid in live:
+                live[rid]['emitted'].extend(e.get('tokens') or [])
+            elif ev == 'reassign' and rid in live:
+                live[rid]['replica'] = e.get('replica')
+            elif ev == 'done':
+                live.pop(rid, None)
+        return live
+
+
+class FrontHandle:
+    """The completion handle a journaled front hands out: the same
+    ``done()`` / ``result()`` surface as ``GenRequest`` / ``_Cell``,
+    but OWNED by the front, so a replica death re-binds it to the
+    requeued continuation invisibly -- the caller sees one seamless
+    stream (journaled prefix + continuation tokens), never a
+    duplicated or dropped token."""
+
+    __slots__ = ('request_id', '_evt', '_tokens', '_error')
+
+    def __init__(self, request_id):
+        self.request_id = request_id
+        self._evt = threading.Event()
+        self._tokens = None
+        self._error = None
+
+    def _complete(self, tokens):
+        if self._evt.is_set():
+            return
+        self._tokens = np.asarray([int(t) for t in tokens], np.int32)
+        self._evt.set()
+
+    def _fail(self, exc):
+        if self._evt.is_set():
+            return
+        self._error = exc
+        self._evt.set()
+
+    def done(self):
+        return self._evt.is_set()
+
+    def result(self, timeout=None):
+        if not self._evt.wait(timeout):
+            raise TimeoutError('request %s not completed within %rs'
+                               % (self.request_id, timeout))
+        if self._error is not None:
+            raise self._error
+        return self._tokens
+
+
+# ----------------------------------------------------------------------
+# the load-degradation ladder
+# ----------------------------------------------------------------------
+
+#: the ladder's rungs, mildest first.  0 is healthy; 1-3 trade reuse/
+#: speculation/admission concurrency for headroom on the ENGINES; 4
+#: sheds a deterministic hash-slice of new admissions at the FRONT.
+DEGRADATION_RUNGS = ('none', 'evict_prefix', 'no_spec',
+                     'shrink_admission', 'shed')
+
+
+def apply_degradation_rung(engine, rung, saved):
+    """Walk one engine's load knobs to degradation rung ``rung``
+    (idempotent -- every knob is set to its value AT that rung, so
+    skipped intermediate calls cannot leave a stale knob behind).
+    ``saved`` is a per-engine dict remembering the healthy values for
+    the walk back.  Rungs: 1 evicts the radix prefix index (banked
+    pages return to the pool; live sequences keep theirs), 2 disables
+    speculative decoding (the target cache stays authoritative, so
+    greedy output is unchanged), 3 halves ``spec_tokens`` and caps
+    admission at one request per tick.  Rung 4 (shed) is applied at
+    the FRONT, not here."""
+    if 'speculative' not in saved:
+        saved['speculative'] = bool(engine.speculative)
+        saved['spec_tokens'] = int(engine.spec_tokens)
+        saved['admit_cap'] = engine.admit_cap
+    rung = max(0, min(int(rung), len(DEGRADATION_RUNGS) - 1))
+    idx = getattr(engine, '_prefix_index', None)
+    if rung >= 1 and idx is not None:
+        while idx.evict(1):
+            pass
+    engine.speculative = saved['speculative'] and rung < 2
+    if saved['spec_tokens']:
+        engine.spec_tokens = (saved['spec_tokens'] if rung < 3
+                              else max(2, saved['spec_tokens'] // 2))
+    engine.admit_cap = saved['admit_cap'] if rung < 3 else 1
+    return rung
+
+
+class DegradationPolicy:
+    """Typed, hysteresis-reversible load-degradation ladder over
+    :data:`DEGRADATION_RUNGS`, driven by the live
+    :class:`~chainermn_tpu.telemetry.slo.SLOMonitor` burn-rate
+    verdict and KV-page pressure.
+
+    Escalation: any observation with an SLO ``breach`` verdict or
+    with free KV pages under ``kv_free_floor`` climbs ONE rung.
+    Recovery walks back one rung only after ``recover_healthy``
+    CONSECUTIVE observations whose verdict is ``ok`` -- the
+    multi-window burn-rate verdict is ``ok`` only when both the fast
+    and slow windows are healthy, which is the hysteresis that stops
+    the ladder from oscillating on the edge of a breach.
+
+    Every transition is a ``degrade`` ledger event and moves the
+    ``fleet_degradation_rung`` gauge; per-rung wall-clock occupancy
+    is accumulated for the bench sidecars.
+    """
+
+    def __init__(self, ledger=None, kv_free_floor=0.125,
+                 recover_healthy=2, shed_fraction=0.5,
+                 clock=time.monotonic):
+        self.ledger = ledger
+        self.kv_free_floor = float(kv_free_floor)
+        self.recover_healthy = int(recover_healthy)
+        self.shed_fraction = float(shed_fraction)
+        self._clock = clock
+        self.rung = 0
+        self.transitions = 0
+        self._healthy_streak = 0
+        self._t_entered = clock()
+        self.occupancy_s = {name: 0.0 for name in DEGRADATION_RUNGS}
+
+    @property
+    def rung_name(self):
+        return DEGRADATION_RUNGS[self.rung]
+
+    def sheds(self, request_id):
+        """At the ``shed`` rung: True for the deterministic
+        ``shed_fraction`` hash-slice of request ids (same ring
+        discipline as :func:`canary_slice` -- retries of an id are
+        shed consistently, and no rng is involved)."""
+        if self.rung < len(DEGRADATION_RUNGS) - 1:
+            return False
+        return (zlib.crc32(('shed:%s' % request_id).encode())
+                % CANARY_MOD < int(self.shed_fraction * CANARY_MOD))
+
+    def observe(self, overall, breaches=(), kv_in_use=None,
+                kv_total=None):
+        """One observation of the live signals.  ``overall`` is the
+        worst SLO verdict across serving replicas (``'ok'`` /
+        ``'warn'`` / ``'breach'`` / None when monitors are quiet).
+        Returns the new rung after a transition, None when the
+        ladder did not move."""
+        reasons = []
+        if overall == 'breach':
+            reasons.append('slo_breach:%s'
+                           % ','.join(sorted(set(breaches))))
+        if kv_total:
+            free = (kv_total - (kv_in_use or 0)) / float(kv_total)
+            if free < self.kv_free_floor:
+                reasons.append('kv_pressure:%.0f%%_free'
+                               % (100 * free))
+        if reasons:
+            self._healthy_streak = 0
+            if self.rung < len(DEGRADATION_RUNGS) - 1:
+                return self._move(self.rung + 1, 'escalate', reasons)
+            return None
+        if overall == 'ok':
+            self._healthy_streak += 1
+            if (self.rung > 0
+                    and self._healthy_streak >= self.recover_healthy):
+                self._healthy_streak = 0
+                return self._move(
+                    self.rung - 1, 'recover',
+                    ['healthy_windows:%d' % self.recover_healthy])
+        return None
+
+    def _move(self, new, direction, reasons):
+        now = self._clock()
+        old = self.rung
+        self.occupancy_s[DEGRADATION_RUNGS[old]] += \
+            now - self._t_entered
+        self._t_entered = now
+        self.rung = new
+        self.transitions += 1
+        if self.ledger is not None:
+            self.ledger.append(
+                'degrade', direction=direction, from_rung=old,
+                to_rung=new, from_name=DEGRADATION_RUNGS[old],
+                to_name=DEGRADATION_RUNGS[new], reasons=reasons)
+        reg = _telemetry.registry()
+        if reg is not None:
+            reg.gauge('fleet_degradation_rung',
+                      help='current load-degradation ladder rung '
+                           '(0 none .. 4 shed)').set(new)
+        return new
+
+    def occupancy(self):
+        """Per-rung wall seconds including the currently-open rung --
+        the bench sidecar payload."""
+        now = self._clock()
+        out = dict(self.occupancy_s)
+        out[DEGRADATION_RUNGS[self.rung]] += now - self._t_entered
+        return {k: round(v, 4) for k, v in out.items()}
+
+    def describe(self):
+        return {'rung': self.rung, 'rung_name': self.rung_name,
+                'transitions': self.transitions,
+                'kv_free_floor': self.kv_free_floor,
+                'recover_healthy': self.recover_healthy,
+                'shed_fraction': self.shed_fraction,
+                'occupancy_s': self.occupancy()}
 
 
 # ----------------------------------------------------------------------
@@ -247,9 +565,13 @@ class LocalReplica:
         engine.label = name
         self.generation = hasattr(engine, 'decode_edges')
         if self.generation:
-            self.queue = GenerationQueue(engine.max_prompt_len,
-                                         max_queue=max_queue,
-                                         label=name)
+            self.queue = GenerationQueue(
+                engine.max_prompt_len, max_queue=max_queue,
+                label=name,
+                # paged engines group admissions by radix prefix
+                page_size=(engine.page_size
+                           if getattr(engine, 'paged', False)
+                           else None))
         else:
             self.queue = RequestQueue(max_batch=engine.max_batch,
                                       max_queue=max_queue, label=name)
@@ -257,9 +579,11 @@ class LocalReplica:
         self.slos = slos
         self._clock = clock
         self._stop = threading.Event()
+        self._abort = threading.Event()
         self._thread = None
         self._outstanding = []
         self._monitor = None
+        self._degrade_saved = {}
 
     @property
     def version(self):
@@ -267,12 +591,58 @@ class LocalReplica:
 
     def start(self):
         self._thread = threading.Thread(
-            target=self.engine.run, args=(self.queue, self._stop),
-            daemon=True, name='fleet-%s' % self.name)
+            target=self._run, daemon=True,
+            name='fleet-%s' % self.name)
         self._thread.start()
         return self
 
+    def _run(self):
+        # ``engine.run`` with an abort hatch: :meth:`kill` must stop
+        # the scheduler MID-GENERATION (an unplanned death leaves
+        # slots live), which run()'s drain-first exit cannot express
+        while not self._abort.is_set():
+            worked = self.engine.step(self.queue)
+            if not worked:
+                if (self._stop.is_set() and self.queue.depth() == 0
+                        and not self.engine._slots
+                        and not getattr(self.engine, '_prefilling',
+                                        ())):
+                    return
+                time.sleep(0.002)
+
+    def kill(self):
+        """Hard-kill the replica in process -- the
+        :class:`LocalReplica` twin of a ``replica_kill``'d
+        subprocess.  The scheduler stops between ticks (tokens the
+        final tick committed were already streamed to ``on_token``,
+        so a journaling front's prefix stays exact), then every
+        outstanding request resolves with the typed
+        :class:`~chainermn_tpu.utils.failure.ReplicaDeadError` --
+        exactly what the subprocess front sees at read-loop EOF."""
+        self.state = 'dead'
+        self._abort.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+        open_reqs = [r for r in self._outstanding if not r.done()]
+        rids = [r.request_id for r in open_reqs]
+        for req in open_reqs:
+            req.set_error(ReplicaDeadError(
+                'replica %s killed with %s in flight'
+                % (self.name, req.request_id),
+                replica=self.name, request_ids=rids))
+        self._outstanding = []
+        return rids
+
+    def degrade(self, rung):
+        """Apply one degradation-ladder rung to the engine (rung 4's
+        shed lives at the front)."""
+        return apply_degradation_rung(self.engine, rung,
+                                      self._degrade_saved)
+
     def submit(self, *args, deadline=None, request_id=None, **kw):
+        if self.state == 'dead':
+            raise ReplicaDeadError('replica %s is dead' % self.name,
+                                   replica=self.name)
         req = self.queue.submit(*args, deadline=deadline,
                                 request_id=request_id, **kw)
         self._outstanding.append(req)
@@ -347,12 +717,16 @@ class LocalReplica:
 
 class _Cell:
     """Completion cell for one subprocess-served request (the
-    socket-side twin of ``GenRequest``'s result surface)."""
+    socket-side twin of ``GenRequest``'s result surface).
+    ``on_token`` (set at submit when the front journals) receives the
+    incremental ``token`` frames the worker streams per scheduler
+    tick; the final reply still carries the full token list."""
 
-    __slots__ = ('request_id', '_evt', '_msg')
+    __slots__ = ('request_id', '_evt', '_msg', 'on_token')
 
-    def __init__(self, request_id):
+    def __init__(self, request_id, on_token=None):
         self.request_id = request_id
+        self.on_token = on_token
         self._evt = threading.Event()
         self._msg = None
 
@@ -373,6 +747,11 @@ class _Cell:
         if m.get('error') == 'OverloadError':
             raise OverloadError(m.get('message', 'request shed'),
                                 reason=m.get('reason', 'queue_full'))
+        if m.get('error') == 'ReplicaDead':
+            raise ReplicaDeadError(
+                m.get('message', 'replica dead'),
+                replica=m.get('replica'),
+                request_ids=m.get('request_ids') or ())
         raise RuntimeError(m.get('message')
                            or 'replica error: %r' % (m,))
 
@@ -419,7 +798,7 @@ class SubprocessReplica:
     def spawn(cls, name, snapshot, version, out, n_slots=2,
               max_prompt_len=4, max_queue=64, replica_chaos=None,
               env=None, python=None, boot_timeout=240.0,
-              engine_args=None):
+              engine_args=None, replica_index=None, worker_out=None):
         port = _free_port()
         logdir = os.path.join(out, 'logs')
         os.makedirs(logdir, exist_ok=True)
@@ -427,7 +806,7 @@ class SubprocessReplica:
         env_base = {k: v for k, v in
                     (os.environ if env is None else env).items()
                     if k not in ('JAX_PLATFORMS', 'XLA_FLAGS',
-                                 _chaos.ENV_VAR,
+                                 _chaos.ENV_VAR, _chaos.REPLICA_ENV_VAR,
                                  'CHAINERMN_TPU_TELEMETRY')}
         root = os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__))))
@@ -435,6 +814,10 @@ class SubprocessReplica:
             root + os.pathsep + env_base.get('PYTHONPATH', ''))
         if replica_chaos:
             env_base[_chaos.ENV_VAR] = replica_chaos
+        if replica_index is not None:
+            # the replica_kill site's membership gate: the handout
+            # names WHICH fleet position this worker occupies
+            env_base[_chaos.REPLICA_ENV_VAR] = str(int(replica_index))
         argv = [python or sys.executable, '-m',
                 'chainermn_tpu.serving.fleet', '--replica',
                 '--name', name, '--port', str(port),
@@ -443,6 +826,11 @@ class SubprocessReplica:
                 '--n-slots', str(n_slots),
                 '--max-prompt-len', str(max_prompt_len),
                 '--max-queue', str(max_queue)]
+        if worker_out:
+            # disk-backed telemetry: an in-memory recorder's flight
+            # dump is a no-op, and the supervisor's post-mortem
+            # quick_verdict needs the dead worker's capture on disk
+            argv += ['--worker-out', worker_out]
         for extra in (engine_args or ()):
             argv.append(str(extra))
         proc = subprocess.Popen(argv, env=env_base, stdout=logf,
@@ -482,14 +870,30 @@ class SubprocessReplica:
                     msg = json.loads(line)
                 except ValueError:
                     continue
+                if 'token' in msg:
+                    # incremental stream frame: the request is still
+                    # in flight, so the cell stays pending
+                    cell = self._pending.get(msg.get('id'))
+                    if cell is not None and cell.on_token is not None:
+                        try:
+                            cell.on_token(cell.request_id,
+                                          msg['token'])
+                        except Exception:
+                            pass
+                    continue
                 cell = self._pending.pop(msg.get('id'), None)
                 if cell is not None:
                     cell._resolve(msg)
         except Exception:
             pass
+        # read-loop EOF IS the positive death signal: resolve every
+        # pending request typed, naming the whole in-flight set (the
+        # front's requeue worklist travels with the error)
         self._dead = True
+        rids = [c.request_id for c in self._pending.values()]
         for cell in list(self._pending.values()):
             cell._resolve({'ok': False, 'error': 'ReplicaDead',
+                           'replica': self.name, 'request_ids': rids,
                            'message': 'replica %s connection closed'
                                       % self.name})
         self._pending.clear()
@@ -499,11 +903,12 @@ class SubprocessReplica:
         with self._wlock:
             self._sock.sendall(data)
 
-    def _rpc(self, cmd, **fields):
+    def _rpc(self, cmd, on_token=None, rid=None, **fields):
         if self._dead:
-            raise RuntimeError('replica %s is dead' % self.name)
+            raise ReplicaDeadError('replica %s is dead' % self.name,
+                                   replica=self.name)
         mid = next(self._ids)
-        cell = _Cell('%s#%d' % (cmd, mid))
+        cell = _Cell(rid or '%s#%d' % (cmd, mid), on_token=on_token)
         self._pending[mid] = cell
         self._send(dict(fields, id=mid, cmd=cmd))
         return cell
@@ -515,6 +920,11 @@ class SubprocessReplica:
                                % (self.name, cmd, timeout))
         msg = cell._msg
         if not msg.get('ok'):
+            if msg.get('error') == 'ReplicaDead':
+                raise ReplicaDeadError(
+                    msg.get('message', 'replica dead'),
+                    replica=msg.get('replica', self.name),
+                    request_ids=msg.get('request_ids') or ())
             raise RuntimeError('replica %s: %s failed: %s'
                                % (self.name, cmd,
                                   msg.get('message') or msg))
@@ -526,17 +936,25 @@ class SubprocessReplica:
         return self._version
 
     def submit(self, prompt, max_new_tokens, deadline=None,
-               request_id=None):
+               request_id=None, on_token=None):
         # absolute controller-clock deadline -> relative seconds (the
         # worker re-anchors on its own monotonic clock)
         deadline_s = (None if deadline is None
                       else max(0.0, deadline - time.monotonic()))
         try:
             cell = self._rpc(
-                'serve',
+                'serve', on_token=on_token, rid=request_id,
                 prompt=[int(t) for t in np.asarray(prompt).reshape(-1)],
                 max_new_tokens=int(max_new_tokens),
-                deadline_s=deadline_s, request_id=request_id)
+                deadline_s=deadline_s, request_id=request_id,
+                stream=on_token is not None)
+        except ReplicaDeadError:
+            raise   # typed: the front decides requeue-or-shed
+        except OSError as e:
+            self._dead = True
+            raise ReplicaDeadError(
+                'replica %s write failed: %s' % (self.name, e),
+                replica=self.name)
         except Exception as e:
             raise OverloadError('replica %s unavailable: %s'
                                 % (self.name, e),
@@ -566,6 +984,14 @@ class SubprocessReplica:
 
     def reset_slo(self):
         self._call('reset_slo', timeout=30.0)
+
+    def degrade(self, rung):
+        """Ship one degradation-ladder rung to the worker engine."""
+        try:
+            return self._call('degrade', timeout=30.0,
+                              rung=int(rung)).get('rung')
+        except Exception:
+            return None
 
     def slo_eval(self):
         try:
@@ -634,16 +1060,32 @@ class FleetFront:
     """
 
     def __init__(self, replicas, current_version, canary_fraction=0.25,
-                 clock=time.monotonic):
+                 journal=None, clock=time.monotonic):
         self.replicas = list(replicas)
         self.current_version = int(current_version)
         self.canary_version = None
         self.canary_fraction = float(canary_fraction)
+        #: :class:`RequestJournal` (None: journaling off, the
+        #: zero-overhead default -- submit returns the replica's own
+        #: handle and nothing survives a replica death).  With a
+        #: journal, submit returns a :class:`FrontHandle` and
+        #: :meth:`recover` can requeue a dead replica's in-flight
+        #: requests as exact continuations.  Generation replicas
+        #: only: the journal streams per-tick tokens.
+        self.journal = journal
+        #: :class:`DegradationPolicy` whose ``shed`` rung this front
+        #: enforces at admission (set by the supervisor)
+        self.degradation = None
+        self.result_timeout = 120.0
         self._rr = itertools.count()
         self._clock = clock
+        self._handles = {}
+        self._hlock = threading.Lock()
         self.submitted = 0
         self.routed_canary = 0
         self.shed_no_replica = 0
+        self.shed_degraded = 0
+        self.recovered_requests = 0
 
     def by_name(self, name):
         for r in self.replicas:
@@ -658,35 +1100,233 @@ class FleetFront:
 
     def submit(self, *args, deadline=None, **kw):
         rid = next_request_id()
+        if (self.degradation is not None
+                and self.degradation.sheds(rid)):
+            self.shed_degraded += 1
+            record_shed('degraded', request_id=rid)
+            raise OverloadError(
+                'degradation ladder at shed rung (request %s in the '
+                'shed slice)' % rid, reason='degraded')
         to_canary = (self.canary_version is not None
                      and canary_slice(rid, self.canary_fraction))
-        group = self.serving(self.canary_version if to_canary
-                             else self.current_version)
-        if not group:
-            group = self.serving()   # availability beats affinity
-        if not group:
-            self.shed_no_replica += 1
-            record_shed('no_replica', request_id=rid)
-            raise OverloadError(
-                'no serving replica available (all parked)',
-                reason='no_replica')
-        r = group[next(self._rr) % len(group)]
+        handle, admitted = None, False
+        while True:
+            group = self.serving(self.canary_version if to_canary
+                                 else self.current_version)
+            if not group:
+                group = self.serving()  # availability beats affinity
+            if not group:
+                self.shed_no_replica += 1
+                record_shed('no_replica', request_id=rid)
+                if admitted:
+                    self.journal.done(rid, outcome='shed',
+                                      reason='no_replica')
+                    self._drop_handle(rid)
+                raise OverloadError(
+                    'no serving replica available (all parked)',
+                    reason='no_replica')
+            r = group[next(self._rr) % len(group)]
+            if self.journal is not None:
+                if not admitted:
+                    handle = FrontHandle(rid)
+                    with self._hlock:
+                        self._handles[rid] = handle
+                    self.journal.admit(rid, args[0], args[1],
+                                       deadline, r.name, r.version)
+                    admitted = True
+                else:
+                    self.journal.reassign(rid, r.name)
+                kw = dict(kw, on_token=self.journal.tokens)
+            try:
+                backend = r.submit(*args, deadline=deadline,
+                                   request_id=rid, **kw)
+            except ReplicaDeadError:
+                # positively dead: park it (the supervisor requeues
+                # ITS in-flight separately) and re-route this request
+                r.state = 'dead'
+                continue
+            except OverloadError as e:
+                if admitted:
+                    self.journal.done(rid, outcome='shed',
+                                      reason=e.reason)
+                    self._drop_handle(rid)
+                raise
+            break
         self.submitted += 1
         if to_canary and r.version == self.canary_version:
             self.routed_canary += 1
-        return r.submit(*args, deadline=deadline, request_id=rid, **kw)
+        if self.journal is None:
+            return backend
+        self._watch(handle, backend, prefix=())
+        return handle
+
+    def _drop_handle(self, rid):
+        with self._hlock:
+            self._handles.pop(rid, None)
+
+    def _watch(self, handle, backend, prefix):
+        """Bind ``handle`` to ``backend``'s eventual resolution; a
+        typed :class:`ReplicaDeadError` leaves the handle OPEN -- the
+        journal still holds the request, and :meth:`recover` re-binds
+        it to a continuation on a survivor."""
+        rid = handle.request_id
+        prefix = [int(t) for t in prefix]
+
+        def wait():
+            try:
+                toks = backend.result(timeout=self.result_timeout)
+            except ReplicaDeadError:
+                return
+            except OverloadError as e:
+                if self.journal.done(rid, outcome='shed',
+                                     reason=e.reason):
+                    handle._fail(e)
+                    self._drop_handle(rid)
+            except Exception as e:
+                if self.journal.done(rid, outcome='error',
+                                     reason=type(e).__name__):
+                    handle._fail(e)
+                    self._drop_handle(rid)
+            else:
+                if self.journal.done(rid, outcome='served'):
+                    handle._complete(prefix
+                                     + [int(t) for t in toks])
+                    self._drop_handle(rid)
+
+        threading.Thread(target=wait, daemon=True,
+                         name='fleet-front-%s' % rid).start()
+
+    def recover(self, dead, ledger=None):
+        """Exact-replay recovery of ``dead``'s journaled in-flight
+        requests: each is re-dispatched to a survivor as a
+        CONTINUATION -- teacher-forced prefill of ``prompt +
+        emitted`` (the existing prefill path; chunked prefill meters
+        long continuations), then greedy decode resumes.  Greedy
+        determinism makes the continuation token-for-token identical
+        to the uninterrupted run; the client's :class:`FrontHandle`
+        resolves with journaled prefix + continuation, one seamless
+        stream.  Already-expired deadlines shed TYPED with per-request
+        attribution, never silently.  Returns ``(requeued_ids,
+        shed_ids)``; ``ledger`` (the fleet ledger) gets ``requeue`` /
+        ``requeue_shed`` / ``recovered`` events."""
+        dead.state = 'dead'
+        if self.journal is None:
+            return [], []
+        work = self.journal.inflight(replica=dead.name)
+        requeued, shed, completed = [], [], []
+        now = self._clock()
+        for rid in sorted(work, key=admission_order):
+            e = work[rid]
+            with self._hlock:
+                handle = self._handles.get(rid)
+            if handle is None:
+                handle = FrontHandle(rid)
+                with self._hlock:
+                    self._handles[rid] = handle
+            emitted = [int(t) for t in e['emitted']]
+            remaining = e['max_new'] - len(emitted)
+            if remaining <= 0:
+                # fully generated -- only the completion frame died
+                # with the replica; the journal already holds every
+                # token
+                if self.journal.done(rid, outcome='served',
+                                     recovered=True):
+                    handle._complete(emitted)
+                    self._drop_handle(rid)
+                self.recovered_requests += 1
+                completed.append(rid)
+                continue
+            if e['deadline'] is not None and now > e['deadline']:
+                if self.journal.done(rid, outcome='shed',
+                                     reason='deadline',
+                                     replica=dead.name):
+                    record_shed('deadline', request_id=rid,
+                                replica=dead.name, phase='requeue')
+                    handle._fail(OverloadError(
+                        'deadline of %s expired before requeue '
+                        '(died with replica %s)' % (rid, dead.name),
+                        reason='deadline'))
+                    self._drop_handle(rid)
+                if ledger is not None:
+                    ledger.append('requeue_shed', request_id=rid,
+                                  replica=dead.name,
+                                  reason='deadline')
+                shed.append(rid)
+                continue
+            cont = list(e['prompt']) + emitted
+            survivors = [r for r in self.serving() if r is not dead]
+            backend, target, reason = None, None, 'no_replica'
+            while survivors:
+                cand = survivors[next(self._rr) % len(survivors)]
+                try:
+                    backend = cand.submit(
+                        np.asarray(cont, np.int32), remaining,
+                        deadline=e['deadline'], request_id=rid,
+                        on_token=self.journal.tokens)
+                except ReplicaDeadError:
+                    cand.state = 'dead'
+                    survivors = [r for r in survivors
+                                 if r is not cand]
+                    continue
+                except OverloadError as exc:
+                    reason = exc.reason
+                except ValueError:
+                    # continuation longer than the survivor's
+                    # max_prompt_len: size recovery scenarios with
+                    # max_prompt_len >= prompt + max_new - 1
+                    reason = 'continuation_too_long'
+                target = cand
+                break
+            if backend is None:
+                if self.journal.done(rid, outcome='shed',
+                                     reason=reason,
+                                     replica=dead.name):
+                    record_shed(reason, request_id=rid,
+                                replica=dead.name, phase='requeue')
+                    handle._fail(OverloadError(
+                        'requeue of %s shed: %s' % (rid, reason),
+                        reason=reason))
+                    self._drop_handle(rid)
+                if ledger is not None:
+                    ledger.append('requeue_shed', request_id=rid,
+                                  replica=dead.name, reason=reason)
+                shed.append(rid)
+                continue
+            self.journal.reassign(rid, target.name)
+            if ledger is not None:
+                ledger.append('requeue', request_id=rid,
+                              from_replica=dead.name,
+                              to_replica=target.name,
+                              emitted=len(emitted),
+                              remaining=remaining)
+            self._watch(handle, backend, prefix=emitted)
+            self.recovered_requests += 1
+            requeued.append(rid)
+        if ledger is not None:
+            ledger.append('recovered', replica=dead.name,
+                          request_ids=requeued, shed=shed,
+                          completed_at_death=completed)
+        return requeued, shed
 
     def shed_total(self):
-        return (self.shed_no_replica
-                + sum(r.shed_total() for r in self.replicas))
+        return (self.shed_no_replica + self.shed_degraded
+                + sum(r.shed_total() for r in self.replicas
+                      if r.state != 'dead'))
 
     def stats(self):
-        return {'submitted': self.submitted,
-                'routed_canary': self.routed_canary,
-                'shed_no_replica': self.shed_no_replica,
-                'current_version': self.current_version,
-                'canary_version': self.canary_version,
-                'replicas': [r.stats() for r in self.replicas]}
+        out = {'submitted': self.submitted,
+               'routed_canary': self.routed_canary,
+               'shed_no_replica': self.shed_no_replica,
+               'shed_degraded': self.shed_degraded,
+               'recovered_requests': self.recovered_requests,
+               'current_version': self.current_version,
+               'canary_version': self.canary_version,
+               'replicas': [r.stats() for r in self.replicas]}
+        if self.journal is not None:
+            out['journal'] = {'admitted': self.journal.admitted,
+                              'completed': self.journal.completed,
+                              'inflight': len(self.journal.inflight())}
+        return out
 
 
 # ----------------------------------------------------------------------
@@ -1077,6 +1717,283 @@ class FleetController:
                 pass
 
 # ----------------------------------------------------------------------
+# the replica supervisor: detect -> requeue -> respawn -> degrade
+# ----------------------------------------------------------------------
+
+def strip_oneshot_kills(spec, site='replica_kill'):
+    """Drop one-shot ``@``-scheduled ``site`` rules from a chaos spec
+    handout (keep ``p`` and ``*`` rules).  A respawned worker's
+    occurrence counters restart at zero, so handing it the original
+    ``replica_kill=@N`` rule would re-fire the already-consumed kill
+    on every respawn -- while a ``*`` rule SHOULD keep firing: that
+    is the crash-loop the restart policy must abort on."""
+    if not spec:
+        return spec
+    kept = []
+    for item in str(spec).split(';'):
+        item = item.strip()
+        if not item:
+            continue
+        name, _, rhs = item.partition('=')
+        if name.strip() == site and rhs.strip().startswith('@'):
+            continue
+        kept.append(item)
+    return ';'.join(kept)
+
+
+class ReplicaSupervisor:
+    """Fleet-level self-healing loop -- the serving twin of the
+    training supervisor.  One :meth:`check` pass:
+
+    1. **detect**: a subprocess replica whose process exited or whose
+       read loop hit EOF, or a :class:`LocalReplica` marked ``dead``
+       (by :meth:`LocalReplica.kill` or a typed submit failure);
+    2. **classify + record**: ``classify_exit`` on the worker's
+       returncode, the dead worker's flight dump read through the
+       doctor's ``quick_verdict`` (when workers capture to disk via
+       ``--worker-out``), a ``replica_dead`` ledger event naming
+       every in-flight request id;
+    3. **requeue**: :meth:`FleetFront.recover` -- exact-replay
+       continuations on survivors, per-request attribution;
+    4. **decide**: the training-side
+       :class:`~chainermn_tpu.training.supervisor.RestartPolicy`
+       (crash-loop window, restart budget,
+       :class:`~chainermn_tpu.utils.failure.Backoff` pacing).  A
+       crash loop (``replica_kill=*`` on every respawn) ABORTS typed
+       instead of burning the budget;
+    5. **respawn**: ``spawn_fn(name, path, version, index)`` boots a
+       replacement from the controller's incumbent snapshot (the
+       newest valid rolled head of the ``CheckpointWatcher`` /
+       ``chain_heads`` chain) and splices it into the front at the
+       dead replica's slot -- ``respawn`` ledger event, fresh name.
+
+    The same loop drives the :class:`DegradationPolicy` from the live
+    per-replica SLO verdicts and KV-page pressure (``degrade_interval``
+    cadence), applying rungs 0-3 to every serving engine; rung 4's
+    shed is enforced by the front itself.
+    """
+
+    def __init__(self, controller, spawn_fn=None, policy=None,
+                 degradation=None, poll_interval=0.15,
+                 degrade_interval=0.5, worker_out=None,
+                 clock=time.monotonic):
+        from chainermn_tpu.training.supervisor import RestartPolicy
+        self.controller = controller
+        self.front = controller.front
+        self.ledger = controller.ledger
+        self.spawn_fn = spawn_fn
+        self.policy = policy if policy is not None else RestartPolicy(
+            max_restarts=8, crash_window=120.0, crash_threshold=3,
+            shrink_causes=(),   # serving never shrinks: respawn or abort
+            backoff=failure.Backoff(initial=0.2, factor=2.0,
+                                    max_delay=2.0))
+        self.degradation = degradation
+        if degradation is not None:
+            if degradation.ledger is None:
+                degradation.ledger = self.ledger
+            self.front.degradation = degradation
+        self.poll_interval = float(poll_interval)
+        self.degrade_interval = float(degrade_interval)
+        self.worker_out = worker_out
+        self._clock = clock
+        self._stop = threading.Event()
+        self._thread = None
+        self._handled = set()
+        self._respawn_gen = {}
+        self._t_next_degrade = 0.0
+        self.deaths = 0
+        self.respawns = 0
+        self.requeued = []
+        self.shed = []
+        self.aborted = False
+        self.abort_reason = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._loop,
+                                        daemon=True,
+                                        name='fleet-supervisor')
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                self.check()
+            except Exception:
+                pass
+            if self.aborted:
+                return
+            self._stop.wait(self.poll_interval)
+
+    # -- one pass ------------------------------------------------------
+    @staticmethod
+    def _is_dead(r):
+        if getattr(r, 'state', None) == 'dead':
+            return True
+        proc = getattr(r, 'proc', None)
+        if proc is not None and (proc.poll() is not None
+                                 or getattr(r, '_dead', False)):
+            return True
+        return False
+
+    def check(self):
+        """One detect/requeue/respawn/degrade pass (tests call this
+        directly for determinism; :meth:`start` polls it)."""
+        for idx, r in enumerate(list(self.front.replicas)):
+            if r.name in self._handled or self.aborted:
+                continue
+            if self._is_dead(r):
+                self._handle_death(idx, r)
+        self._drive_degradation()
+        return {'deaths': self.deaths, 'respawns': self.respawns,
+                'aborted': self.aborted}
+
+    def _quick_verdict(self, r):
+        if not self.worker_out:
+            return None
+        try:
+            from chainermn_tpu.telemetry.diagnosis import quick_verdict
+            v = quick_verdict(os.path.join(self.worker_out, r.name))
+            if not v:
+                return None
+            return {'verdict': v.get('verdict'),
+                    'causes': v.get('causes')}
+        except Exception:
+            return None
+
+    def _handle_death(self, idx, r):
+        self._handled.add(r.name)
+        self.deaths += 1
+        r.state = 'dead'
+        proc = getattr(r, 'proc', None)
+        rc = None
+        if proc is not None:
+            # the journal's committed prefix is only final at read-loop
+            # EOF: frames already in the socket buffer land before it,
+            # so wait for the reader before computing the worklist
+            t_end = time.monotonic() + 5.0
+            while (not getattr(r, '_dead', False)
+                   and time.monotonic() < t_end):
+                time.sleep(0.01)
+            try:
+                rc = proc.wait(timeout=10.0)
+            except Exception:
+                rc = proc.returncode
+        exit_kind = (failure.classify_exit(rc)
+                     if rc is not None else 'killed')
+        inflight = sorted(
+            self.front.journal.inflight(replica=r.name)
+            if self.front.journal is not None else (),
+            key=admission_order)
+        self.ledger.append(
+            'replica_dead', replica=r.name, returncode=rc,
+            exit=exit_kind, request_ids=inflight,
+            quick_verdict=self._quick_verdict(r))
+        requeued, shed = self.front.recover(r, ledger=self.ledger)
+        self.requeued.extend(requeued)
+        self.shed.extend(shed)
+        try:
+            r.close()
+        except Exception:
+            pass
+        cause = 'crash' if rc is not None else 'killed'
+        decision = self.policy.on_failure(
+            cause, nprocs=len(self.front.serving()) + 1)
+        if decision.action == 'abort':
+            self.aborted = True
+            self.abort_reason = decision.reason
+            self.ledger.append('abort', replica=r.name,
+                               reason=decision.reason,
+                               restarts=self.policy.restarts)
+            return
+        if self.spawn_fn is None:
+            return   # requeue-only mode: survivors absorb the load
+        if decision.delay:
+            self._stop.wait(decision.delay)
+        gen = self._respawn_gen.get(idx, 0) + 1
+        self._respawn_gen[idx] = gen
+        name = 'replica-%dr%d' % (idx, gen)
+        try:
+            replacement = self.spawn_fn(
+                name=name, path=self.controller.current_path,
+                version=self.controller.current_version, index=idx)
+        except Exception as e:
+            self.ledger.append('respawn_failed', replica=name,
+                               replaces=r.name, error=str(e))
+            return
+        self.front.replicas[idx] = replacement
+        self.respawns += 1
+        self.policy.on_success()   # healthy boot: backoff resets
+        self.ledger.append(
+            'respawn', replica=name, replaces=r.name,
+            version=self.controller.current_version,
+            path=self.controller.current_path,
+            delay_s=round(decision.delay, 4),
+            restarts=self.policy.restarts)
+
+    # -- degradation driving -------------------------------------------
+    def _drive_degradation(self):
+        pol = self.degradation
+        if pol is None:
+            return
+        now = self._clock()
+        if now < self._t_next_degrade:
+            return
+        self._t_next_degrade = now + self.degrade_interval
+        order = {'ok': 0, 'warn': 1, 'breach': 2}
+        worst, breaches = None, []
+        kv_used = kv_total = 0
+        for r in self.front.serving():
+            try:
+                ev = r.slo_eval()
+            except Exception:
+                ev = None
+            if ev:
+                verdict = ev.get('verdict') or {}
+                o = verdict.get('overall')
+                if o in order and (worst is None
+                                   or order[o] > order[worst]):
+                    worst = o
+                if o == 'breach':
+                    breaches.extend(verdict.get('breaches') or ())
+            eng = getattr(r, 'engine', None)
+            if eng is not None and getattr(eng, 'pool',
+                                           None) is not None:
+                kv_used += eng.pool.in_use()
+                kv_total += eng.n_pages
+        moved = pol.observe(worst, breaches=breaches,
+                            kv_in_use=kv_used or None,
+                            kv_total=kv_total or None)
+        if moved is not None:
+            for r in self.front.serving():
+                try:
+                    r.degrade(min(moved, 3))
+                except Exception:
+                    pass
+
+    def describe(self):
+        out = {'deaths': self.deaths, 'respawns': self.respawns,
+               'requeued': sorted(self.requeued,
+                                  key=admission_order),
+               'shed': sorted(self.shed,
+                              key=admission_order),
+               'aborted': self.aborted,
+               'abort_reason': self.abort_reason,
+               'policy': self.policy.describe()}
+        if self.degradation is not None:
+            out['degradation'] = self.degradation.describe()
+        if self.front.journal is not None:
+            out['lost_requests'] = len(self.front.journal.inflight())
+        return out
+
+
+# ----------------------------------------------------------------------
 # the built-in demo: a tiny LM trained for real, served for real
 # ----------------------------------------------------------------------
 
@@ -1163,11 +2080,16 @@ def demo_train(ckpt_dir, steps, snapshot_every, lr=0.05,
 def build_local_fleet(ckpt_dir, out, n_replicas=2, n_slots=2,
                       max_prompt_len=4, max_queue=64, slos=None,
                       canary_fraction=0.25, engine_kw=None,
-                      **controller_kw):
+                      journal=False, warmup=True, **controller_kw):
     """An in-process demo-LM fleet booted from the newest VALID
     snapshot under ``ckpt_dir`` -- the tier-1 test and bench-arm
     path (the CLI's default is subprocess replicas).  Returns the
-    started :class:`FleetController`."""
+    started :class:`FleetController`.  ``journal=True`` arms the
+    crash-safe :class:`RequestJournal` (``OUT/request_journal.jsonl``)
+    so a :class:`ReplicaSupervisor` can exact-replay-recover a dead
+    replica's in-flight generations.  ``warmup=False`` skips the
+    eager full-bucket-family compile and lets each executable
+    compile on first use (tests that only touch a few buckets)."""
     from chainermn_tpu.serving.generate import GenerationEngine
     from chainermn_tpu.training import recovery
     kind, path, it = recovery.latest_snapshot(ckpt_dir)
@@ -1182,13 +2104,39 @@ def build_local_fleet(ckpt_dir, out, n_replicas=2, n_slots=2,
             path, model, template, n_slots=n_slots,
             max_prompt_len=max_prompt_len, label=name, version=it,
             **(engine_kw or {}))
-        eng.warmup()
+        if warmup:
+            eng.warmup()
         replicas.append(LocalReplica(name, eng, max_queue=max_queue,
                                      slos=slos).start())
-    front = FleetFront(replicas, current_version=it,
-                       canary_fraction=canary_fraction)
+    front = FleetFront(
+        replicas, current_version=it,
+        canary_fraction=canary_fraction,
+        journal=(RequestJournal(os.path.join(out, JOURNAL_NAME))
+                 if journal else None))
     return FleetController(front, ckpt_dir, out, boot=(path, it),
                            **controller_kw)
+
+def local_respawn_fn(n_slots=2, max_prompt_len=4, max_queue=64,
+                     slos=None, engine_kw=None, warmup=True):
+    """A ``spawn_fn`` for :class:`ReplicaSupervisor` over IN-PROCESS
+    replicas (the tier-1/bench twin of ``SubprocessReplica.spawn``):
+    boots a fresh demo engine from the incumbent snapshot and starts
+    a :class:`LocalReplica` under the replacement name."""
+    from chainermn_tpu.serving.generate import GenerationEngine
+    model, template = demo_params()
+
+    def spawn_fn(name, path, version, index):
+        eng = GenerationEngine.from_checkpoint(
+            path, model, template, n_slots=n_slots,
+            max_prompt_len=max_prompt_len, label=name,
+            version=version, **(engine_kw or {}))
+        if warmup:
+            eng.warmup()
+        return LocalReplica(name, eng, max_queue=max_queue,
+                            slos=slos).start()
+
+    return spawn_fn
+
 
 # ----------------------------------------------------------------------
 # replica worker (the --replica subprocess)
@@ -1216,7 +2164,10 @@ def _replica_main(args):
     from chainermn_tpu.serving.generate import (GenerationEngine,
                                                 GenerationQueue)
     _chaos.maybe_install_from_env()
-    _telemetry.enable()
+    # --worker-out: capture to disk so a chaos kill's pre-exit flight
+    # dump survives for the supervisor's post-mortem quick_verdict
+    # (an in-memory recorder's dump_flight is a no-op)
+    _telemetry.enable(outdir=args.worker_out or None)
     if args.parent_pid:
         threading.Thread(target=_watch_parent,
                          args=(args.parent_pid,),
@@ -1241,6 +2192,7 @@ def _replica_main(args):
     wlock = threading.Lock()
     outstanding = [0]
     olock = threading.Lock()
+    degrade_saved = {}
 
     def reply(obj):
         with wlock:
@@ -1248,12 +2200,19 @@ def _replica_main(args):
 
     def handle_serve(msg):
         mid = msg.get('id')
+        on_token = None
+        if msg.get('stream'):
+            # incremental token frames per scheduler tick: the
+            # journaling front's committed-prefix feed
+            def on_token(_rid, toks):
+                reply({'id': mid, 'token': toks})
         try:
             dl = (None if msg.get('deadline_s') is None
                   else time.monotonic() + float(msg['deadline_s']))
             req = queue.submit(msg['prompt'], msg['max_new_tokens'],
                                deadline=dl,
-                               request_id=msg.get('request_id'))
+                               request_id=msg.get('request_id'),
+                               on_token=on_token)
         except OverloadError as e:
             reply({'id': mid, 'ok': False, 'error': 'OverloadError',
                    'reason': e.reason, 'message': str(e)})
@@ -1329,6 +2288,10 @@ def _replica_main(args):
             monitor[0] = _fresh_monitor(args.name,
                                         engine.param_version)
             reply({'id': mid, 'ok': True})
+        elif cmd == 'degrade':
+            rung = apply_degradation_rung(engine, msg.get('rung', 0),
+                                          degrade_saved)
+            reply({'id': mid, 'ok': True, 'rung': rung})
         elif cmd == 'stats':
             reply({'id': mid, 'ok': True,
                    'version': engine.param_version,
@@ -1473,6 +2436,7 @@ def _demo_main(args):
                         latency_floor_ms=args.latency_floor_ms,
                         shed_delta=args.shed_delta,
                         min_events=args.min_events)
+    worker_out = os.path.join(out, 'telemetry')
     if args.local:
         controller = build_local_fleet(
             ckpt_dir, out, n_replicas=args.replicas,
@@ -1483,18 +2447,28 @@ def _demo_main(args):
             canary_seconds=args.canary_seconds,
             judge_interval=args.judge_interval,
             drain_timeout=args.drain_timeout,
-            watcher=None)
+            watcher=None, journal=args.recover)
         controller.watcher.debounce_s = args.debounce
+        spawn_fn = local_respawn_fn(
+            n_slots=args.n_slots,
+            max_prompt_len=args.max_prompt_len,
+            max_queue=args.max_queue, slos=slos)
     else:
         replicas = [SubprocessReplica.spawn(
             'replica-%d' % i, path, it, out,
             n_slots=args.n_slots,
             max_prompt_len=args.max_prompt_len,
             max_queue=args.max_queue,
-            replica_chaos=args.replica_chaos)
+            replica_chaos=args.replica_chaos,
+            replica_index=i,
+            worker_out=(os.path.join(worker_out, 'replica-%d' % i)
+                        if args.recover else None))
             for i in range(args.replicas)]
-        front = FleetFront(replicas, current_version=it,
-                           canary_fraction=args.canary_fraction)
+        front = FleetFront(
+            replicas, current_version=it,
+            canary_fraction=args.canary_fraction,
+            journal=(RequestJournal(os.path.join(out, JOURNAL_NAME))
+                     if args.recover else None))
         controller = FleetController(
             front, ckpt_dir, out, boot=(path, it),
             watcher=CheckpointWatcher(ckpt_dir,
@@ -1503,7 +2477,27 @@ def _demo_main(args):
             judge=judge, canary_seconds=args.canary_seconds,
             judge_interval=args.judge_interval,
             drain_timeout=args.drain_timeout)
+        # respawned workers never inherit the one-shot @N kill (their
+        # occurrence counters restart) -- but * rules stay so a
+        # crash-loop keeps crashing into the restart-policy abort
+        respawn_chaos = strip_oneshot_kills(args.replica_chaos)
+
+        def spawn_fn(name, path, version, index):
+            return SubprocessReplica.spawn(
+                name, path, version, out,
+                n_slots=args.n_slots,
+                max_prompt_len=args.max_prompt_len,
+                max_queue=args.max_queue,
+                replica_chaos=respawn_chaos,
+                replica_index=index,
+                worker_out=os.path.join(worker_out, name))
     controller.start()
+    supervisor = None
+    if args.recover:
+        supervisor = ReplicaSupervisor(
+            controller, spawn_fn=spawn_fn,
+            degradation=DegradationPolicy(),
+            worker_out=worker_out).start()
     stop_ctl = threading.Event()
     ctl_thread = threading.Thread(
         target=controller.run, args=(stop_ctl,), daemon=True)
@@ -1511,7 +2505,8 @@ def _demo_main(args):
     traffic = _TrafficGen(
         controller.front, rate=args.rate,
         max_new_tokens=args.max_new_tokens,
-        prompt_len_range=(1, args.max_prompt_len),
+        prompt_len_range=(1, args.traffic_prompt_max
+                          or args.max_prompt_len),
         seed=args.seed).start()
     rc = 0
     try:
@@ -1533,17 +2528,27 @@ def _demo_main(args):
                       % target, file=sys.stderr)
                 rc = 3
                 break
-        time.sleep(args.duration)
+        t_end = time.monotonic() + args.duration
+        while time.monotonic() < t_end:
+            if supervisor is not None and supervisor.aborted:
+                break
+            time.sleep(0.05)
     finally:
-        traffic.stop()
+        traffic.stop()   # before supervisor.stop(): outstanding
+        if supervisor is not None:   # handles may need a recovery
+            supervisor.stop()
         stop_ctl.set()
         ctl_thread.join(timeout=60.0)
         summary = controller.complete(traffic=traffic.stats())
         controller.close()
-    print(json.dumps({k: summary[k] for k in
-                      ('version', 'promotes', 'rollbacks',
-                       'swap_failures', 'dropped_during_swap',
-                       'traffic')}, sort_keys=True))
+    payload = {k: summary[k] for k in
+               ('version', 'promotes', 'rollbacks',
+                'swap_failures', 'dropped_during_swap', 'traffic')}
+    if supervisor is not None:
+        payload['recovery'] = supervisor.describe()
+        if supervisor.aborted:
+            rc = 1
+    print(json.dumps(payload, sort_keys=True, default=repr))
     return rc
 
 
@@ -1594,7 +2599,22 @@ def main(argv=None):
     p.add_argument('--replica-chaos', default=None,
                    help='CHAINERMN_TPU_CHAOS handout to replica '
                         'subprocesses (e.g. serve_slow=*:0.3 -- the '
-                        'regression only bites on a swapped version)')
+                        'regression only bites on a swapped version; '
+                        'replica_kill=@N:IDX hard-kills replica IDX '
+                        'at its Nth decode tick)')
+    p.add_argument('--recover', action='store_true',
+                   help='arm the crash-safe request journal and the '
+                        'ReplicaSupervisor self-healing loop '
+                        '(exact-replay requeue + respawn + '
+                        'degradation ladder)')
+    p.add_argument('--traffic-prompt-max', type=int, default=None,
+                   help='cap demo-traffic prompt length below '
+                        '--max-prompt-len so recovery continuations '
+                        '(prompt + emitted tokens) still fit the '
+                        'prefill window')
+    p.add_argument('--worker-out', default=None,
+                   help='internal: replica worker telemetry capture '
+                        'dir (set by the controller under --recover)')
     p.add_argument('--seed', type=int, default=0)
     args = p.parse_args(argv)
     if args.replica:

@@ -86,15 +86,26 @@ class GenRequest:
     a STABLE hash of the shareable prompt prefix
     (:func:`chainermn_tpu.serving.paged.prefix_key`): a pure function
     of the token ids, so arrival order can never change it -- the
-    scheduler uses it to co-admit shared-prefix requests."""
+    scheduler uses it to co-admit shared-prefix requests.
+
+    ``on_token`` (optional) streams committed tokens incrementally:
+    the engine calls ``on_token(request_id, [int, ...])`` from the
+    scheduler thread each time tokens are emitted (first token at
+    prefill completion, one per decode tick, an accepted window per
+    speculative tick).  The callback is passed at SUBMIT time (not
+    attached later) so there is no race against the scheduler thread;
+    it must be cheap and never raise -- the engine guards it, but a
+    slow callback stalls the tick.  The fleet front's crash-safe
+    request journal rides exactly this hook."""
 
     __slots__ = ('prompt', 'max_new_tokens', 'deadline', 'seq',
                  't_submit', 'synthetic', 'request_id', 't_trace0',
-                 'prefix_key', '_done', '_result', '_error')
+                 'prefix_key', 'on_token', '_done', '_result',
+                 '_error')
 
     def __init__(self, prompt, max_new_tokens, deadline=None, seq=0,
                  t_submit=0.0, synthetic=False, request_id=None,
-                 prefix_key=None):
+                 prefix_key=None, on_token=None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError('empty prompt')
@@ -107,6 +118,7 @@ class GenRequest:
         self.t_submit = t_submit
         self.synthetic = synthetic
         self.prefix_key = prefix_key
+        self.on_token = on_token
         self.request_id = request_id or next_request_id()
         rec = _telemetry.active()
         self.t_trace0 = rec.now() if rec is not None else None
@@ -117,6 +129,19 @@ class GenRequest:
     def set_result(self, tokens):
         self._result = np.asarray(tokens, np.int32)
         self._done.set()
+
+    def notify_tokens(self, tokens):
+        """Stream newly COMMITTED tokens to ``on_token`` (no-op when
+        no callback was registered).  Guarded: a journal/stream
+        callback failure must never take the scheduler thread down
+        with it -- the request still completes via ``set_result``."""
+        if self.on_token is None or not tokens:
+            return
+        try:
+            self.on_token(self.request_id,
+                          [int(t) for t in tokens])
+        except Exception:
+            pass
 
     def set_error(self, exc):
         self._error = exc
@@ -167,12 +192,14 @@ class GenerationQueue:
         self.shed_deadline = 0
 
     def submit(self, prompt, max_new_tokens, deadline=None,
-               request_id=None):
+               request_id=None, on_token=None):
         """Enqueue one prompt; returns the :class:`GenRequest`.
         Over-length prompts raise ``ValueError`` before touching
         queue state; a full or closed queue sheds typed.
         ``request_id`` lets an admission front (the fleet) pre-assign
-        the trace id it already routed on."""
+        the trace id it already routed on; ``on_token`` is the
+        incremental token-stream callback installed at admission (see
+        :class:`GenRequest`)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size > self.max_prompt_len:
             raise ValueError(
@@ -183,7 +210,8 @@ class GenerationQueue:
                  if _chaos._active is not None else 0)
         with self._lock:
             req = self._admit(prompt, max_new_tokens, deadline,
-                              request_id=request_id)
+                              request_id=request_id,
+                              on_token=on_token)
             for _ in range(burst):
                 try:
                     self._admit(prompt, max_new_tokens, deadline,
@@ -193,7 +221,7 @@ class GenerationQueue:
         return req
 
     def _admit(self, prompt, max_new_tokens, deadline,
-               synthetic=False, request_id=None):
+               synthetic=False, request_id=None, on_token=None):
         if self._closed:
             raise OverloadError('generation queue is shut down',
                                 reason='shutdown',
@@ -217,7 +245,7 @@ class GenerationQueue:
         req = GenRequest(prompt, max_new_tokens, deadline=deadline,
                          seq=self._seq, t_submit=self._clock(),
                          synthetic=synthetic, request_id=request_id,
-                         prefix_key=key)
+                         prefix_key=key, on_token=on_token)
         self._waiting.append(req)
         return req
 
@@ -431,6 +459,10 @@ class GenerationEngine:
         self.param_version = int(version)
         self._boot_version = self.param_version
         self.n_slots = int(n_slots)
+        #: admissions per scheduler tick cap (None: every free slot).
+        #: The fleet degradation ladder's "shrink admission" rung sets
+        #: this to 1 and restores None on recovery.
+        self.admit_cap = None
         self.max_prompt_len = int(max_prompt_len)
         self.max_len = int(max_len or model.max_len)
         if self.max_prompt_len > self.max_len:
@@ -1391,6 +1423,13 @@ class GenerationEngine:
             % (self.pool.in_use(), self.pool.n_pages, where),
             reason='kv_pages'))
 
+    def _admit_budget(self):
+        """Admissions this tick: every free slot, unless the fleet
+        degradation ladder capped it (``admit_cap``)."""
+        if self.admit_cap is None:
+            return len(self._free)
+        return min(len(self._free), max(0, int(self.admit_cap)))
+
     def _admit(self, queue, now, clock):
         """Refill free slots from the queue: one PREFILL per request
         (bucketed by prompt length), TTFT recorded when its first
@@ -1405,7 +1444,7 @@ class GenerationEngine:
         rec = _telemetry.active()
         reg = _telemetry.registry()
         ident = self._ident()
-        for req in queue.pop(len(self._free)):
+        for req in queue.pop(self._admit_budget()):
             sid = self._free.pop(0)
             prompt = req.prompt
             t_pop = rec.now() if rec is not None else None
@@ -1477,6 +1516,7 @@ class GenerationEngine:
                 ).observe(t_first - req.t_submit)
                 reg.counter('serve_tokens_total',
                             help='generated tokens').inc()
+            req.notify_tokens([tok])
             if self.eos_id is not None and tok == self.eos_id \
                     or req.max_new_tokens == 1:
                 req.set_result([tok])
@@ -1502,7 +1542,7 @@ class GenerationEngine:
         reg = _telemetry.registry()
         ident = self._ident()
         group = self._prefix_index is not None
-        for req in queue.pop(len(self._free), group_prefix=group):
+        for req in queue.pop(self._admit_budget(), group_prefix=group):
             sid = self._free.pop(0)
             prompt = req.prompt
             t_pop = rec.now() if rec is not None else None
@@ -1678,6 +1718,7 @@ class GenerationEngine:
                 n_cover = -(-prompt.size // self.page_size)
                 self._prefix_index.insert(prompt,
                                           st.pages[:n_cover])
+            req.notify_tokens([tok])
             if self.eos_id is not None and tok == self.eos_id \
                     or req.max_new_tokens == 1:
                 req.set_result([tok])
@@ -1798,6 +1839,7 @@ class GenerationEngine:
                 continue   # free pad row (or inactive full-bucket row)
             tok = int(toks[i])
             slot.generated.append(tok)
+            slot.request.notify_tokens([tok])
             slot.position += 1
             slot.remaining -= 1
             if itl is not None:
@@ -1991,6 +2033,7 @@ class GenerationEngine:
                 emitted = emitted[:emitted.index(self.eos_id) + 1]
             c = len(emitted)
             slot.generated.extend(emitted)
+            slot.request.notify_tokens(emitted)
             slot.position += c
             slot.remaining -= c
             emitted_total += c
@@ -2132,6 +2175,12 @@ class GenerationEngine:
         if self.paged and self._prefilling:
             worked = self._prefill_tick(clock)
         if self._slots:
+            if _chaos._active is not None:
+                # replica_kill counts DECODE ticks (slots live), so a
+                # fired site always dies with generations in flight --
+                # the unplanned-death scenario the fleet front's
+                # journal replay must recover
+                _chaos.on_replica_kill()
             if self.speculative:
                 self._spec_once(clock)
             else:

@@ -10,7 +10,8 @@ from chainermn_tpu.utils.chaos import FaultInjector  # noqa
 from chainermn_tpu.utils.failure import (  # noqa
     NanGuard, DivergenceError, Heartbeat, check_finite, detect_stall,
     read_heartbeat, heartbeat_extension, CommFailure, ChannelTimeout,
-    PeerDeadError, Backoff, Deadline, CheckpointCorruptError,
+    PeerDeadError, ReplicaDeadError, Backoff, Deadline,
+    CheckpointCorruptError,
     CheckpointSkippedWarning, exit_code_for, classify_exit)
 from chainermn_tpu.utils.schedules import (  # noqa
     linear_scaled_lr, gradual_warmup, distributed_sgd_schedule)

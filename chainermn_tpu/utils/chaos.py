@@ -143,6 +143,24 @@ Sites (the action is part of the site name):
                     (``prefill_chunk``) interleaves the same work
                     with decode ticks and holds the SLO
                     (``chainermn_tpu/serving/loadgen.py``)
+``replica_kill``    hard-kill (``os._exit(46)``) the engine-replica
+                    WORKER process whose replica index
+                    (``CHAINERMN_TPU_REPLICA`` env, or the index the
+                    caller passes to ``on_replica_kill``) equals the
+                    rule ARG (default replica 0) at the start of
+                    DECODE tick N (live slots only, so the victim
+                    always dies with generations in flight) -- an
+                    UNPLANNED replica death mid-decode.  Processes
+                    outside the target replica never consult the
+                    occurrence counter (the ``slice_loss`` idiom), so
+                    survivors record no chaos event; the fleet front
+                    must detect the death typed
+                    (``failure.ReplicaDeadError``), requeue every
+                    journaled in-flight generation as an exact-greedy
+                    continuation on a survivor, and respawn the
+                    worker (``chainermn_tpu/serving/fleet.py``,
+                    ``docs/fault_tolerance.md`` "Serving
+                    self-healing")
 ``data_stall``      sleep ARG (default 0.05) seconds before a shard
                     record read (``chainermn_tpu/data/recordio.py``)
                     -- a slow/contended filesystem; the loader's
@@ -190,12 +208,17 @@ SITES = ('drop_send', 'delay_send', 'dup_send', 'stall_kv',
          'ckpt_stall', 'slice_loss',
          'serve_burst', 'serve_cancel', 'swap_kill', 'serve_slow',
          'data_stall', 'data_corrupt', 'extra_collective',
-         'serve_longprompt')
+         'serve_longprompt', 'replica_kill')
 
 #: environment variable naming this process's failure-domain slice
 #: (the supervisor's per-rank handout; MeshPlan.create(slices=)
 #: builds the matching mesh axis).  ``slice_loss`` consults it.
 SLICE_ENV_VAR = 'CHAINERMN_TPU_SLICE'
+
+#: environment variable naming this process's serving-replica index
+#: (the fleet controller's per-worker handout).  ``replica_kill``
+#: consults it (or the index passed to :func:`on_replica_kill`).
+REPLICA_ENV_VAR = 'CHAINERMN_TPU_REPLICA'
 
 
 def slice_id():
@@ -316,7 +339,8 @@ class FaultInjector:
                 telemetry.event('chaos:' + site, kind='chaos',
                                 occurrence=idx, arg=rule.arg)
                 if site in ('kill_step', 'kill_recv', 'ckpt_kill',
-                            'hang_step', 'swap_kill', 'slice_loss'):
+                            'hang_step', 'swap_kill', 'slice_loss',
+                            'replica_kill'):
                     # os._exit skips atexit: flush the timeline AND
                     # drop the crash-safe flight record NOW, or the
                     # fatal injection is invisible post-mortem
@@ -604,6 +628,40 @@ def on_serve_slow(swapped):
     r = inj.fires('serve_slow')
     if r is not None:
         time.sleep(r.arg if r.arg is not None else 0.05)
+
+
+def replica_index():
+    """This process's serving-replica index from
+    :data:`REPLICA_ENV_VAR`, or None when the process serves no
+    replica role."""
+    v = os.environ.get(REPLICA_ENV_VAR)
+    if v in (None, ''):
+        return None
+    return int(v)
+
+
+def on_replica_kill(index=None):
+    """``replica_kill``: hard-kill (``os._exit(46)``) THIS process at
+    the start of a generation-engine DECODE tick, but ONLY when
+    its replica index equals the rule ARG (default replica 0).  The
+    ``slice_loss`` idiom: the membership gate runs BEFORE the
+    occurrence counter, so non-target replicas never advance it (their
+    tick cadence differs) and record no chaos event -- the post-mortem
+    sees exactly one unplanned death, and the fleet front must requeue
+    the victim's journaled in-flight generations on the survivors.
+
+    ``index`` overrides :data:`REPLICA_ENV_VAR` (in-process fleets
+    have no per-process env to consult)."""
+    inj = _active
+    if inj is None:
+        return
+    rule = inj.rules.get('replica_kill')
+    if rule is None:
+        return
+    target = int(rule.arg) if rule.arg is not None else 0
+    me = replica_index() if index is None else index
+    if me == target and inj.fires('replica_kill') is not None:
+        os._exit(46)
 
 
 def on_serve_longprompt():

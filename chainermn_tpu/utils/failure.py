@@ -96,6 +96,32 @@ class PeerDeadError(CommFailure):
                      process_index=process_index)
 
 
+class ReplicaDeadError(CommFailure):
+    """A serving replica is POSITIVELY detected dead (its stdout
+    stream hit EOF, its process exited, or a typed RPC found the
+    connection closed).  The serving-fleet sibling of
+    :class:`PeerDeadError`: terminal for every request the replica
+    was carrying, but -- unlike a training peer -- the fleet front
+    can *recover* those requests by replaying their journaled
+    ``prompt + emitted`` prefix on a survivor (exact-greedy
+    continuation, ``docs/fault_tolerance.md`` "Serving
+    self-healing").
+
+    ``replica`` names the dead replica; ``request_ids`` lists the
+    in-flight request ids it was carrying when it died (the requeue
+    worklist)."""
+
+    status_name = 'CMN_REPLICA_DEAD'
+
+    def __init__(self, message, replica=None, request_ids=()):
+        super().__init__(message)
+        self.replica = replica
+        self.request_ids = tuple(request_ids)
+        _flight_dump('ReplicaDeadError', message=str(message),
+                     replica=replica,
+                     request_ids=list(self.request_ids))
+
+
 class CheckpointCorruptError(ValueError):
     """A checkpoint failed integrity verification and must NOT be
     restored: truncated/unreadable file, per-leaf crc32 mismatch,

@@ -298,6 +298,77 @@ rm -rf "${SPEC_DIR}"
 echo "=== fleet leg: roll->promote, canary breach->rollback, swap_kill convergence ==="
 python -m pytest tests/test_fleet_mp.py -q --runslow
 
+# SERVING SELF-HEALING LEG (ISSUE 20 acceptance): a replica worker
+# process is chaos hard-killed mid-decode (replica_kill=@2:1 --
+# os._exit(46) at replica 1's 2nd decode tick, generations in
+# flight) under open-loop traffic with the crash-safe request
+# journal armed (--recover).  The ledger must prove: every in-flight
+# request requeued onto the survivor as an exact continuation and
+# attributed by id in `recovered`; a replacement worker respawned
+# FROM THE INCUMBENT snapshot and spliced back into the front; zero
+# lost requests, zero client-visible errors.  Then the crash-loop
+# twin: replica_kill=* survives the one-shot strip by design, the
+# respawned worker dies right back, and the shared restart policy
+# aborts rc 1 within the crash window.  See docs/fault_tolerance.md
+# ("Serving self-healing").
+echo "=== serving self-healing leg: replica kill -> requeue -> respawn; crash-loop abort ==="
+HEAL_DIR=$(mktemp -d /tmp/fleet_heal.XXXXXX)
+CHAINERMN_TPU_CHAOS= \
+  python -m chainermn_tpu.serving.fleet --out "${HEAL_DIR}" \
+  --rolls 0 --duration 8 --replicas 2 --rate 20 \
+  --max-new-tokens 8 --max-prompt-len 16 --traffic-prompt-max 4 \
+  --recover --replica-chaos 'replica_kill=@2:1' \
+  > "${HEAL_DIR}/summary.json"
+python - "${HEAL_DIR}" <<'PY'
+import json, sys
+d = sys.argv[1]
+ledger = [json.loads(l) for l in open(d + '/fleet_ledger.jsonl')]
+dead = [e for e in ledger if e['event'] == 'replica_dead']
+assert len(dead) == 1 and dead[0]['replica'] == 'replica-1', dead
+assert dead[0]['returncode'] == 46 and dead[0]['exit'] == 'crash', \
+    dead[0]
+requeues = [e['request_id'] for e in ledger
+            if e['event'] == 'requeue']
+rec = [e for e in ledger if e['event'] == 'recovered'][0]
+assert rec['request_ids'] == requeues, (rec, requeues)
+assert rec['shed'] == [], rec
+respawn = [e for e in ledger if e['event'] == 'respawn'][0]
+assert respawn['replica'] == 'replica-1r1', respawn
+summary = json.loads(open(d + '/summary.json').read().strip()
+                     .splitlines()[-1])
+assert respawn['version'] == summary['version'], \
+    (respawn, summary['version'])   # incumbent weights
+r = summary['recovery']
+assert r['deaths'] == 1 and r['respawns'] == 1, r
+assert r['lost_requests'] == 0 and not r['aborted'], r
+t = summary['traffic']
+assert t['errors'] == 0 and t['served'] == t['offered'] > 0, t
+print('self-healing OK: %d requeued (%s), respawned at v%d, '
+      '%d/%d served, 0 lost'
+      % (len(requeues), ','.join(requeues) or '-',
+         respawn['version'], t['served'], t['offered']))
+PY
+if CHAINERMN_TPU_CHAOS= \
+  python -m chainermn_tpu.serving.fleet --out "${HEAL_DIR}/loop" \
+  --rolls 0 --duration 60 --replicas 2 --rate 20 \
+  --max-new-tokens 8 --max-prompt-len 16 --traffic-prompt-max 4 \
+  --recover --replica-chaos 'replica_kill=*' \
+  > "${HEAL_DIR}/loop_summary.json"; then
+  echo "crash loop did NOT abort rc 1" >&2; exit 1
+fi
+python - "${HEAL_DIR}" <<'PY'
+import json, sys
+d = sys.argv[1]
+ledger = [json.loads(l) for l in open(d + '/loop/fleet_ledger.jsonl')]
+aborts = [e for e in ledger if e['event'] == 'abort']
+assert len(aborts) == 1 and 'crash_loop' in aborts[0]['reason'], \
+    aborts
+deaths = [e for e in ledger if e['event'] == 'replica_dead']
+assert len(deaths) == 3, deaths   # threshold, inside the budget
+print('crash-loop abort OK: 3 deaths -> %r' % aborts[0]['reason'])
+PY
+rm -rf "${HEAL_DIR}"
+
 # CONVERGENCE-UNDER-CHAOS LEG (ISSUE 15 acceptance): the streaming
 # input pipeline proved end to end over REAL jax.distributed CPU
 # processes.  (1) stream_elastic: training on streamed record shards

@@ -365,6 +365,15 @@ run bench_serve_generate_paged_spec $QT python bench.py --serve --generate --qui
 # new-family-never-starves-the-headline reasoning.
 run bench_serve_fleet $QT python bench.py --serve --fleet --quick
 
+# serving self-healing (ISSUE 20): MTTR from a hard replica kill
+# mid-decode to the first recovered continuation token on a
+# survivor, with lost_requests as a HARD rc-1 gate (a journal left
+# with open entries breaks the contract whatever the MTTR says);
+# detection latency, requeue/respawn counts and degradation-rung
+# occupancy ride as sidecars.  Queued right after the fleet arm it
+# degrades from.
+run bench_serve_fleet_recovery $QT python bench.py --serve --fleet --recovery --quick
+
 # --- tier 4: the remaining BASELINE workloads ------------------------
 # seq2seq FIRST: it is the variable-shape allreduce configuration
 # (VERDICT #4) -- the datum no other workload stands in for -- and

@@ -894,3 +894,91 @@ def test_chaos_data_stall_site_delays_read(tmp_path, monkeypatch):
         assert slept == [0.25]
     finally:
         chaos.uninstall()
+
+
+# ----------------------------------------------------------------------
+# replica_kill (ISSUE 20): the serving self-healing kill site
+
+
+def test_replica_kill_in_spec_grammar_and_oneshot_strip():
+    """``replica_kill=@N:IDX`` parses beside the other serve sites;
+    ``fleet.strip_oneshot_kills`` drops the consumed ``@`` rule from a
+    respawned worker's handout but keeps ``*``/``p`` rules (the
+    crash-loop must keep crashing into the restart-policy abort)."""
+    from chainermn_tpu.serving.fleet import strip_oneshot_kills
+    seed, rank, rules = chaos.parse_spec('replica_kill=@2:1')
+    assert rules['replica_kill'].at == frozenset({2})
+    assert int(rules['replica_kill'].arg) == 1
+    assert (strip_oneshot_kills('replica_kill=@2:1;serve_slow=*:0.1')
+            == 'serve_slow=*:0.1')
+    assert (strip_oneshot_kills('replica_kill=*')
+            == 'replica_kill=*')
+    assert (strip_oneshot_kills('replica_kill=p0.5;swap_kill=@1')
+            == 'replica_kill=p0.5;swap_kill=@1')
+    assert strip_oneshot_kills(None) is None
+    assert strip_oneshot_kills('') == ''
+
+
+def test_replica_kill_fires_at_occurrence_for_target_only(
+        monkeypatch):
+    """The membership gate comes BEFORE the occurrence counter: only
+    the targeted replica index counts decode ticks, so the same spec
+    kills the same tick regardless of what other replicas do -- and
+    fires ``os._exit(46)`` exactly once for an ``@`` rule."""
+    exits = []
+    monkeypatch.setattr(chaos.os, '_exit',
+                        lambda code: exits.append(code))
+    monkeypatch.setenv(chaos.REPLICA_ENV_VAR, '1')
+    assert chaos.replica_index() == 1
+    chaos.install(chaos.FaultInjector('replica_kill=@1:1'))
+    try:
+        # a non-target replica never consumes occurrences
+        for _ in range(5):
+            chaos.on_replica_kill(index=0)
+        assert exits == []
+        chaos.on_replica_kill()        # occurrence 0: survives
+        assert exits == []
+        chaos.on_replica_kill()        # occurrence 1: dies rc 46
+        assert exits == [46]
+        chaos.on_replica_kill()        # one-shot: never re-fires
+        assert exits == [46]
+    finally:
+        chaos.uninstall()
+
+
+def test_replica_kill_inert_without_replica_identity(monkeypatch):
+    """No ``CHAINERMN_TPU_REPLICA`` in the environment (every
+    in-process engine, the whole tier-1 suite) means no identity to
+    match the target -- the site never fires and never consumes
+    occurrences."""
+    exits = []
+    monkeypatch.setattr(chaos.os, '_exit',
+                        lambda code: exits.append(code))
+    monkeypatch.delenv(chaos.REPLICA_ENV_VAR, raising=False)
+    assert chaos.replica_index() is None
+    chaos.install(chaos.FaultInjector('replica_kill=@0:0'))
+    try:
+        for _ in range(3):
+            chaos.on_replica_kill()
+        assert exits == []
+    finally:
+        chaos.uninstall()
+    chaos.on_replica_kill()           # uninstalled: quiet
+    assert exits == []
+
+
+def test_replica_kill_star_rule_is_the_crash_loop(monkeypatch):
+    """``replica_kill=*`` (no arg: target index 0) fires on EVERY
+    counted tick -- the respawn-dies-again loop the supervisor's
+    restart policy must classify as a crash loop and abort on."""
+    exits = []
+    monkeypatch.setattr(chaos.os, '_exit',
+                        lambda code: exits.append(code))
+    monkeypatch.setenv(chaos.REPLICA_ENV_VAR, '0')
+    chaos.install(chaos.FaultInjector('replica_kill=*'))
+    try:
+        chaos.on_replica_kill()
+        chaos.on_replica_kill()
+        assert exits == [46, 46]
+    finally:
+        chaos.uninstall()

@@ -419,3 +419,341 @@ def test_front_sheds_typed_only_when_nothing_serves(booted_fleet):
     finally:
         for r, s in zip(ctl.replicas, saved):
             r.state = s
+
+
+# ----------------------------------------------------------------------
+# serving self-healing (ISSUE 20): journal, recovery, ladder,
+# supervisor
+
+
+class TestRequestJournal:
+    def test_roundtrip_replay_and_torn_tail(self, tmp_path):
+        """The mirror and the disk replay agree; a torn tail from a
+        killed writer (the crash-safety contract) is skipped, not
+        fatal -- inherited from the shared Ledger discipline."""
+        path = str(tmp_path / 'journal.jsonl')
+        j = fleet.RequestJournal(path)
+        j.admit('r1', [3, 1], 4, None, 'replica-0', 2)
+        j.admit('r2', [5], 6, 123.4, 'replica-1', 2)
+        j.tokens('r1', [7, 8])
+        j.tokens('rZ', [9])          # unknown id: dropped quietly
+        j.reassign('r2', 'replica-0')
+        assert j.done('r1', outcome='served')
+        live = j.inflight()
+        assert set(live) == {'r2'}
+        assert live['r2']['replica'] == 'replica-0'
+        with open(path, 'a') as f:
+            f.write('{"event": "token", "request_id": "r2", "tok')
+        replayed = fleet.RequestJournal.replay(path)
+        assert set(replayed) == {'r2'}
+        assert replayed['r2']['prompt'] == [5]
+        assert replayed['r2']['max_new'] == 6
+        assert replayed['r2']['replica'] == 'replica-0'
+        assert replayed['r2']['emitted'] == []
+
+    def test_done_first_closer_wins(self, tmp_path):
+        """The idempotency guard: a requeue racing a late completion
+        frame closes once -- the second closer is a no-op, so the
+        handle never resolves twice."""
+        j = fleet.RequestJournal(str(tmp_path / 'j.jsonl'))
+        j.admit('r1', [1], 2, None, 'a', 0)
+        assert j.done('r1', outcome='served')
+        assert not j.done('r1', outcome='shed', reason='deadline')
+        assert j.completed == 1
+        h = fleet.FrontHandle('r1')
+        h._complete([4, 5])
+        h._fail(RuntimeError('late'))        # first-wins: ignored
+        assert list(h.result(timeout=0)) == [4, 5]
+
+
+class _LadderEngine:
+    """The four knobs apply_degradation_rung walks, nothing else."""
+
+    class _Idx:
+        def __init__(self):
+            self.evicted = 0
+
+        def evict(self, n):
+            if self.evicted >= 3:
+                return 0
+            self.evicted += 1
+            return 1
+
+    def __init__(self):
+        self.speculative = True
+        self.spec_tokens = 4
+        self.admit_cap = None
+        self._prefix_index = self._Idx()
+
+
+class TestDegradationLadder:
+    def test_apply_rung_is_idempotent_and_reversible(self):
+        eng, saved = _LadderEngine(), {}
+        fleet.apply_degradation_rung(eng, 3, saved)
+        assert eng._prefix_index.evicted == 3    # rung>=1: full evict
+        assert eng.speculative is False          # rung>=2
+        assert eng.spec_tokens == 2              # rung>=3: halved
+        assert eng.admit_cap == 1                # rung>=3
+        fleet.apply_degradation_rung(eng, 3, saved)   # idempotent
+        assert (eng.spec_tokens, eng.admit_cap) == (2, 1)
+        fleet.apply_degradation_rung(eng, 0, saved)   # walk back
+        assert eng.speculative is True
+        assert eng.spec_tokens == 4
+        assert eng.admit_cap is None
+
+    def test_escalation_hysteresis_and_ledger_events(self, tmp_path):
+        led = Ledger(str(tmp_path / 'led.jsonl'))
+        clk = [0.0]
+        pol = fleet.DegradationPolicy(ledger=led, recover_healthy=2,
+                                      clock=lambda: clk[0])
+        assert pol.observe('ok') is None
+        assert pol.observe('breach', breaches=['ttft_p99']) == 1
+        assert pol.observe(None, kv_in_use=31, kv_total=32) == 2
+        assert pol.observe('breach') == 3
+        assert pol.observe('breach') == 4
+        assert pol.observe('breach') is None     # already at the top
+        # one healthy window is NOT enough (hysteresis) ...
+        assert pol.observe('ok') is None
+        # ... a breach resets the streak entirely
+        assert pol.observe('breach') is None
+        assert pol.observe('ok') is None
+        assert pol.observe('ok') == 3            # 2 consecutive: down
+        assert pol.observe('warn') is None       # warn: holds, no move
+        entries = events(Ledger.read(str(tmp_path / 'led.jsonl')),
+                         'degrade')
+        assert [(e['direction'], e['to_name']) for e in entries] == [
+            ('escalate', 'evict_prefix'), ('escalate', 'no_spec'),
+            ('escalate', 'shrink_admission'), ('escalate', 'shed'),
+            ('recover', 'shrink_admission')]
+        assert entries[0]['reasons'] == ['slo_breach:ttft_p99']
+        assert entries[1]['reasons'] == ['kv_pressure:3%_free']
+
+    def test_shed_slice_only_at_top_rung(self):
+        pol = fleet.DegradationPolicy(shed_fraction=0.5)
+        rids = ['r%d' % i for i in range(200)]
+        assert not any(pol.sheds(r) for r in rids)   # rung 0: never
+        pol.rung = len(fleet.DEGRADATION_RUNGS) - 1
+        frac = sum(pol.sheds(r) for r in rids) / len(rids)
+        assert 0.3 < frac < 0.7                  # the hash slice
+        assert pol.sheds('r7') == pol.sheds('r7')   # deterministic
+
+
+class _DeadStub:
+    """A replica that is only ever a name + state (recover() never
+    talks to the dead replica itself)."""
+
+    def __init__(self, name, state='serving', version=2):
+        self.name = name
+        self.state = state
+        self.version = version
+
+    def shed_total(self):
+        return 0
+
+
+class TestFrontRecover:
+    def _front(self, tmp_path, replicas):
+        return fleet.FleetFront(
+            replicas, current_version=2,
+            journal=fleet.RequestJournal(str(tmp_path / 'j.jsonl')))
+
+    def test_expired_deadline_sheds_typed_with_attribution(
+            self, tmp_path):
+        dead = _DeadStub('replica-1')
+        front = self._front(tmp_path, [_DeadStub('replica-0'), dead])
+        front.journal.admit('r1', [1, 2], 4, -1.0, 'replica-1', 2)
+        led = Ledger(str(tmp_path / 'led.jsonl'))
+        requeued, shed = front.recover(dead, ledger=led)
+        assert (requeued, shed) == ([], ['r1'])
+        entries = Ledger.read(str(tmp_path / 'led.jsonl'))
+        rs = events(entries, 'requeue_shed')
+        assert rs[0]['request_id'] == 'r1'
+        assert rs[0]['replica'] == 'replica-1'   # WHO died with it
+        assert rs[0]['reason'] == 'deadline'
+        rec = events(entries, 'recovered')[0]
+        assert rec['shed'] == ['r1']
+        assert front.journal.inflight() == {}    # nothing lost open
+
+    def test_completed_at_death_resolves_from_journal(self, tmp_path):
+        """Every token was journaled before the death -- no survivor
+        is consulted at all; the handle resolves from the journal."""
+        dead = _DeadStub('replica-1')
+        front = self._front(tmp_path, [dead])    # NO survivor
+        front.journal.admit('r1', [1], 2, None, 'replica-1', 2)
+        front.journal.tokens('r1', [8])
+        front.journal.tokens('r1', [9])
+        h = fleet.FrontHandle('r1')
+        front._handles['r1'] = h
+        led = Ledger(str(tmp_path / 'led.jsonl'))
+        requeued, shed = front.recover(dead, ledger=led)
+        assert (requeued, shed) == ([], [])
+        assert list(h.result(timeout=1.0)) == [8, 9]
+        rec = events(Ledger.read(str(tmp_path / 'led.jsonl')),
+                     'recovered')[0]
+        assert rec['completed_at_death'] == ['r1']
+
+    def test_no_survivor_sheds_typed_no_replica(self, tmp_path):
+        dead = _DeadStub('replica-0')
+        front = self._front(tmp_path, [dead])
+        front.journal.admit('r1', [1], 4, None, 'replica-0', 2)
+        h = fleet.FrontHandle('r1')
+        front._handles['r1'] = h
+        requeued, shed = front.recover(dead)
+        assert shed == ['r1']
+        with pytest.raises(failure.OverloadError) as ei:
+            h.result(timeout=1.0)
+        assert ei.value.reason == 'no_replica'
+
+
+def test_supervisor_crash_loop_aborts_within_budget(tmp_path):
+    """A replica that dies right back after every respawn is a crash
+    loop: the shared RestartPolicy aborts at crash_threshold deaths
+    inside the window and the ledger records the abort -- the
+    ``replica_kill=*`` CI scenario, in-process."""
+    out = str(tmp_path / 'out')
+    front = fleet.FleetFront(
+        [_DeadStub('replica-0', state='dead'), _DeadStub('replica-1')],
+        current_version=2,
+        journal=fleet.RequestJournal(str(tmp_path / 'j.jsonl')))
+    ctl = fleet.FleetController(front, str(tmp_path / 'ck'), out,
+                                boot=('snap2', 2))
+    spawned = []
+
+    def spawn_fn(name, path, version, index):
+        spawned.append(name)
+        return _DeadStub(name, state='dead', version=version)
+
+    from chainermn_tpu.training.supervisor import RestartPolicy
+    sup = fleet.ReplicaSupervisor(
+        ctl, spawn_fn=spawn_fn,
+        policy=RestartPolicy(max_restarts=8, crash_window=120.0,
+                             crash_threshold=3, shrink_causes=(),
+                             backoff=failure.Backoff(initial=0.001,
+                                                     max_delay=0.001)))
+    for _ in range(5):
+        sup.check()
+        if sup.aborted:
+            break
+    assert sup.aborted
+    assert sup.deaths == 3
+    assert spawned == ['replica-0r1', 'replica-0r2']
+    assert 'crash_loop' in sup.abort_reason
+    aborts = events(Ledger.read(os.path.join(out, fleet.LEDGER_NAME)),
+                    'abort')
+    assert len(aborts) == 1
+    d = sup.describe()
+    assert d['aborted'] and d['lost_requests'] == 0
+
+
+# -- the acceptance pin: exact-replay recovery, token for token ---------
+
+_RECOVERY_MAXNEW = 10
+
+
+def _recovery_prompts():
+    """Five prompts sharing a 2-token prefix (so the paged mode's
+    radix index actually shares pages across them)."""
+    rng = np.random.RandomState(7)
+    vocab = fleet.DEMO_MODEL['vocab_size']
+    base = rng.randint(0, vocab, size=2)
+    return [np.concatenate([base, rng.randint(0, vocab, size=1)])
+            for _ in range(5)]
+
+
+@pytest.fixture(scope='module')
+def recovery_seed(tmp_path_factory):
+    """Trained demo checkpoint + the uninterrupted single-engine
+    oracle streams (slab; cross-mode greedy equivalence is already
+    pinned by the serving and speculative suites)."""
+    from chainermn_tpu.serving.generate import (GenerationEngine,
+                                                GenerationQueue)
+    from chainermn_tpu.training import recovery
+    tmp = tmp_path_factory.mktemp('recovery')
+    ck = str(tmp / 'ckpt')
+    fleet.demo_train(ck, steps=2, snapshot_every=2)
+    kind, path, it = recovery.latest_snapshot(ck)
+    model, template = fleet.demo_params()
+    eng = GenerationEngine.from_checkpoint(
+        path, model, template, n_slots=2, max_prompt_len=12,
+        label='oracle', version=it)
+    q = GenerationQueue(12, max_queue=64, label='oracle')
+    prompts = _recovery_prompts()
+    reqs = [q.submit(p, _RECOVERY_MAXNEW) for p in prompts]
+    for _ in range(3000):
+        if all(r.done() for r in reqs):
+            break
+        eng.step(q)
+    oracle = [[int(t) for t in r.result(timeout=0)] for r in reqs]
+    return ck, path, it, prompts, oracle
+
+
+@pytest.mark.parametrize('mode', ['slab', 'paged_prefix',
+                                  'speculative'])
+def test_replica_kill_midflight_recovers_token_parity(
+        recovery_seed, mode, tmp_path):
+    """THE pin: hard-kill a replica mid-decode with >= 4 generations
+    in flight; every client stream completes token-for-token equal to
+    the uninterrupted oracle (journaled prefix + teacher-forced
+    continuation on a survivor), the ledger attributes every requeue,
+    the journal ends with zero lost requests, and the supervisor
+    splices a respawned replica serving the incumbent version back
+    into the front -- in every KV-cache mode."""
+    ck, path, it, prompts, oracle = recovery_seed
+    engine_kw = {}
+    if mode == 'paged_prefix':
+        engine_kw = dict(paged=True, page_size=8)
+    elif mode == 'speculative':
+        from chainermn_tpu.serving.engine import load_params
+        model, template = fleet.demo_params()
+        engine_kw = dict(draft_model=fleet.demo_model(),
+                         draft_params=load_params(path, template))
+    out = str(tmp_path / 'out')
+    ctl = fleet.build_local_fleet(
+        ck, out, n_replicas=2, n_slots=2, max_prompt_len=12,
+        journal=True, engine_kw=engine_kw, warmup=False)
+    ctl.start()
+    sup = fleet.ReplicaSupervisor(
+        ctl, spawn_fn=fleet.local_respawn_fn(
+            n_slots=2, max_prompt_len=12, engine_kw=engine_kw,
+            warmup=False))
+    front = ctl.front
+    try:
+        # pin every submission to replica-1 so one kill catches all
+        front.replicas[0].state = 'draining'
+        handles = [front.submit(p, _RECOVERY_MAXNEW)
+                   for p in prompts]
+        front.replicas[0].state = 'serving'
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:   # wait for MID-decode
+            inf = front.journal.inflight(replica='replica-1')
+            if any(e['emitted'] for e in inf.values()):
+                break
+            time.sleep(0.002)
+        front.replicas[1].kill()
+        inflight = front.journal.inflight(replica='replica-1')
+        assert len(inflight) >= 4, \
+            'kill raced completion: %d in flight' % len(inflight)
+        sup.check()
+        results = [h.result(timeout=120.0) for h in handles]
+        for got, want in zip(results, oracle):
+            assert [int(t) for t in got] == want   # THE parity pin
+        entries = Ledger.read(os.path.join(out, fleet.LEDGER_NAME))
+        assert events(entries, 'replica_dead')[0]['replica'] == \
+            'replica-1'
+        requeues = events(entries, 'requeue')
+        rec = events(entries, 'recovered')[0]
+        assert rec['request_ids'] == \
+            [e['request_id'] for e in requeues]   # all attributed
+        assert rec['shed'] == []
+        assert len(events(entries, 'respawn')) == 1
+        assert sup.describe()['lost_requests'] == 0
+        replacement = front.replicas[1]
+        assert replacement.name == 'replica-1r1'
+        assert replacement.version == it          # incumbent weights
+        assert replacement.state == 'serving'
+        # the respawned replica actually serves
+        h = front.submit(prompts[0], 2)
+        assert len(h.result(timeout=60.0)) == 2
+    finally:
+        sup.stop()
+        ctl.close()

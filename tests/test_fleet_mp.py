@@ -165,3 +165,63 @@ def test_swap_kill_mid_roll_converges_on_restart(tmp_path):
     assert starts[-1]['version'] == 4
     comp = events(ledger2, 'complete')[-1]
     assert comp['traffic']['served'] > 0   # converged fleet serves
+
+
+@pytest.mark.slow
+def test_replica_kill_recovers_all_inflight_and_respawns(tmp_path):
+    """ISSUE 20 acceptance, on real worker processes: chaos hard-kills
+    replica 1 mid-decode (``os._exit(46)`` at its 2nd decode tick);
+    the journaled front requeues every in-flight generation onto the
+    survivor as an exact continuation, the supervisor respawns a
+    replacement from the incumbent snapshot, and the run ends with
+    zero lost requests and zero client-visible errors."""
+    out = tmp_path / 'run'
+    proc, ledger = _run_fleet(
+        out, ['--rolls', '0', '--duration', '8', '--recover',
+              '--max-prompt-len', '16', '--traffic-prompt-max', '4',
+              '--max-new-tokens', '8',
+              '--replica-chaos', 'replica_kill=@2:1'],
+        timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    summary = _summary(proc)
+    rec = summary['recovery']
+    assert rec['deaths'] == 1 and rec['respawns'] == 1
+    assert not rec['aborted']
+    assert rec['lost_requests'] == 0
+    t = summary['traffic']
+    assert t['errors'] == 0 and t['served'] == t['offered'] > 0
+
+    dead = events(ledger, 'replica_dead')
+    assert len(dead) == 1 and dead[0]['replica'] == 'replica-1'
+    assert dead[0]['exit'] == 'crash' and dead[0]['returncode'] == 46
+    requeues = [e['request_id'] for e in events(ledger, 'requeue')]
+    recov = events(ledger, 'recovered')[0]
+    assert recov['request_ids'] == requeues   # every one attributed
+    respawn = events(ledger, 'respawn')[0]
+    assert respawn['replica'] == 'replica-1r1'
+    # the replacement serves the INCUMBENT version (the boot snapshot
+    # -- no rolls in this scenario)
+    assert respawn['version'] == summary['version']
+
+
+@pytest.mark.slow
+def test_replica_kill_crash_loop_aborts_rc1(tmp_path):
+    """``replica_kill=*``: the respawned worker dies right back (the
+    ``*`` rule survives the one-shot strip by design), so the shared
+    restart policy classifies a crash loop and aborts -- rc 1, abort
+    ledgered, within the restart budget."""
+    out = tmp_path / 'run'
+    proc, ledger = _run_fleet(
+        out, ['--rolls', '0', '--duration', '60', '--recover',
+              '--max-prompt-len', '16', '--traffic-prompt-max', '4',
+              '--replica-chaos', 'replica_kill=*'],
+        timeout=420)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    summary = _summary(proc)
+    rec = summary['recovery']
+    assert rec['aborted'] and 'crash_loop' in rec['abort_reason']
+    assert rec['deaths'] == 3                 # threshold, not budget
+    assert rec['lost_requests'] == 0          # survivor absorbed all
+    aborts = events(ledger, 'abort')
+    assert len(aborts) == 1
+    assert 'crash_loop' in aborts[0]['reason']
